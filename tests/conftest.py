@@ -1,0 +1,43 @@
+"""Test harness: force an 8-virtual-device CPU backend BEFORE jax initialises.
+
+Real multi-chip TPU hardware is not available in CI; all sharding/mesh tests
+run against 8 virtual CPU devices, the same validation path the driver uses
+for ``__graft_entry__.dryrun_multichip``.
+"""
+
+import os
+import sys
+
+# The image's sitecustomize imports jax at interpreter start (axon PJRT
+# registration), so plain env vars are read too early to override here; use
+# jax.config updates, which win as long as no backend has been initialised.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Repo root on sys.path so `import tpustack` works without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from tpustack.parallel import build_mesh
+
+    return build_mesh((2, 2, 2, 1))

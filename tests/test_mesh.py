@@ -1,0 +1,61 @@
+import pytest
+
+from tpustack.parallel import MeshConfig, best_mesh_shape, build_mesh
+
+
+def test_mesh_config_resolve():
+    assert MeshConfig().resolve(8) == (8, 1, 1, 1)
+    assert MeshConfig(dp=-1, tp=2).resolve(8) == (4, 1, 2, 1)
+    assert MeshConfig(dp=2, fsdp=2, tp=2).resolve(8) == (2, 2, 2, 1)
+    with pytest.raises(ValueError):
+        MeshConfig(dp=3).resolve(8)
+
+
+def test_best_mesh_shape():
+    assert best_mesh_shape(8) == (1, 8, 1, 1)
+    assert best_mesh_shape(8, tp=2) == (1, 4, 2, 1)
+    assert best_mesh_shape(16, tp=4, sp=2, fsdp=2) == (1, 2, 4, 2)
+
+
+def test_build_mesh_8cpu(devices8):
+    mesh = build_mesh((2, 2, 2, 1))
+    assert mesh.axis_names == ("dp", "fsdp", "tp", "sp")
+    assert mesh.devices.shape == (2, 2, 2, 1)
+
+
+def test_attention_matches_reference():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpustack.ops import dot_product_attention
+
+    k0 = jax.random.PRNGKey(0)
+    q = jax.random.normal(k0, (2, 16, 4, 8))
+    k = jax.random.normal(jax.random.fold_in(k0, 1), (2, 16, 4, 8))
+    v = jax.random.normal(jax.random.fold_in(k0, 2), (2, 16, 4, 8))
+    out = dot_product_attention(q, k, v)
+
+    # naive reference
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(8)
+    probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    ref = np.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    # causal: last query attends to all, first only to itself
+    out_c = dot_product_attention(q, k, v, causal=True)
+    first = dot_product_attention(q[:, :1], k[:, :1], v[:, :1])
+    np.testing.assert_allclose(np.asarray(out_c[:, 0]), np.asarray(first[:, 0]), atol=1e-5)
+
+
+def test_attention_gqa():
+    import jax
+
+    from tpustack.ops import dot_product_attention
+
+    k0 = jax.random.PRNGKey(1)
+    q = jax.random.normal(k0, (1, 8, 8, 4))
+    k = jax.random.normal(jax.random.fold_in(k0, 1), (1, 8, 2, 4))
+    v = jax.random.normal(jax.random.fold_in(k0, 2), (1, 8, 2, 4))
+    out = dot_product_attention(q, k, v)
+    assert out.shape == (1, 8, 8, 4)

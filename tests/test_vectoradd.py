@@ -1,0 +1,24 @@
+import os
+import subprocess
+import sys
+
+from tpustack.ops import vectoradd_selftest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_vectoradd_passes():
+    assert vectoradd_selftest()
+
+
+def test_vectoradd_cli_prints_passed():
+    """The k8s Job log gate greps for 'Test PASSED' (README.md parity)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpustack.ops.vectoradd"],
+        capture_output=True,
+        text=True,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin", "PYTHONPATH": REPO_ROOT},
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip().endswith("Test PASSED")

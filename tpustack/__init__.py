@@ -1,0 +1,34 @@
+"""tpustack — a TPU-native re-build of the ``christianshub/k8s-nvidia-gpus`` stack.
+
+The reference (surveyed in ``SURVEY.md``) is an infrastructure-as-code stack that
+turns a GPU host into a single-node Kubernetes cluster running GPU workloads
+(Stable Diffusion 1.5 REST API, llama.cpp LLM server, CUDA vectoradd smoke
+tests), reconciled by FluxCD.  This package is the *compute half* of the
+TPU-native equivalent: everything the reference consumed as prebuilt
+CUDA/C++/torch container images (diffusers' StableDiffusionPipeline, llama.cpp,
+the CUDA vectoradd sample) is re-designed here as idiomatic JAX/XLA for TPU —
+NHWC layouts for the MXU, bf16 compute, ``jit``-compiled static-shape loops,
+``jax.sharding.Mesh`` + collectives for scale-out instead of NCCL.
+
+Layout
+------
+- ``tpustack.ops``       — small device ops (vectoradd smoke test, attention).
+- ``tpustack.models``    — model families: SD1.5 (CLIP/UNet/VAE/schedulers),
+                           ResNet-50, BERT, Llama-2/Qwen2.
+- ``tpustack.parallel``  — mesh construction, sharding rules, distributed init
+                           (JobSet/TPU env), ring attention for long context.
+- ``tpustack.serving``   — HTTP servers re-implementing the reference apps'
+                           REST contracts (sd15-api, llama.cpp server).
+- ``tpustack.train``     — the BASELINE.json training ladder (ResNet-50 →
+                           BERT pmap → Llama-2 multi-host pjit), Orbax ckpt.
+- ``tpustack.utils``     — config/env-flag system, logging, image IO, HF
+                           safetensors weight loading.
+
+The *infrastructure half* (Ansible playbooks, Flux manifests, the TPU device
+plugin / JobSet stack, k8s Jobs) lives at the repo root in
+``tpu-installation/`` and ``cluster-config/`` mirroring the reference layout.
+"""
+
+from tpustack.version import __version__
+
+__all__ = ["__version__"]

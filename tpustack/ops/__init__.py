@@ -1,0 +1,4 @@
+from tpustack.ops.vectoradd import vector_add, vectoradd_selftest
+from tpustack.ops.attention import dot_product_attention
+
+__all__ = ["vector_add", "vectoradd_selftest", "dot_product_attention"]

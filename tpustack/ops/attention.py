@@ -1,0 +1,74 @@
+"""Attention ops shared by the model families.
+
+The reference never implements attention itself — it arrives prebuilt inside
+diffusers (sd15-api) and llama.cpp (llm app).  Here it is a first-class op:
+a plain XLA einsum path (lets XLA fuse softmax into the matmuls on the MXU)
+plus an optional Pallas flash-attention kernel for long sequences
+(``tpustack.ops.pallas.flash_attention``), selected by ``impl=``.
+
+Shapes follow the TPU-friendly convention ``[batch, seq, heads, head_dim]``
+(BSHD); matmuls contract over head_dim/seq which XLA tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: str = "xla",
+) -> jax.Array:
+    """Scaled dot-product attention over BSHD tensors.
+
+    Args:
+      q: ``[B, Sq, H, D]``.
+      k/v: ``[B, Sk, Hkv, D]`` — ``Hkv`` may divide ``H`` (GQA/MQA); kv heads
+        are repeated to match.
+      mask: optional boolean mask broadcastable to ``[B, H, Sq, Sk]``; True
+        means *attend*.
+      causal: apply a causal mask (decoder LMs).
+      scale: defaults to ``1/sqrt(D)``.
+      impl: ``"xla"`` (default) or ``"flash"`` (Pallas kernel, TPU).
+    """
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        if h % hkv:
+            raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+        k = jnp.repeat(k, h // hkv, axis=2)
+        v = jnp.repeat(v, h // hkv, axis=2)
+
+    if impl == "flash":
+        if mask is not None:
+            raise NotImplementedError("flash impl supports causal=, not arbitrary mask=")
+        from tpustack.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r}")
+
+    if scale is None:
+        scale = d ** -0.5
+    # [B, H, Sq, Sk]; accumulate logits in fp32 for bf16 inputs.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * jnp.asarray(scale, logits.dtype)
+
+    if causal:
+        sk = k.shape[1]
+        causal_mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        mask = causal_mask if mask is None else jnp.logical_and(mask, causal_mask)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,3 @@
+from tpustack.parallel.mesh import MeshConfig, build_mesh, best_mesh_shape
+
+__all__ = ["MeshConfig", "build_mesh", "best_mesh_shape"]

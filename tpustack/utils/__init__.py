@@ -1,0 +1,4 @@
+from tpustack.utils.config import EnvConfig, env_flag, env_int, env_str
+from tpustack.utils.logging import get_logger
+
+__all__ = ["EnvConfig", "env_flag", "env_int", "env_str", "get_logger"]

@@ -1,0 +1,39 @@
+"""Image IO helpers for the serving layer.
+
+The reference app returns PNG bytes from ``POST /generate`` and caches the
+last image for ``GET /last`` (``cluster-config/apps/sd15-api/configmap.yaml:
+113-121``).  PNG encoding here prefers the native C helper
+(``tpustack.runtime``) when built, falling back to PIL.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+
+def array_to_png(img: np.ndarray) -> bytes:
+    """Encode an ``[H, W, 3]`` uint8 array as PNG bytes."""
+    img = np.asarray(img)
+    if img.dtype != np.uint8:
+        raise ValueError(f"expected uint8 image, got {img.dtype}")
+    try:
+        from tpustack.runtime import png_encode  # native fast path (C)
+    except ImportError:
+        png_encode = None
+    if png_encode is not None:
+        # A real encode failure should surface, not silently fall back.
+        return png_encode(img)
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def denormalize_to_uint8(x) -> np.ndarray:
+    """Map model output in [-1, 1] (VAE decode range) to uint8 [0, 255]."""
+    x = np.asarray(x, dtype=np.float32)
+    x = np.clip((x + 1.0) * 127.5, 0.0, 255.0)
+    return x.round().astype(np.uint8)

@@ -1,0 +1,36 @@
+"""Structured-ish logging matching the reference app's posture.
+
+The reference sd15-api logs INFO lines with prompt/params/latency
+(``cluster-config/apps/sd15-api/configmap.yaml:33-35,94-102,116``) and relies
+on ``kubectl logs`` as the observability interface.  We keep that: stdlib
+logging to stdout, one shared formatter, no external sinks.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root = logging.getLogger("tpustack")
+    root.addHandler(handler)
+    root.setLevel(os.environ.get("TPUSTACK_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure_root()
+    if name == "tpustack" or name.startswith("tpustack."):
+        return logging.getLogger(name)
+    return logging.getLogger(f"tpustack.{name}")
