@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Batch-generate images from the SD15 TPU API over HTTP.
+
+TPU-native port of the reference client (``/root/reference/scripts/
+batch_generate.py:1-62``) — the BASELINE.json metric workload ("samples/sec/
+chip").  Same CLI shape (prompt, count, prefix, out_dir, --steps/--url/
+--delay), same POST {prompt, steps} → PNG + ``X-Gen-Time`` protocol, with the
+reference's known bugs fixed (SURVEY.md §7): ``traceback`` is imported before
+use (ref L32,35), the ``--steps`` default matches its help text (ref L50),
+and a summary line reports aggregate samples/sec at the end.
+
+Also runs in-cluster as a Flux-reconciled Job (``cluster-config/jobs/
+batch-generate.yaml``), the north-star deployment mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import traceback
+from pathlib import Path
+
+import requests
+
+DEFAULT_URL = "http://127.0.0.1:30800/generate"
+
+
+def generate(prompt: str, steps: int, url: str, out_dir: Path, prefix: str,
+             count: int, delay: float, width: int | None = None,
+             height: int | None = None) -> int:
+    out_dir.mkdir(parents=True, exist_ok=True)
+    session = requests.Session()
+    ok = 0
+    t_start = time.time()
+
+    for idx in range(1, count + 1):
+        name = f"{prefix}_{idx:02d}.png"
+        target = out_dir / name
+        payload = {"prompt": prompt, "steps": steps}
+        if width is not None:
+            payload["width"] = width
+        if height is not None:
+            payload["height"] = height
+
+        print(f"[*] Generating {name} -> {target}")
+        try:
+            resp = session.post(url, json=payload, timeout=600)
+            resp.raise_for_status()
+            target.write_bytes(resp.content)
+            gen_time = resp.headers.get("X-Gen-Time", "?")
+            print(f"    done in {gen_time}")
+            ok += 1
+        except requests.exceptions.RequestException as e:
+            print(f"    Request failed for {name}: {e}")
+            traceback.print_exc()
+        except Exception as e:
+            print(f"    Unexpected error for {name}: {e}")
+            traceback.print_exc()
+
+        if delay > 0 and idx != count:
+            time.sleep(delay)
+
+    wall = time.time() - t_start
+    if ok:
+        print(f"[*] {ok}/{count} images in {wall:.1f}s "
+              f"({ok / wall:.3f} samples/sec)")
+    else:
+        print("[*] Generation loop finished (all requests failed).")
+    return ok
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        description="Batch-generate images via the SD15 TPU API")
+    parser.add_argument("prompt", help="prompt to send to the API")
+    parser.add_argument("count", type=int, help="number of images to generate")
+    parser.add_argument("prefix", help="output filename prefix, e.g. piggy")
+    parser.add_argument("out_dir", nargs="?", default="outputs",
+                        help="directory to save images (default: outputs)")
+    parser.add_argument("--steps", type=int, default=30,
+                        help="diffusion steps per image (default: 30)")
+    parser.add_argument("--url", default=DEFAULT_URL,
+                        help=f"API endpoint (default: {DEFAULT_URL})")
+    parser.add_argument("--delay", type=float, default=0,
+                        help="seconds to sleep between requests")
+    parser.add_argument("--width", type=int, default=None,
+                        help="image width (server default if omitted)")
+    parser.add_argument("--height", type=int, default=None,
+                        help="image height (server default if omitted)")
+    args = parser.parse_args(argv)
+
+    out_dir = Path(args.out_dir)
+    ok = generate(args.prompt, args.steps, args.url, out_dir, args.prefix,
+                  args.count, args.delay, args.width, args.height)
+    print(f"All done. Images saved under {out_dir.resolve()}")
+    return 0 if ok == args.count else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,118 @@
+"""Weight-converter round-trip tests (offline, synthetic HF state dicts)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpustack.models.sd15 import SD15Config
+from tpustack.models.sd15.clip import CLIPTextEncoder
+from tpustack.models.sd15.unet import UNet2DCondition
+from tpustack.models.sd15.vae import VAEDecoder, VAEEncoder
+from tpustack.models.sd15.weights import (
+    convert_state_dict,
+    make_fake_hf_state_dict,
+    our_path_to_hf_key,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return SD15Config.tiny()
+
+
+def _roundtrip(template, model, n_levels=4):
+    hf = make_fake_hf_state_dict(template, model, n_levels)
+    ours = convert_state_dict(template, hf, model, n_levels)
+
+    flat_t = jax.tree_util.tree_leaves_with_path(template)
+    flat_o = jax.tree_util.tree_leaves_with_path(ours)
+    assert len(flat_t) == len(flat_o)
+    for (pt, t), (po, o) in zip(sorted(flat_t, key=lambda x: str(x[0])),
+                                sorted(flat_o, key=lambda x: str(x[0]))):
+        assert str(pt) == str(po)
+        assert t.shape == o.shape, f"{pt}: {t.shape} vs {o.shape}"
+    return hf
+
+
+def test_unet_key_mapping_spotchecks():
+    assert (our_path_to_hf_key(("down_0_res_1", "conv1", "kernel"), "unet")
+            == "down_blocks.0.resnets.1.conv1.weight")
+    assert (our_path_to_hf_key(("up_3_res_0", "norm1", "scale"), "unet")
+            == "up_blocks.0.resnets.0.norm1.weight")
+    assert (our_path_to_hf_key(("down_1_attn_0", "blocks_0", "attn2", "to_out", "kernel"), "unet")
+            == "down_blocks.1.attentions.0.transformer_blocks.0.attn2.to_out.0.weight")
+    assert (our_path_to_hf_key(("down_1_attn_0", "blocks_0", "ff", "proj_in", "kernel"), "unet")
+            == "down_blocks.1.attentions.0.transformer_blocks.0.ff.net.0.proj.weight")
+    assert (our_path_to_hf_key(("down_1_attn_0", "blocks_0", "ff", "proj_out", "bias"), "unet")
+            == "down_blocks.1.attentions.0.transformer_blocks.0.ff.net.2.bias")
+    assert (our_path_to_hf_key(("time_fc1", "kernel"), "unet")
+            == "time_embedding.linear_1.weight")
+    assert (our_path_to_hf_key(("norm_out", "scale"), "unet")
+            == "conv_norm_out.weight")
+    assert (our_path_to_hf_key(("down_0_downsample", "conv", "kernel"), "unet")
+            == "down_blocks.0.downsamplers.0.conv.weight")
+
+
+def test_text_encoder_key_mapping():
+    assert (our_path_to_hf_key(("layers_3", "self_attn", "q_proj", "kernel"), "text_encoder")
+            == "text_model.encoder.layers.3.self_attn.q_proj.weight")
+    assert (our_path_to_hf_key(("token_embedding", "embedding"), "text_encoder")
+            == "text_model.embeddings.token_embedding.weight")
+    assert (our_path_to_hf_key(("final_layer_norm", "bias"), "text_encoder")
+            == "text_model.final_layer_norm.bias")
+
+
+def test_vae_key_mapping():
+    assert (our_path_to_hf_key(("post_quant_conv", "kernel"), "vae_decoder")
+            == "post_quant_conv.weight")
+    assert (our_path_to_hf_key(("mid", "attn", "to_q", "kernel"), "vae_decoder")
+            == "decoder.mid_block.attentions.0.to_q.weight")
+    assert (our_path_to_hf_key(("up_0_res_2", "conv1", "bias"), "vae_decoder")
+            == "decoder.up_blocks.0.resnets.2.conv1.bias")
+    assert (our_path_to_hf_key(("up_1_upsample", "kernel"), "vae_decoder")
+            == "decoder.up_blocks.1.upsamplers.0.conv.weight")
+    assert (our_path_to_hf_key(("quant_conv", "bias"), "vae_encoder")
+            == "quant_conv.bias")
+
+
+def test_roundtrip_all_modules(tiny):
+    n_levels = len(tiny.unet.block_out_channels)
+    clip = CLIPTextEncoder(tiny.text)
+    ids = jnp.zeros((1, tiny.text.max_length), jnp.int32)
+    tmpl = clip.init(jax.random.PRNGKey(0), ids)["params"]
+    _roundtrip(tmpl, "text_encoder")
+
+    unet = UNet2DCondition(tiny.unet)
+    ctx = jnp.zeros((1, tiny.text.max_length, tiny.unet.cross_attention_dim))
+    tmpl = unet.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 4)),
+                     jnp.zeros((1,), jnp.int32), ctx)["params"]
+    _roundtrip(tmpl, "unet", n_levels)
+
+    dec = VAEDecoder(tiny.vae)
+    tmpl = dec.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 4)))["params"]
+    _roundtrip(tmpl, "vae_decoder")
+
+    enc = VAEEncoder(tiny.vae)
+    tmpl = enc.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))["params"]
+    _roundtrip(tmpl, "vae_encoder")
+
+
+def test_conversion_values_transposed(tiny):
+    """Conv kernels must be [kh,kw,I,O] after conversion from torch [O,I,kh,kw]."""
+    dec = VAEDecoder(tiny.vae)
+    tmpl = dec.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 4)))["params"]
+    hf = make_fake_hf_state_dict(tmpl, "vae_decoder")
+    ours = convert_state_dict(tmpl, hf, "vae_decoder")
+    torch_w = hf["decoder.conv_in.weight"]
+    np.testing.assert_array_equal(
+        np.asarray(ours["conv_in"]["kernel"]), np.transpose(torch_w, (2, 3, 1, 0)))
+
+
+def test_missing_keys_raise(tiny):
+    dec = VAEDecoder(tiny.vae)
+    tmpl = dec.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 4)))["params"]
+    hf = make_fake_hf_state_dict(tmpl, "vae_decoder")
+    hf.pop("decoder.conv_in.weight")
+    with pytest.raises(ValueError, match="missing"):
+        convert_state_dict(tmpl, hf, "vae_decoder")

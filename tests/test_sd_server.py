@@ -1,0 +1,139 @@
+"""SD15 API server tests — in-process contract tests + a subprocess e2e run
+driving the real server with the real batch_generate client."""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PNG_MAGIC = b"\x89PNG\r\n\x1a\n"
+
+
+@pytest.fixture(scope="module")
+def server():
+    from tpustack.models.sd15 import SD15Config, SD15Pipeline
+    from tpustack.serving.sd_server import SDServer
+
+    return SDServer(pipeline=SD15Pipeline(SD15Config.tiny()))
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_rest_contract(server):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            # healthz (configmap.yaml:60-62 parity)
+            r = await client.get("/healthz")
+            assert r.status == 200 and await r.json() == {"ok": True}
+
+            # /last before any generate → 404 (configmap.yaml:80-84)
+            r = await client.get("/last")
+            assert r.status == 404
+
+            # index placeholder (configmap.yaml:64-67)
+            r = await client.get("/")
+            assert "No image generated yet" in await r.text()
+
+            # generate → PNG + X-Gen-Time header (configmap.yaml:86-121)
+            r = await client.post("/generate", json={
+                "prompt": "a panda", "steps": 2, "width": 64, "height": 64,
+                "seed": 7})
+            assert r.status == 200
+            body = await r.read()
+            assert body[:8] == PNG_MAGIC
+            assert r.headers["X-Gen-Time"].endswith("s")
+            assert r.content_type == "image/png"
+
+            # /last now returns the same PNG
+            r = await client.get("/last")
+            assert r.status == 200 and (await r.read()) == body
+
+            # index now embeds a base64 preview
+            r = await client.get("/")
+            assert "data:image/png;base64," in await r.text()
+
+            # empty prompt → 400 (configmap.yaml:88-89)
+            r = await client.post("/generate", json={"prompt": "   "})
+            assert r.status == 400
+
+            # size not a multiple of the UNet factor → clean 400, not a 500
+            r = await client.post("/generate", json={
+                "prompt": "x", "steps": 2, "width": 100, "height": 100})
+            assert r.status == 400
+            assert "multiple" in (await r.json())["detail"]
+
+            # malformed body → 422
+            r = await client.post("/generate", json={"steps": 2})
+            assert r.status == 422
+
+            # determinism: same seed, same bytes
+            r1 = await client.post("/generate", json={
+                "prompt": "a panda", "steps": 2, "width": 64, "height": 64,
+                "seed": 7})
+            assert (await r1.read()) == body
+        finally:
+            await client.close()
+
+    _run(scenario())
+
+
+@pytest.mark.slow
+def test_e2e_subprocess_with_batch_generate_client(tmp_path):
+    """Full loop: real server process ← HTTP → the reference-parity client."""
+    port = 18231
+    env = {
+        "PATH": "/usr/bin:/bin",
+        "PYTHONPATH": REPO_ROOT,
+        "JAX_PLATFORMS": "cpu",
+        "SD15_PRESET": "tiny",
+        "SD15_WARMUP": "0",
+        "PORT": str(port),
+        "HOME": os.environ.get("HOME", "/root"),
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpustack.serving.sd_server"],
+        env=env, cwd=REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        import requests
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                out = proc.stdout.read()
+                pytest.fail(f"server died early:\n{out}")
+            try:
+                if requests.get(f"http://127.0.0.1:{port}/healthz",
+                                timeout=2).ok:
+                    break
+            except requests.ConnectionError:
+                time.sleep(1.0)
+        else:
+            pytest.fail("server never became healthy")
+
+        client = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts", "batch_generate.py"),
+             "a tiny panda", "2", "e2e", str(tmp_path),
+             "--steps", "2", "--width", "64", "--height", "64",
+             "--url", f"http://127.0.0.1:{port}/generate"],
+            capture_output=True, text=True, timeout=300,
+            env={"PATH": "/usr/bin:/bin", "PYTHONPATH": REPO_ROOT})
+        assert client.returncode == 0, client.stdout + client.stderr
+        assert "samples/sec" in client.stdout
+        for i in (1, 2):
+            png = tmp_path / f"e2e_{i:02d}.png"
+            assert png.exists()
+            assert png.read_bytes()[:8] == PNG_MAGIC
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,4 @@
+from tpustack.models.sd15.config import CLIPTextConfig, SD15Config, UNetConfig, VAEConfig
+from tpustack.models.sd15.pipeline import SD15Pipeline
+
+__all__ = ["CLIPTextConfig", "SD15Config", "UNetConfig", "VAEConfig", "SD15Pipeline"]

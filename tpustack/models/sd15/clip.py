@@ -1,0 +1,95 @@
+"""CLIP ViT-L/14 text encoder in Flax — the SD1.5 conditioning tower.
+
+The reference gets this prebuilt inside diffusers' StableDiffusionPipeline
+(reference ``cluster-config/apps/sd15-api/configmap.yaml:28,41``).  Here it is
+an explicit Flax module: token + learned position embeddings, ``num_layers``
+pre-LN transformer blocks with causal self-attention and quick-GELU MLPs, and
+a final LayerNorm.  SD1.5 conditions on the full ``last_hidden_state``
+(``[B, 77, 768]``), not the pooled output.
+
+Matmuls run in ``dtype`` (bf16 on TPU → MXU); params stay fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpustack.models.sd15.config import CLIPTextConfig
+from tpustack.ops.attention import dot_product_attention
+
+
+def _act(name: str):
+    if name == "quick_gelu":
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    if name == "gelu":
+        return nn.gelu
+    raise ValueError(f"unknown activation {name}")
+
+
+class CLIPAttention(nn.Module):
+    cfg: CLIPTextConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        dense = lambda name: nn.Dense(c.hidden_size, dtype=self.dtype, name=name)
+        q = dense("q_proj")(x)
+        k = dense("k_proj")(x)
+        v = dense("v_proj")(x)
+        split = lambda t: t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
+        out = dot_product_attention(split(q), split(k), split(v), causal=True)
+        out = out.reshape(x.shape[0], x.shape[1], c.hidden_size)
+        return dense("out_proj")(out)
+
+
+class CLIPMLP(nn.Module):
+    cfg: CLIPTextConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = self.cfg
+        x = nn.Dense(c.intermediate_size, dtype=self.dtype, name="fc1")(x)
+        x = _act(c.activation)(x)
+        return nn.Dense(c.hidden_size, dtype=self.dtype, name="fc2")(x)
+
+
+class CLIPEncoderLayer(nn.Module):
+    cfg: CLIPTextConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = self.cfg
+        ln = lambda name: nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=self.dtype, name=name)
+        x = x + CLIPAttention(c, self.dtype, name="self_attn")(ln("layer_norm1")(x))
+        x = x + CLIPMLP(c, self.dtype, name="mlp")(ln("layer_norm2")(x))
+        return x
+
+
+class CLIPTextEncoder(nn.Module):
+    """``input_ids [B, L] int32 → last_hidden_state [B, L, hidden]``."""
+
+    cfg: CLIPTextConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        c = self.cfg
+        tok = nn.Embed(c.vocab_size, c.hidden_size, dtype=self.dtype, name="token_embedding")
+        pos = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.01),
+            (c.max_length, c.hidden_size),
+        )
+        x = tok(input_ids) + pos[None, : input_ids.shape[1]].astype(self.dtype)
+        for i in range(c.num_layers):
+            x = CLIPEncoderLayer(c, self.dtype, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=self.dtype, name="final_layer_norm")(x)
+        return x

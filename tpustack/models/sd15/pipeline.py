@@ -1,0 +1,144 @@
+"""SD1.5 text→image pipeline, compiled end-to-end for TPU.
+
+TPU-first equivalent of diffusers' ``StableDiffusionPipeline.__call__`` as the
+reference drives it (``cluster-config/apps/sd15-api/configmap.yaml:103-112``,
+SURVEY.md §3.3: text encode → N× UNet denoise ← THE hot loop → VAE decode).
+
+Differences from the torch reference, all deliberate:
+
+- The **entire** generate path — CLIP encode, classifier-free-guidance denoise
+  loop (``lax.fori_loop``), VAE decode, uint8 conversion — is one ``jit``
+  program per (batch, steps, height, width) signature.  No host round-trips
+  between steps, no autocast context: compute is bf16 by construction.
+- CFG batches cond+uncond into a single UNet call (batch ``2B``) so the MXU
+  sees one large matmul stream instead of two small ones.
+- Seeding is ``jax.random.PRNGKey`` (reference: ``torch.Generator.manual_seed``,
+  configmap.yaml:91-92) — deterministic per (seed, shape).
+- Weights default to random init in the zero-egress dev environment; real
+  ``runwayml/stable-diffusion-v1-5`` safetensors load through
+  ``tpustack.models.sd15.weights.load_sd15_safetensors``.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpustack.models.sd15.clip import CLIPTextEncoder
+from tpustack.models.sd15.config import SD15Config
+from tpustack.models.sd15.scheduler import Schedule, ddim_step, make_schedule
+from tpustack.models.sd15.tokenizer import load_tokenizer
+from tpustack.models.sd15.unet import UNet2DCondition
+from tpustack.models.sd15.vae import VAEDecoder, VAEEncoder
+from tpustack.utils import get_logger
+
+log = get_logger("models.sd15.pipeline")
+
+
+class SD15Pipeline:
+    """Holds module defs + params and a cache of compiled generate programs."""
+
+    def __init__(self, config: Optional[SD15Config] = None,
+                 params: Optional[Dict[str, Any]] = None, seed: int = 0):
+        self.config = config or SD15Config.sd15()
+        dtype = self.config.compute_dtype
+        self.text_encoder = CLIPTextEncoder(self.config.text, dtype=dtype)
+        self.unet = UNet2DCondition(self.config.unet, dtype=dtype)
+        self.vae_decoder = VAEDecoder(self.config.vae, dtype=dtype)
+        self.vae_encoder = VAEEncoder(self.config.vae, dtype=dtype)
+        self.tokenizer = load_tokenizer(self.config.text.vocab_size,
+                                        self.config.text.max_length)
+        self.params = params if params is not None else self._random_init(seed)
+
+    # ---------------------------------------------------------------- init
+    def _random_init(self, seed: int) -> Dict[str, Any]:
+        """Random weights (zero-egress default); architecture/shape-exact."""
+        log.warning("Initialising SD1.5 with RANDOM weights (no checkpoint given)")
+        c = self.config
+        k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(seed), 4)
+        ids = jnp.zeros((1, c.text.max_length), jnp.int32)
+        text = jax.jit(self.text_encoder.init)(k1, ids)["params"]
+        ctx = jnp.zeros((1, c.text.max_length, c.unet.cross_attention_dim), jnp.float32)
+        zl = jnp.zeros((1, 8, 8, c.unet.in_channels), jnp.float32)
+        unet = jax.jit(self.unet.init)(k2, zl, jnp.zeros((1,), jnp.int32), ctx)["params"]
+        zv = jnp.zeros((1, 8, 8, c.vae.latent_channels), jnp.float32)
+        vae_d = jax.jit(self.vae_decoder.init)(k3, zv)["params"]
+        img = jnp.zeros((1, 8 * c.vae_scale, 8 * c.vae_scale, 3), jnp.float32)
+        vae_e = jax.jit(self.vae_encoder.init)(k4, img)["params"]
+        return {"text_encoder": text, "unet": unet, "vae_decoder": vae_d,
+                "vae_encoder": vae_e}
+
+    # ------------------------------------------------------------ compiled fn
+    @functools.partial(jax.jit, static_argnums=(0, 5))
+    def _generate(self, params, cond_ids, uncond_ids, noise, num_steps: int,
+                  guidance_scale):
+        """One fused program: encode → CFG denoise loop → decode → uint8."""
+        c = self.config
+        sched: Schedule = make_schedule(num_steps)
+
+        ids = jnp.concatenate([uncond_ids, cond_ids], axis=0)  # [2B, L]
+        context = self.text_encoder.apply({"params": params["text_encoder"]}, ids)
+
+        def body(i, x):
+            t = jnp.broadcast_to(sched.timesteps[i], (x.shape[0] * 2,))
+            eps = self.unet.apply(
+                {"params": params["unet"]},
+                jnp.concatenate([x, x], axis=0).astype(c.compute_dtype), t, context)
+            eps_uncond, eps_cond = jnp.split(eps.astype(jnp.float32), 2, axis=0)
+            eps = eps_uncond + guidance_scale * (eps_cond - eps_uncond)
+            return ddim_step(i, x, eps, sched)
+
+        x = noise * sched.init_noise_sigma
+        x = jax.lax.fori_loop(0, num_steps, body, x)
+
+        img = self.vae_decoder.apply(
+            {"params": params["vae_decoder"]}, x / c.vae.scaling_factor)
+        img = jnp.clip((img.astype(jnp.float32) + 1.0) * 127.5, 0.0, 255.0)
+        return jnp.round(img).astype(jnp.uint8)
+
+    # ---------------------------------------------------------------- public
+    def generate(
+        self,
+        prompt: str,
+        *,
+        steps: int = 30,
+        guidance_scale: float = 7.5,
+        seed: Optional[int] = None,
+        width: int = 512,
+        height: int = 512,
+        negative_prompt: str = "",
+        batch_size: int = 1,
+    ) -> Tuple[np.ndarray, float]:
+        """Returns (``[B, H, W, 3]`` uint8 images, wall latency seconds).
+
+        Matches the reference request schema {prompt, steps, guidance_scale,
+        seed, width, height} (configmap.yaml:52-58); negative_prompt and
+        batch_size are supersets.
+        """
+        c = self.config
+        # latents must survive the UNet's own down/up path cleanly
+        factor = c.vae_scale * 2 ** (len(c.unet.block_out_channels) - 1)
+        if width % factor or height % factor:
+            raise ValueError(f"width/height must be multiples of {factor}")
+        t0 = time.time()
+        cond = jnp.asarray(self.tokenizer([prompt] * batch_size))
+        uncond = jnp.asarray(self.tokenizer([negative_prompt] * batch_size))
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None else seed)
+        noise = jax.random.normal(
+            key, (batch_size, height // c.vae_scale, width // c.vae_scale,
+                  c.unet.in_channels), jnp.float32)
+        img = self._generate(self.params, cond, uncond, noise, int(steps),
+                             jnp.float32(guidance_scale))
+        img = np.asarray(img)
+        return img, time.time() - t0
+
+    def warmup(self, **kw) -> float:
+        """Compile the generate program for the given signature; returns seconds."""
+        t0 = time.time()
+        self.generate("warmup", seed=0, **kw)
+        return time.time() - t0

@@ -1,0 +1,108 @@
+"""Diffusion noise schedulers (DDIM, Euler discrete) as pure functions.
+
+The reference's scheduler lives inside diffusers' StableDiffusionPipeline
+(PNDM by default; the serving contract only exposes ``steps``, reference
+``cluster-config/apps/sd15-api/configmap.yaml:52-58,103-112``).  On TPU the
+scheduler must be *traceable*: every step consumes precomputed per-step
+constants gathered by index so the whole denoise loop compiles once into a
+``lax.fori_loop`` — no Python-side state machine, no per-step retrace.
+
+All schedules use SD's ``scaled_linear`` betas (0.00085 → 0.012, 1000 train
+steps).  ``make_schedule`` precomputes the per-step constant table; the
+``*_step`` functions are pure ``(i, x, eps, sched) → x`` maps.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NUM_TRAIN_TIMESTEPS = 1000
+BETA_START = 0.00085
+BETA_END = 0.012
+
+
+def alphas_cumprod(num_train_timesteps: int = NUM_TRAIN_TIMESTEPS) -> jax.Array:
+    betas = jnp.linspace(BETA_START ** 0.5, BETA_END ** 0.5, num_train_timesteps,
+                         dtype=jnp.float32) ** 2
+    return jnp.cumprod(1.0 - betas)
+
+
+class Schedule(NamedTuple):
+    """Per-inference-step constant table (all ``[num_steps]`` fp32)."""
+
+    timesteps: jax.Array        # train-timestep index fed to the UNet
+    alpha_t: jax.Array          # alphas_cumprod at t
+    alpha_prev: jax.Array       # alphas_cumprod at the next (less noisy) step
+    sigma_t: jax.Array          # Euler: sigma at t (incl. trailing 0)
+    sigma_next: jax.Array
+    init_noise_sigma: jax.Array  # scale for the initial latents
+
+
+def make_schedule(num_steps: int, num_train_timesteps: int = NUM_TRAIN_TIMESTEPS) -> Schedule:
+    """Leading-spaced timesteps (diffusers' default for SD1.5)."""
+    ac = alphas_cumprod(num_train_timesteps)
+    step = num_train_timesteps // num_steps
+    ts = (jnp.arange(num_steps) * step)[::-1]  # e.g. 970, 940, ..., 0 for 33 steps
+
+    alpha_t = ac[ts]
+    prev_ts = ts - step
+    alpha_prev = jnp.where(prev_ts >= 0, ac[jnp.maximum(prev_ts, 0)], jnp.float32(1.0))
+
+    sigmas = jnp.sqrt((1.0 - ac) / ac)
+    sigma_t = sigmas[ts]
+    sigma_next = jnp.concatenate([sigma_t[1:], jnp.zeros((1,), jnp.float32)])
+    return Schedule(
+        timesteps=ts.astype(jnp.int32),
+        alpha_t=alpha_t,
+        alpha_prev=alpha_prev,
+        sigma_t=sigma_t,
+        sigma_next=sigma_next,
+        init_noise_sigma=jnp.float32(1.0),
+    )
+
+
+def ddim_step(i: jax.Array, x: jax.Array, eps: jax.Array, sched: Schedule) -> jax.Array:
+    """Deterministic DDIM (eta=0) update, epsilon-prediction parameterisation."""
+    a_t = sched.alpha_t[i]
+    a_prev = sched.alpha_prev[i]
+    x = x.astype(jnp.float32)
+    eps = eps.astype(jnp.float32)
+    x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+    return jnp.sqrt(a_prev) * x0 + jnp.sqrt(1.0 - a_prev) * eps
+
+
+def euler_scale_model_input(i: jax.Array, x: jax.Array, sched: Schedule) -> jax.Array:
+    """Euler works in the sigma-space ODE; the UNet input must be rescaled."""
+    s = sched.sigma_t[i]
+    return x / jnp.sqrt(s * s + 1.0)
+
+
+def euler_step(i: jax.Array, x: jax.Array, eps: jax.Array, sched: Schedule) -> jax.Array:
+    """Euler discrete step in sigma space (x is the sigma-space latent)."""
+    s = sched.sigma_t[i]
+    s_next = sched.sigma_next[i]
+    x = x.astype(jnp.float32)
+    eps = eps.astype(jnp.float32)
+    # denoised sample estimate, then a straight-line ODE step toward s_next
+    d = eps  # for epsilon-pred, derivative dx/dsigma = eps
+    return x + (s_next - s) * d
+
+
+def euler_init_sigma(num_steps: int) -> jax.Array:
+    ac = alphas_cumprod()
+    step = NUM_TRAIN_TIMESTEPS // num_steps
+    t0 = (num_steps - 1) * step
+    sigmas = jnp.sqrt((1.0 - ac) / ac)
+    return jnp.sqrt(sigmas[t0] ** 2 + 1.0)
+
+
+def add_noise(x0: jax.Array, noise: jax.Array, t: jax.Array,
+              num_train_timesteps: int = NUM_TRAIN_TIMESTEPS) -> jax.Array:
+    """Forward q(x_t | x_0) — used by img2img and by diffusion training."""
+    ac = alphas_cumprod(num_train_timesteps)[t]
+    while ac.ndim < x0.ndim:
+        ac = ac[..., None]
+    return jnp.sqrt(ac) * x0 + jnp.sqrt(1.0 - ac) * noise

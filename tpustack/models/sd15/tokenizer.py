@@ -1,0 +1,88 @@
+"""Prompt tokenization for the SD1.5 text tower.
+
+The reference gets the CLIP BPE tokenizer from the HF hub at pod start
+(reference ``cluster-config/apps/sd15-api/deployment.yaml:49-50`` — the HF
+cache lives on the PVC).  In-cluster we do the same: if real tokenizer files
+are present (``SD15_TOKENIZER_DIR`` or the default HF cache), use transformers'
+``CLIPTokenizer``.  In the zero-egress dev/bench environment we fall back to a
+deterministic hash tokenizer: same shapes, same BOS/EOS framing, stable ids —
+enough for performance work and serving demos with random weights, clearly
+logged so nobody mistakes it for the real vocabulary.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+from typing import List, Sequence
+
+import numpy as np
+
+from tpustack.utils import get_logger
+
+log = get_logger("models.sd15.tokenizer")
+
+BOS_ID = 49406
+EOS_ID = 49407
+_WORD_RE = re.compile(r"[a-z0-9]+|[^\sa-z0-9]")
+
+
+class HashTokenizer:
+    """Deterministic word→id hashing with CLIP-style [BOS] ids [EOS] pad framing."""
+
+    def __init__(self, vocab_size: int, max_length: int):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        # keep ids clear of the BOS/EOS slots when the vocab is full-size
+        self.bos = min(BOS_ID, vocab_size - 2)
+        self.eos = min(EOS_ID, vocab_size - 1)
+
+    def _word_id(self, word: str) -> int:
+        h = int.from_bytes(hashlib.sha1(word.encode()).digest()[:4], "little")
+        return h % max(self.bos - 1, 1) + 1  # avoid 0 / BOS / EOS
+
+    def __call__(self, prompts: Sequence[str]) -> np.ndarray:
+        out = np.full((len(prompts), self.max_length), self.eos, dtype=np.int32)
+        for row, prompt in enumerate(prompts):
+            words = _WORD_RE.findall(prompt.lower())[: self.max_length - 2]
+            ids = [self.bos] + [self._word_id(w) for w in words] + [self.eos]
+            out[row, : len(ids)] = ids
+        return out
+
+
+class CLIPTokenizerWrapper:
+    """Real CLIP BPE via transformers, same call contract as HashTokenizer."""
+
+    def __init__(self, tokenizer, max_length: int):
+        self._tok = tokenizer
+        self.max_length = max_length
+
+    def __call__(self, prompts: Sequence[str]) -> np.ndarray:
+        enc = self._tok(
+            list(prompts),
+            padding="max_length",
+            truncation=True,
+            max_length=self.max_length,
+            return_tensors="np",
+        )
+        return enc["input_ids"].astype(np.int32)
+
+
+def load_tokenizer(vocab_size: int, max_length: int):
+    """Prefer real CLIP tokenizer files; fall back to the hash tokenizer."""
+    tok_dir = os.environ.get("SD15_TOKENIZER_DIR", "")
+    if tok_dir and os.path.isdir(tok_dir):
+        try:
+            from transformers import CLIPTokenizer
+
+            tok = CLIPTokenizer.from_pretrained(tok_dir)
+            log.info("Loaded CLIP tokenizer from %s", tok_dir)
+            return CLIPTokenizerWrapper(tok, max_length)
+        except Exception as e:  # corrupt/partial files → keep serving
+            log.warning("CLIP tokenizer load failed (%s); using hash tokenizer", e)
+    log.warning(
+        "No CLIP tokenizer files (SD15_TOKENIZER_DIR unset/missing); using "
+        "deterministic hash tokenizer — fine for perf/demo, not for real prompts"
+    )
+    return HashTokenizer(vocab_size, max_length)

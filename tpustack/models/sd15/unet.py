@@ -1,0 +1,212 @@
+"""SD1.5 UNet2DConditionModel in Flax, NHWC/TPU-first.
+
+The reference consumes this model prebuilt inside diffusers (reference
+``cluster-config/apps/sd15-api/configmap.yaml:28,41,103-112`` — the 30-step
+denoise loop is THE hot loop of the whole stack, SURVEY.md §3.3).  This
+re-implementation keeps diffusers' SD1.5 architecture (so HF weights map over)
+but is written for XLA:TPU:
+
+- **NHWC** feature layout — TPU convolutions tile channels onto the MXU lanes;
+  no NCHW transposes anywhere in the hot loop.
+- Spatial self/cross-attention runs through the shared BSHD attention op.
+- All shapes static; the full UNet traces once under ``jit`` and the step loop
+  lives in ``lax.fori_loop`` inside the pipeline (no per-step retrace).
+- Params fp32, compute dtype bf16 by default.
+
+Architecture (SD1.5): conv_in 4→320; down path (320,640,1280,1280)×2 resnets,
+cross-attn transformers on the first three levels, stride-2 conv downsamples;
+mid resnet–transformer–resnet; up path mirrors with 3 resnets per level and
+nearest-neighbor×2 + conv upsamples; GroupNorm(32)+SiLU+conv_out back to 4.
+Timesteps: sinusoidal(320) → 2-layer MLP → 1280, injected into every resnet.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpustack.models.sd15.config import UNetConfig
+from tpustack.ops.attention import dot_product_attention
+
+
+def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10_000.0) -> jax.Array:
+    """Sinusoidal timestep embedding ``[B] → [B, dim]`` (fp32)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class ResnetBlock(nn.Module):
+    out_channels: int
+    groups: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, temb: jax.Array) -> jax.Array:
+        gn = lambda name: nn.GroupNorm(num_groups=self.groups, dtype=self.dtype, name=name)
+        conv = lambda name: nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype, name=name)
+        h = nn.silu(gn("norm1")(x))
+        h = conv("conv1")(h)
+        t = nn.Dense(self.out_channels, dtype=self.dtype, name="time_emb_proj")(nn.silu(temb))
+        h = h + t[:, None, None, :]
+        h = nn.silu(gn("norm2")(h))
+        h = conv("conv2")(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype, name="conv_shortcut")(x)
+        return x + h
+
+
+class FeedForward(nn.Module):
+    """GEGLU feed-forward (diffusers' default for SD transformers)."""
+
+    dim: int
+    mult: int = 4
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        inner = self.dim * self.mult
+        gate = nn.Dense(inner * 2, dtype=self.dtype, name="proj_in")(x)
+        h, g = jnp.split(gate, 2, axis=-1)
+        return nn.Dense(self.dim, dtype=self.dtype, name="proj_out")(h * nn.gelu(g))
+
+
+class CrossAttention(nn.Module):
+    dim: int
+    heads: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
+        ctx = x if context is None else context
+        head_dim = self.dim // self.heads
+        q = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
+        v = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
+        split = lambda t: t.reshape(t.shape[0], t.shape[1], self.heads, head_dim)
+        out = dot_product_attention(split(q), split(k), split(v))
+        out = out.reshape(x.shape[0], x.shape[1], self.dim)
+        return nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    heads: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
+        ln = lambda name: nn.LayerNorm(dtype=self.dtype, name=name)
+        x = x + CrossAttention(self.dim, self.heads, self.dtype, name="attn1")(ln("norm1")(x))
+        x = x + CrossAttention(self.dim, self.heads, self.dtype, name="attn2")(ln("norm2")(x), context)
+        x = x + FeedForward(self.dim, dtype=self.dtype, name="ff")(ln("norm3")(x))
+        return x
+
+
+class Transformer2D(nn.Module):
+    """Spatial transformer: GN → 1x1 in → N blocks over HW tokens → 1x1 out, residual."""
+
+    heads: int
+    layers: int = 1
+    groups: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
+        b, h, w, c = x.shape
+        residual = x
+        x = nn.GroupNorm(num_groups=self.groups, dtype=self.dtype, name="norm")(x)
+        x = nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_in")(x)
+        x = x.reshape(b, h * w, c)
+        for i in range(self.layers):
+            x = TransformerBlock(c, self.heads, self.dtype, name=f"blocks_{i}")(x, context)
+        x = x.reshape(b, h, w, c)
+        x = nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_out")(x)
+        return x + residual
+
+
+class Downsample(nn.Module):
+    channels: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return nn.Conv(self.channels, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                       dtype=self.dtype, name="conv")(x)
+
+
+class Upsample(nn.Module):
+    channels: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, h, w, c = x.shape
+        x = jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+        return nn.Conv(self.channels, (3, 3), padding=1, dtype=self.dtype, name="conv")(x)
+
+
+class UNet2DCondition(nn.Module):
+    """``(latents [B,H,W,4], t [B], context [B,L,768]) → noise pred [B,H,W,4]``."""
+
+    cfg: UNetConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, t: jax.Array, context: jax.Array) -> jax.Array:
+        c = self.cfg
+        n_levels = len(c.block_out_channels)
+        heads = c.attention_head_dim
+        context = context.astype(self.dtype)
+
+        # --- time embedding ---
+        temb = timestep_embedding(t, c.block_out_channels[0])
+        time_dim = c.block_out_channels[0] * c.time_embed_dim_mult
+        temb = nn.Dense(time_dim, dtype=self.dtype, name="time_fc1")(temb.astype(self.dtype))
+        temb = nn.Dense(time_dim, dtype=self.dtype, name="time_fc2")(nn.silu(temb))
+
+        x = x.astype(self.dtype)
+        h = nn.Conv(c.block_out_channels[0], (3, 3), padding=1, dtype=self.dtype, name="conv_in")(x)
+        skips = [h]
+
+        # --- down path ---
+        for level, ch in enumerate(c.block_out_channels):
+            for blk in range(c.layers_per_block):
+                h = ResnetBlock(ch, c.norm_num_groups, self.dtype,
+                                name=f"down_{level}_res_{blk}")(h, temb)
+                if c.down_block_has_attn[level]:
+                    h = Transformer2D(heads, c.transformer_layers, c.norm_num_groups,
+                                      self.dtype, name=f"down_{level}_attn_{blk}")(h, context)
+                skips.append(h)
+            if level < n_levels - 1:
+                h = Downsample(ch, self.dtype, name=f"down_{level}_downsample")(h)
+                skips.append(h)
+
+        # --- mid ---
+        mid_ch = c.block_out_channels[-1]
+        h = ResnetBlock(mid_ch, c.norm_num_groups, self.dtype, name="mid_res_0")(h, temb)
+        h = Transformer2D(heads, c.transformer_layers, c.norm_num_groups,
+                          self.dtype, name="mid_attn")(h, context)
+        h = ResnetBlock(mid_ch, c.norm_num_groups, self.dtype, name="mid_res_1")(h, temb)
+
+        # --- up path ---
+        for i, ch in enumerate(reversed(c.block_out_channels)):
+            level = n_levels - 1 - i
+            for blk in range(c.layers_per_block + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = ResnetBlock(ch, c.norm_num_groups, self.dtype,
+                                name=f"up_{level}_res_{blk}")(h, temb)
+                if c.up_block_has_attn[i]:
+                    h = Transformer2D(heads, c.transformer_layers, c.norm_num_groups,
+                                      self.dtype, name=f"up_{level}_attn_{blk}")(h, context)
+            if level > 0:
+                h = Upsample(ch, self.dtype, name=f"up_{level}_upsample")(h)
+
+        h = nn.silu(nn.GroupNorm(num_groups=c.norm_num_groups, dtype=self.dtype, name="norm_out")(h))
+        h = nn.Conv(c.out_channels, (3, 3), padding=1, dtype=jnp.float32, name="conv_out")(h)
+        return h

@@ -1,0 +1,126 @@
+"""SD1.5 AutoencoderKL (f8, 4 latent channels) in Flax, NHWC/TPU-first.
+
+The reference's VAE arrives inside diffusers; its only in-repo knobs are
+``pipe.enable_vae_slicing()`` and the ``VAE_CPU`` offload flag (reference
+``cluster-config/apps/sd15-api/configmap.yaml:43-45``) — GPU-memory crutches a
+16 GB-HBM TPU chip doesn't need, so neither is replicated; XLA fuses the decode
+fine at 512×512.
+
+Decoder is the txt2img hot path (latents → pixels); the encoder is included
+for img2img parity.  Mid-block attention is single-head over HW tokens, as in
+the original architecture.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpustack.models.sd15.config import VAEConfig
+from tpustack.ops.attention import dot_product_attention
+
+
+class VAEResnetBlock(nn.Module):
+    out_channels: int
+    groups: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        gn = lambda name: nn.GroupNorm(num_groups=self.groups, dtype=self.dtype, name=name)
+        h = nn.silu(gn("norm1")(x))
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype, name="conv1")(h)
+        h = nn.silu(gn("norm2")(h))
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype, name="conv2")(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype, name="conv_shortcut")(x)
+        return x + h
+
+
+class VAEAttnBlock(nn.Module):
+    """Single-head self-attention over spatial tokens (mid block)."""
+
+    groups: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, h, w, c = x.shape
+        residual = x
+        x = nn.GroupNorm(num_groups=self.groups, dtype=self.dtype, name="norm")(x)
+        x = x.reshape(b, h * w, c)
+        q = nn.Dense(c, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(c, dtype=self.dtype, name="to_k")(x)
+        v = nn.Dense(c, dtype=self.dtype, name="to_v")(x)
+        out = dot_product_attention(q[:, :, None], k[:, :, None], v[:, :, None])
+        out = nn.Dense(c, dtype=self.dtype, name="to_out")(out[:, :, 0])
+        return residual + out.reshape(b, h, w, c)
+
+
+class VAEMidBlock(nn.Module):
+    channels: int
+    groups: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = VAEResnetBlock(self.channels, self.groups, self.dtype, name="res_0")(x)
+        x = VAEAttnBlock(self.groups, self.dtype, name="attn")(x)
+        return VAEResnetBlock(self.channels, self.groups, self.dtype, name="res_1")(x)
+
+
+class VAEDecoder(nn.Module):
+    """``latents [B,h,w,4] (already / scaling_factor) → images [B,8h,8w,3] in [-1,1]``."""
+
+    cfg: VAEConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        c = self.cfg
+        z = z.astype(self.dtype)
+        z = nn.Conv(c.latent_channels, (1, 1), dtype=self.dtype, name="post_quant_conv")(z)
+        top = c.block_out_channels[-1]
+        h = nn.Conv(top, (3, 3), padding=1, dtype=self.dtype, name="conv_in")(z)
+        h = VAEMidBlock(top, c.norm_num_groups, self.dtype, name="mid")(h)
+        # Up path: reversed channels, layers_per_block+1 resnets, upsample between.
+        rev = tuple(reversed(c.block_out_channels))
+        for i, ch in enumerate(rev):
+            for blk in range(c.layers_per_block + 1):
+                h = VAEResnetBlock(ch, c.norm_num_groups, self.dtype,
+                                   name=f"up_{i}_res_{blk}")(h)
+            if i < len(rev) - 1:
+                b, hh, ww, cc = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, cc), method="nearest")
+                h = nn.Conv(ch, (3, 3), padding=1, dtype=self.dtype, name=f"up_{i}_upsample")(h)
+        h = nn.silu(nn.GroupNorm(num_groups=c.norm_num_groups, dtype=self.dtype, name="norm_out")(h))
+        return nn.Conv(c.out_channels, (3, 3), padding=1, dtype=jnp.float32, name="conv_out")(h)
+
+
+class VAEEncoder(nn.Module):
+    """``images [B,H,W,3] in [-1,1] → (mean, logvar) each [B,H/8,W/8,4]``."""
+
+    cfg: VAEConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array):
+        c = self.cfg
+        x = x.astype(self.dtype)
+        h = nn.Conv(c.block_out_channels[0], (3, 3), padding=1, dtype=self.dtype, name="conv_in")(x)
+        for i, ch in enumerate(c.block_out_channels):
+            for blk in range(c.layers_per_block):
+                h = VAEResnetBlock(ch, c.norm_num_groups, self.dtype,
+                                   name=f"down_{i}_res_{blk}")(h)
+            if i < len(c.block_out_channels) - 1:
+                h = nn.Conv(ch, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                            dtype=self.dtype, name=f"down_{i}_downsample")(h)
+        h = VAEMidBlock(c.block_out_channels[-1], c.norm_num_groups, self.dtype, name="mid")(h)
+        h = nn.silu(nn.GroupNorm(num_groups=c.norm_num_groups, dtype=self.dtype, name="norm_out")(h))
+        h = nn.Conv(2 * c.latent_channels, (3, 3), padding=1, dtype=jnp.float32, name="conv_out")(h)
+        h = nn.Conv(2 * c.latent_channels, (1, 1), dtype=jnp.float32, name="quant_conv")(h)
+        mean, logvar = jnp.split(h, 2, axis=-1)
+        return mean, logvar

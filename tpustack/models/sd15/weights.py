@@ -1,0 +1,277 @@
+"""HF diffusers → tpustack weight conversion for SD1.5.
+
+The reference pulls ``runwayml/stable-diffusion-v1-5`` from the HF hub into a
+PVC cache at pod start (reference ``cluster-config/apps/sd15-api/
+deployment.yaml:49-50``).  The TPU build does the same, then maps the
+*diffusers-layout* safetensors into this package's param tree:
+
+- torch Conv2d ``[O, I, kh, kw]`` → flax NHWC kernel ``[kh, kw, I, O]``
+- torch Linear ``[O, I]``          → flax kernel ``[I, O]``
+- {Group,Layer}Norm weight/bias    → flax scale/bias
+
+The mapping is *driven by our param tree*: every leaf computes its expected HF
+key, so a missing/mis-shaped checkpoint fails loudly with the exact key list
+instead of silently initialising randomly.
+
+Expected directory layout (diffusers repo snapshot)::
+
+    <root>/text_encoder/model.safetensors
+    <root>/unet/diffusion_pytorch_model.safetensors
+    <root>/vae/diffusion_pytorch_model.safetensors
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpustack.models.sd15.config import SD15Config
+from tpustack.utils import get_logger
+from tpustack.utils.tree import flatten_dict as _flatten, unflatten_dict as _unflatten
+
+log = get_logger("models.sd15.weights")
+
+Array = Any
+Tree = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# layout transforms (torch → flax) and their inverses (used by tests)
+# --------------------------------------------------------------------------
+
+def conv_to_flax(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def conv_to_torch(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (3, 2, 0, 1))
+
+
+def linear_to_flax(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w)
+
+
+linear_to_torch = linear_to_flax
+
+
+# --------------------------------------------------------------------------
+# our-path → HF-key mapping
+# --------------------------------------------------------------------------
+
+def _unet_prefix(parts: Tuple[str, ...], n_levels: int) -> Tuple[str, ...]:
+    """Map our module path head to the diffusers module path head."""
+    head = parts[0]
+    m = re.fullmatch(r"down_(\d+)_res_(\d+)", head)
+    if m:
+        return (f"down_blocks.{m[1]}.resnets.{m[2]}",) + parts[1:]
+    m = re.fullmatch(r"down_(\d+)_attn_(\d+)", head)
+    if m:
+        return (f"down_blocks.{m[1]}.attentions.{m[2]}",) + parts[1:]
+    m = re.fullmatch(r"down_(\d+)_downsample", head)
+    if m:
+        return (f"down_blocks.{m[1]}.downsamplers.0",) + parts[1:]
+    m = re.fullmatch(r"up_(\d+)_res_(\d+)", head)
+    if m:  # our level L == HF up_blocks index (n_levels - 1 - L)
+        return (f"up_blocks.{n_levels - 1 - int(m[1])}.resnets.{m[2]}",) + parts[1:]
+    m = re.fullmatch(r"up_(\d+)_attn_(\d+)", head)
+    if m:
+        return (f"up_blocks.{n_levels - 1 - int(m[1])}.attentions.{m[2]}",) + parts[1:]
+    m = re.fullmatch(r"up_(\d+)_upsample", head)
+    if m:
+        return (f"up_blocks.{n_levels - 1 - int(m[1])}.upsamplers.0",) + parts[1:]
+    return {
+        "time_fc1": ("time_embedding.linear_1",) + parts[1:],
+        "time_fc2": ("time_embedding.linear_2",) + parts[1:],
+        "mid_res_0": ("mid_block.resnets.0",) + parts[1:],
+        "mid_res_1": ("mid_block.resnets.1",) + parts[1:],
+        "mid_attn": ("mid_block.attentions.0",) + parts[1:],
+        "conv_in": ("conv_in",) + parts[1:],
+        "conv_out": ("conv_out",) + parts[1:],
+        "norm_out": ("conv_norm_out",) + parts[1:],
+    }.get(head, parts)
+
+
+def _transformer_inner(parts: Tuple[str, ...]) -> Tuple[str, ...]:
+    """Inside a Transformer2D: blocks_k → transformer_blocks.k, ff/attn naming."""
+    out = []
+    i = 0
+    while i < len(parts):
+        p = parts[i]
+        m = re.fullmatch(r"blocks_(\d+)", p)
+        if m:
+            out.append(f"transformer_blocks.{m[1]}")
+        elif p == "ff":
+            nxt = parts[i + 1]
+            out.append("ff.net.0.proj" if nxt == "proj_in" else "ff.net.2")
+            i += 1  # consumed proj_in/proj_out
+        elif p == "to_out":
+            out.append("to_out.0")
+        else:
+            out.append(p)
+        i += 1
+    return tuple(out)
+
+
+_LEAF = {"kernel": "weight", "scale": "weight", "bias": "bias", "embedding": "weight"}
+
+
+def our_path_to_hf_key(parts: Tuple[str, ...], model: str, n_levels: int = 4) -> str:
+    """Translate a flax param path (tuple of names) to the diffusers key."""
+    parts = tuple(parts)
+    leaf = parts[-1]
+    body = parts[:-1]
+
+    if model == "unet":
+        body = _unet_prefix(body, n_levels)
+        body = _transformer_inner(body)
+    elif model == "text_encoder":
+        mapped = []
+        for p in body:
+            m = re.fullmatch(r"layers_(\d+)", p)
+            mapped.append(f"encoder.layers.{m[1]}" if m else p)
+        body = tuple(mapped)
+        if body and body[0] == "token_embedding":
+            body = ("embeddings",) + body
+        body = ("text_model",) + body
+    elif model in ("vae_decoder", "vae_encoder"):
+        role = "decoder" if model == "vae_decoder" else "encoder"
+        mapped = []
+        for p in body:
+            m = re.fullmatch(r"(up|down)_(\d+)_res_(\d+)", p)
+            if m:
+                mapped.append(f"{m[1]}_blocks.{m[2]}.resnets.{m[3]}")
+                continue
+            m = re.fullmatch(r"(up|down)_(\d+)_(upsample|downsample)", p)
+            if m:
+                kind = "upsamplers" if m[3] == "upsample" else "downsamplers"
+                mapped.append(f"{m[1]}_blocks.{m[2]}.{kind}.0.conv")
+                continue
+            mapped.append({
+                "mid": "mid_block",
+                "res_0": "resnets.0",
+                "res_1": "resnets.1",
+                "attn": "attentions.0",
+                "norm": "group_norm",
+                "to_out": "to_out.0",
+                "norm_out": "conv_norm_out",
+            }.get(p, p))
+        body = tuple(mapped)
+        # quant convs live at the AutoencoderKL top level, not under en/decoder
+        if body and body[0] in ("quant_conv", "post_quant_conv"):
+            return ".".join(body + (_LEAF[leaf],))
+        body = (role,) + body
+    else:
+        raise ValueError(f"unknown model {model}")
+
+    return ".".join(body + (_LEAF[leaf],))
+
+
+# Special case: our CLIP position_embedding is a raw param (no submodule).
+_CLIP_POS_KEY = "text_model.embeddings.position_embedding.weight"
+
+
+def _is_conv_kernel(arr_shape: Tuple[int, ...], leaf: str) -> bool:
+    return leaf == "kernel" and len(arr_shape) == 4
+
+
+def convert_state_dict(template: Tree, hf: Dict[str, np.ndarray], model: str,
+                       n_levels: int = 4, dtype=jnp.float32) -> Tree:
+    """Fill ``template``'s shapes from an HF diffusers state dict."""
+    flat = _flatten(template)
+    out: Dict[Tuple[str, ...], Array] = {}
+    missing, bad_shape = [], []
+    # Some diffusers VAE snapshots use the pre-0.18 attention names.
+    legacy_vae = {"to_q.weight": "query.weight", "to_q.bias": "query.bias",
+                  "to_k.weight": "key.weight", "to_k.bias": "key.bias",
+                  "to_v.weight": "value.weight", "to_v.bias": "value.bias",
+                  "to_out.0.weight": "proj_attn.weight", "to_out.0.bias": "proj_attn.bias"}
+    for path, tmpl in flat.items():
+        if model == "text_encoder" and path == ("position_embedding",):
+            key = _CLIP_POS_KEY
+        else:
+            key = our_path_to_hf_key(path, model, n_levels)
+        if key not in hf and model.startswith("vae"):
+            for new, old in legacy_vae.items():
+                if key.endswith(new):
+                    alt = key[: -len(new)] + old
+                    if alt in hf:
+                        key = alt
+                    break
+        if key not in hf:
+            missing.append(key)
+            continue
+        w = np.asarray(hf[key])
+        leaf = path[-1]
+        if _is_conv_kernel(tmpl.shape, leaf):
+            w = conv_to_flax(w)
+        elif leaf == "kernel":
+            w = linear_to_flax(w)
+        if w.shape != tmpl.shape:
+            bad_shape.append((key, w.shape, tmpl.shape))
+            continue
+        out[path] = jnp.asarray(w, dtype)
+    if missing or bad_shape:
+        raise ValueError(
+            f"{model}: {len(missing)} missing keys, {len(bad_shape)} shape "
+            f"mismatches.\nmissing (first 10): {missing[:10]}\n"
+            f"bad shapes (first 10): {bad_shape[:10]}"
+        )
+    return _unflatten(out)
+
+
+def load_sd15_safetensors(root: str, config: SD15Config, template_params: Tree) -> Tree:
+    """Load a diffusers SD1.5 snapshot directory into our param tree."""
+    from safetensors.numpy import load_file
+
+    files = {
+        "text_encoder": os.path.join(root, "text_encoder", "model.safetensors"),
+        "unet": os.path.join(root, "unet", "diffusion_pytorch_model.safetensors"),
+        "vae": os.path.join(root, "vae", "diffusion_pytorch_model.safetensors"),
+    }
+    for name, path in files.items():
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"{name} weights not found at {path}")
+    n_levels = len(config.unet.block_out_channels)
+    text_sd = load_file(files["text_encoder"])
+    # strip transformers' "text_model." prefix handling: keys already include it
+    unet_sd = load_file(files["unet"])
+    vae_sd = load_file(files["vae"])
+    params = {
+        "text_encoder": convert_state_dict(template_params["text_encoder"], text_sd,
+                                           "text_encoder"),
+        "unet": convert_state_dict(template_params["unet"], unet_sd, "unet", n_levels),
+        "vae_decoder": convert_state_dict(template_params["vae_decoder"], vae_sd,
+                                          "vae_decoder"),
+    }
+    if "vae_encoder" in template_params:
+        params["vae_encoder"] = convert_state_dict(
+            template_params["vae_encoder"], vae_sd, "vae_encoder")
+    log.info("Loaded SD1.5 weights from %s", root)
+    return params
+
+
+def make_fake_hf_state_dict(template: Tree, model: str, n_levels: int = 4,
+                            seed: int = 0) -> Dict[str, np.ndarray]:
+    """Inverse mapping: build an HF-layout random state dict matching our tree.
+
+    Test-only helper — lets the converter round-trip be verified offline
+    without the real (zero-egress-unreachable) checkpoint.
+    """
+    rng = np.random.RandomState(seed)
+    out: Dict[str, np.ndarray] = {}
+    for path, tmpl in _flatten(template).items():
+        if model == "text_encoder" and path == ("position_embedding",):
+            key = _CLIP_POS_KEY
+        else:
+            key = our_path_to_hf_key(path, model, n_levels)
+        w = rng.randn(*tmpl.shape).astype(np.float32) * 0.02
+        if _is_conv_kernel(tmpl.shape, path[-1]):
+            w = conv_to_torch(w)
+        elif path[-1] == "kernel":
+            w = linear_to_torch(w)
+        out[key] = w
+    return out

@@ -1,0 +1,172 @@
+"""SD1.5 REST API server — TPU-native port of the reference sd15-api app.
+
+Byte-compatible with the reference's FastAPI app (reference
+``cluster-config/apps/sd15-api/configmap.yaml:16-121``) so
+``scripts/batch_generate.py`` works unchanged:
+
+- ``GET /healthz``  → ``{"ok": true}``                (configmap.yaml:60-62)
+- ``GET /``         → HTML preview of the last image  (configmap.yaml:64-78)
+- ``GET /last``     → last PNG or 404                 (configmap.yaml:80-84)
+- ``POST /generate``→ PNG + ``X-Gen-Time: <sec>s``    (configmap.yaml:86-121)
+  body {prompt, steps=30, guidance_scale=7.5, seed, width=512, height=512};
+  400 on missing/empty prompt.
+
+Implementation differences, all TPU-motivated: aiohttp instead of
+FastAPI/uvicorn (no ASGI dependency in the base image), the model is this
+package's jitted JAX pipeline instead of torch/diffusers, and there is no
+autocast/attention-slicing/VAE-offload — bf16 and 16 GB HBM make them moot
+(cf. configmap.yaml:42-45).  Generation is serialised with a lock like the
+reference's ``_LAST_LOCK`` (configmap.yaml:38-39) — one chip, one queue.
+
+Env flags (mirroring the reference's env contract, deployment.yaml:43-53):
+``MODEL_DIR`` (diffusers safetensors snapshot; random weights if unset),
+``SD15_PRESET`` (``sd15``|``tiny``), ``PORT``, ``SD15_TOKENIZER_DIR``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import os
+import time
+from typing import Optional
+
+from aiohttp import web
+from pydantic import BaseModel, ValidationError
+
+from tpustack.utils import get_logger
+from tpustack.utils.image import array_to_png
+
+log = get_logger("serving.sd_server")
+
+
+class GenReq(BaseModel):
+    """Request schema — field-for-field the reference's GenReq
+    (configmap.yaml:52-58), plus negative_prompt as a superset."""
+
+    prompt: str
+    steps: Optional[int] = 30
+    guidance_scale: Optional[float] = 7.5
+    seed: Optional[int] = None
+    width: Optional[int] = 512
+    height: Optional[int] = 512
+    negative_prompt: Optional[str] = ""
+
+
+class SDServer:
+    def __init__(self, pipeline=None):
+        if pipeline is None:
+            pipeline = self._pipeline_from_env()
+        self.pipe = pipeline
+        self._last_image: Optional[bytes] = None
+        self._lock = asyncio.Lock()
+
+    @staticmethod
+    def _pipeline_from_env():
+        from tpustack.models.sd15 import SD15Config, SD15Pipeline
+
+        preset = os.environ.get("SD15_PRESET", "sd15")
+        cfg = SD15Config.tiny() if preset == "tiny" else SD15Config.sd15()
+        pipe = SD15Pipeline(cfg)
+        model_dir = os.environ.get("MODEL_DIR", "")
+        if model_dir:
+            from tpustack.models.sd15.weights import load_sd15_safetensors
+
+            pipe.params = load_sd15_safetensors(model_dir, cfg, pipe.params)
+            log.info("Loaded weights from %s", model_dir)
+        return pipe
+
+    # ------------------------------------------------------------ handlers
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def index(self, request: web.Request) -> web.Response:
+        if self._last_image is None:
+            return web.Response(
+                text="<h1>SD1.5 TPU API</h1><p>No image generated yet. "
+                     "POST /generate to create one.</p>",
+                content_type="text/html")
+        preview = base64.b64encode(self._last_image).decode("ascii")
+        html = f"""
+        <html>
+          <head><title>SD1.5 TPU Demo</title></head>
+          <body style="background:#0b0b0f;color:#f0f0f0;font-family:sans-serif;">
+            <h1>Latest image</h1>
+            <img src="data:image/png;base64,{preview}" alt="latest image"
+                 style="max-width:90vw;height:auto;border:3px solid #333;border-radius:8px;" />
+            <p>POST <code>/generate</code> with a prompt to update this preview.</p>
+          </body>
+        </html>
+        """
+        return web.Response(text=html, content_type="text/html")
+
+    async def last(self, request: web.Request) -> web.Response:
+        if self._last_image is None:
+            return web.json_response({"detail": "No image generated yet"}, status=404)
+        return web.Response(body=self._last_image, content_type="image/png")
+
+    async def generate(self, request: web.Request) -> web.Response:
+        try:
+            req = GenReq.model_validate(await request.json())
+        except (ValidationError, ValueError) as e:
+            return web.json_response({"detail": str(e)}, status=422)
+        if not req.prompt or not req.prompt.strip():
+            return web.json_response({"detail": "prompt is required"}, status=400)
+
+        # explicit None checks — 0.0 guidance (CFG off) is a legitimate value
+        steps = 30 if req.steps is None else req.steps
+        guidance = 7.5 if req.guidance_scale is None else req.guidance_scale
+        width = 512 if req.width is None else req.width
+        height = 512 if req.height is None else req.height
+
+        t0 = time.time()
+        log.info(
+            "Generating prompt='%s' steps=%s guidance=%.2f seed=%s size=%sx%s",
+            req.prompt, steps, guidance,
+            req.seed if req.seed is not None else "auto", width, height)
+
+        try:
+            async with self._lock:  # one chip — serialise like the reference
+                imgs, _ = await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    lambda: self.pipe.generate(
+                        req.prompt,
+                        steps=steps,
+                        guidance_scale=guidance,
+                        seed=req.seed,
+                        width=width,
+                        height=height,
+                        negative_prompt=req.negative_prompt or ""))
+        except ValueError as e:  # e.g. size not a multiple of the UNet factor
+            return web.json_response({"detail": str(e)}, status=400)
+        png = array_to_png(imgs[0])
+        latency = time.time() - t0
+        log.info("Completed generation in %.2fs", latency)
+        self._last_image = png
+        return web.Response(body=png, content_type="image/png",
+                            headers={"X-Gen-Time": f"{latency:.2f}s"})
+
+    # ---------------------------------------------------------------- app
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 20)
+        app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/", self.index)
+        app.router.add_get("/last", self.last)
+        app.router.add_post("/generate", self.generate)
+        return app
+
+
+def main() -> None:
+    port = int(os.environ.get("PORT", "8000"))
+    server = SDServer()
+    if os.environ.get("SD15_WARMUP", "1") not in ("0", "false"):
+        tiny = os.environ.get("SD15_PRESET", "sd15") == "tiny"
+        kw = dict(steps=2, width=64, height=64) if tiny else {}
+        log.info("Warming up (compiling %s signature)...", kw or "default 512x512x30")
+        secs = server.pipe.warmup(**kw)
+        log.info("Warmup done in %.1fs", secs)
+    web.run_app(server.build_app(), port=port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Shared nested-dict param-tree helpers (used by the weight converters and
+the sharding rule matcher — one traversal implementation, three call sites)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Tuple
+
+Tree = Dict[str, Any]
+Path = Tuple[str, ...]
+
+
+def iter_flat(tree: Tree, prefix: Path = ()) -> Iterator[Tuple[Path, Any]]:
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            yield from iter_flat(v, prefix + (k,))
+        else:
+            yield prefix + (k,), v
+
+
+def flatten_dict(tree: Tree) -> Dict[Path, Any]:
+    return dict(iter_flat(tree))
+
+
+def unflatten_dict(flat: Dict[Path, Any]) -> Tree:
+    tree: Tree = {}
+    for path, v in flat.items():
+        node = tree
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = v
+    return tree
+
+
+def flat_paths(tree: Tree, sep: str = "/") -> List[Tuple[str, Any]]:
+    return [(sep.join(path), leaf) for path, leaf in iter_flat(tree)]
