@@ -1,0 +1,149 @@
+"""LLM generation engine + server + weight converter tests (tiny, CPU)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpustack.models.llama import LlamaConfig, LlamaModel
+from tpustack.models.llama_weights import (
+    convert_llama_state_dict,
+    make_fake_hf_llama_state_dict,
+    our_path_to_hf_key,
+)
+from tpustack.models.llm_generate import Generator, SampleConfig
+from tpustack.models.text_tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def gen():
+    return Generator(LlamaConfig.tiny(max_seq=64), dtype=jnp.float32)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer(512)
+    ids = tok.encode("hello, TPU! ünïcødé")
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "hello, TPU! ünïcødé"
+
+
+def test_llama_key_mapping():
+    assert (our_path_to_hf_key(("layers_0", "self_attn", "q_proj", "kernel"))
+            == "model.layers.0.self_attn.q_proj.weight")
+    assert our_path_to_hf_key(("embed_tokens", "embedding")) == "model.embed_tokens.weight"
+    assert our_path_to_hf_key(("norm", "scale")) == "model.norm.weight"
+    assert our_path_to_hf_key(("lm_head", "kernel")) == "lm_head.weight"
+    assert (our_path_to_hf_key(("layers_1", "input_layernorm", "scale"))
+            == "model.layers.1.input_layernorm.weight")
+
+
+def test_llama_weights_roundtrip():
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg, dtype=jnp.float32)
+    tmpl = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    hf = make_fake_hf_llama_state_dict(tmpl)
+    ours = convert_llama_state_dict(tmpl, hf, dtype=jnp.float32)
+    a = jax.tree_util.tree_leaves(tmpl)
+    b = jax.tree_util.tree_leaves(ours)
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.shape == y.shape
+    # value check: q_proj kernel is the transpose of the HF tensor
+    np.testing.assert_array_equal(
+        np.asarray(ours["layers_0"]["self_attn"]["q_proj"]["kernel"]),
+        hf["model.layers.0.self_attn.q_proj.weight"].T)
+
+
+def test_generate_greedy_deterministic(gen):
+    ids = [1] + [10, 20, 30]
+    out1, stats = gen.generate(ids, max_new_tokens=8,
+                               sample=SampleConfig(greedy=True))
+    out2, _ = gen.generate(ids, max_new_tokens=8, sample=SampleConfig(greedy=True))
+    assert out1 == out2
+    assert len(out1) == 8
+    assert stats["generated_tokens"] == 8
+    assert stats["tokens_per_s"] > 0
+
+
+def test_generate_seeded_sampling_deterministic(gen):
+    ids = [1, 5, 6]
+    out1, _ = gen.generate(ids, max_new_tokens=6, seed=7)
+    out2, _ = gen.generate(ids, max_new_tokens=6, seed=7)
+    out3, _ = gen.generate(ids, max_new_tokens=6, seed=8)
+    assert out1 == out2
+    assert out1 != out3 or True  # different seed usually differs; no hard guarantee
+
+
+def test_generate_matches_full_forward_greedy(gen):
+    """KV-cache decode must agree with running the full sequence each step."""
+    cfg = gen.cfg
+    model = gen.model
+    ids = [1, 40, 41, 42]
+    out, _ = gen.generate(ids, max_new_tokens=4, sample=SampleConfig(greedy=True))
+    seq = list(ids)
+    for _ in range(4):
+        logits, _ = model.apply({"params": gen.params},
+                                jnp.asarray([seq], jnp.int32))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        seq.append(nxt)
+    assert out == seq[len(ids):]
+
+
+def test_generate_respects_ctx_limit(gen):
+    ids = list(range(1, 60))
+    out, stats = gen.generate(ids, max_new_tokens=100)
+    assert stats["prompt_tokens"] + len(out) <= gen.cfg.max_seq
+
+
+def test_llm_server_endpoints(gen):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.serving.llm_server import LLMServer
+
+    server = LLMServer(generator=gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test")
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.get("/health")
+            assert r.status == 200 and (await r.json()) == {"status": "ok"}
+
+            r = await client.get("/props")
+            j = await r.json()
+            assert j["n_ctx"] == 64 and j["backend"] == "jax/tpu"
+
+            r = await client.post("/tokenize", json={"content": "hi"})
+            toks = (await r.json())["tokens"]
+            r = await client.post("/detokenize", json={"tokens": toks})
+            assert (await r.json())["content"] == "hi"
+
+            r = await client.post("/completion", json={
+                "prompt": "hello", "n_predict": 4, "seed": 3})
+            j = await r.json()
+            assert r.status == 200
+            assert j["model"] == "tiny-test" and j["stop"] is True
+            assert j["tokens_predicted"] <= 4
+            assert "predicted_per_second" in j["timings"]
+
+            r = await client.post("/completion", json={"prompt": ""})
+            assert r.status == 400
+
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hey"}],
+                "max_tokens": 4, "seed": 1})
+            j = await r.json()
+            assert r.status == 200
+            assert j["object"] == "chat.completion"
+            assert j["choices"][0]["finish_reason"] in ("stop", "length")
+            assert j["usage"]["completion_tokens"] <= 4
+
+            r = await client.post("/v1/chat/completions", json={"messages": []})
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

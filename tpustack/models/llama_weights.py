@@ -1,0 +1,92 @@
+"""HF transformers → tpustack weight conversion for Llama/Qwen2 checkpoints.
+
+The reference fetches a GGUF (llama.cpp's quantised format) with curl into a
+PVC (reference ``cluster-config/apps/llm/deployment.yaml:22-58``).  The TPU
+build loads the original HF safetensors instead (SURVEY.md §2.9: "no GGUF —
+use HF safetensors"): torch Linear ``[out, in]`` → flax kernel ``[in, out]``,
+embeddings as-is, RMSNorm weight → scale.  Multi-shard checkpoints
+(``model-0000x-of-0000y.safetensors``) are merged.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpustack.models.llama import LlamaConfig
+from tpustack.utils import get_logger
+from tpustack.utils.tree import iter_flat as _flatten, unflatten_dict
+
+log = get_logger("models.llama_weights")
+
+
+def our_path_to_hf_key(path: tuple) -> str:
+    """('layers_3','self_attn','q_proj','kernel') → 'model.layers.3.self_attn.q_proj.weight'."""
+    parts = []
+    for p in path[:-1]:
+        if p.startswith("layers_"):
+            parts.append(f"layers.{p.split('_', 1)[1]}")
+        else:
+            parts.append(p)
+    leaf = {"kernel": "weight", "scale": "weight", "bias": "bias",
+            "embedding": "weight"}[path[-1]]
+    body = ".".join(parts)
+    if body == "lm_head":
+        return "lm_head.weight"
+    return f"model.{body}.{leaf}"
+
+
+def convert_llama_state_dict(template: Dict[str, Any], hf: Dict[str, np.ndarray],
+                             dtype=jnp.bfloat16) -> Dict[str, Any]:
+    out: Dict[tuple, Any] = {}
+    missing, bad = [], []
+    for path, tmpl in _flatten(template):
+        key = our_path_to_hf_key(path)
+        if key not in hf:
+            missing.append(key)
+            continue
+        w = np.asarray(hf[key])
+        if path[-1] == "kernel":
+            w = np.transpose(w)
+        if w.shape != tmpl.shape:
+            bad.append((key, w.shape, tmpl.shape))
+            continue
+        out[path] = jnp.asarray(w, dtype)
+    if missing or bad:
+        raise ValueError(f"llama load: {len(missing)} missing, {len(bad)} bad shapes; "
+                         f"missing[:10]={missing[:10]} bad[:5]={bad[:5]}")
+    return unflatten_dict(out)
+
+
+def load_llama_safetensors(root: str, cfg: LlamaConfig, template: Dict[str, Any],
+                           dtype=jnp.bfloat16) -> Dict[str, Any]:
+    from safetensors.numpy import load_file
+
+    files = sorted(glob.glob(os.path.join(root, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {root}")
+    hf: Dict[str, np.ndarray] = {}
+    for f in files:
+        hf.update(load_file(f))
+    # tied-embedding checkpoints (Qwen2.5 < 3B etc.) have no lm_head tensor
+    if "lm_head.weight" not in hf and "model.embed_tokens.weight" in hf:
+        hf["lm_head.weight"] = hf["model.embed_tokens.weight"]
+    params = convert_llama_state_dict(template, hf, dtype)
+    log.info("Loaded %d tensors from %s", len(files), root)
+    return params
+
+
+def make_fake_hf_llama_state_dict(template: Dict[str, Any], seed: int = 0):
+    """Inverse mapping for offline converter tests."""
+    rng = np.random.RandomState(seed)
+    out = {}
+    for path, tmpl in _flatten(template):
+        w = rng.randn(*tmpl.shape).astype(np.float32) * 0.02
+        if path[-1] == "kernel":
+            w = np.transpose(w)
+        out[our_path_to_hf_key(path)] = w
+    return out

@@ -1,0 +1,191 @@
+"""Autoregressive generation engine (prefill + KV-cache decode) for Llama/Qwen.
+
+TPU-native replacement for the llama.cpp server's generate loop (reference
+``cluster-config/apps/llm/deployment.yaml:61-84``: Qwen2.5-7B GGUF,
+``--ctx-size 4096 --n-gpu-layers 35``).  Design for XLA:
+
+- **Prefill** pads the prompt to a power-of-two bucket and runs one batched
+  pass (MXU-bound); each bucket compiles once.
+- **Decode** is a single static-shape token step against a ``max_seq`` KV
+  cache (``lax.dynamic_update_slice``), compiled once, with donated caches so
+  XLA updates them in place in HBM.
+- **Sampling** (greedy / temperature / top-k) happens inside the jitted step
+  with a threaded PRNG key — no host round-trip per token.
+
+No quantisation or CPU layer offload: bf16 on a 16 GB-HBM chip holds 7B whole
+(the reference's ``--n-gpu-layers 35`` split was a 6 GB-VRAM workaround).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpustack.models.llama import LlamaConfig, LlamaModel, init_kv_caches
+from tpustack.utils import get_logger
+
+log = get_logger("models.llm_generate")
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleConfig:
+    temperature: float = 0.8
+    top_k: int = 40
+    greedy: bool = False
+
+
+class Generator:
+    """Holds params + compiled prefill/decode programs."""
+
+    def __init__(self, config: LlamaConfig, params: Optional[Dict] = None,
+                 dtype=jnp.bfloat16, seed: int = 0):
+        self.cfg = config
+        self.model = LlamaModel(config, dtype=dtype)
+        self.cache_dtype = dtype
+        if params is None:
+            log.warning("Initialising %s-layer LLM with RANDOM weights", config.n_layers)
+            tokens = jnp.zeros((1, 8), jnp.int32)
+            params = jax.jit(self.model.init)(jax.random.PRNGKey(seed), tokens)["params"]
+        self.params = params
+
+    @classmethod
+    def from_checkpoint(cls, config: LlamaConfig, model_dir: str,
+                        dtype=jnp.bfloat16) -> "Generator":
+        """Load HF safetensors without materialising a random template first
+        (jax.eval_shape gives the converter shapes at zero device cost)."""
+        from tpustack.models.llama_weights import load_llama_safetensors
+
+        model = LlamaModel(config, dtype=dtype)
+        tmpl = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32)))["params"]
+        params = load_llama_safetensors(model_dir, config, tmpl, dtype=dtype)
+        return cls(config, params=params, dtype=dtype)
+
+    # -------------------------------------------------------------- compiled
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _prefill(self, params, tokens, length, caches):
+        """tokens [1, P] padded; valid prefix ``length``. Returns (logits_at_last, caches)."""
+        b, p = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(p), (b, p))
+        # rows: query positions; cols: cache slots. Causal + only valid prefix.
+        q_pos = jnp.arange(p)[None, None, :, None]
+        k_pos = jnp.arange(self.cfg.max_seq)[None, None, None, :]
+        mask = (k_pos <= q_pos) & (q_pos < length) & (k_pos < length)
+        logits, caches = self.model.apply(
+            {"params": params}, tokens, positions, caches, 0, mask)
+        last = jnp.take_along_axis(
+            logits, (length - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return last, caches
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(4,))
+    def _decode_step(self, params, token, index, caches, key, temperature,
+                     top_k, greedy):
+        """One token in → caches updated in place → next token out."""
+        b = token.shape[0]
+        positions = jnp.broadcast_to(index, (b, 1))
+        mask = (jnp.arange(self.cfg.max_seq)[None, None, None, :] <= index)
+        logits, caches = self.model.apply(
+            {"params": params}, token, positions, caches, index, mask)
+        logits = logits[:, -1].astype(jnp.float32)
+
+        def sample(logits):
+            scaled = logits / jnp.maximum(temperature, 1e-4)
+            # top-k with a traced k: take a static top-64 slate (descending),
+            # threshold at the clamp(top_k)-th value; top_k<=0 disables.
+            slate = min(64, self.cfg.vocab_size)
+            topv = jax.lax.top_k(scaled, k=slate)[0]  # [B, slate] descending
+            idx = jnp.clip(top_k - 1, 0, slate - 1)
+            kth = jnp.take_along_axis(topv, jnp.broadcast_to(idx, (topv.shape[0], 1)), axis=1)
+            thresh = jnp.where(top_k > 0, kth, -jnp.inf)
+            scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+            return jax.random.categorical(key, scaled, axis=-1)
+
+        next_greedy = jnp.argmax(logits, axis=-1)
+        next_sampled = sample(logits)
+        next_tok = jnp.where(greedy, next_greedy, next_sampled)
+        return next_tok.astype(jnp.int32), caches
+
+    # ---------------------------------------------------------------- public
+    def _bucket(self, n: int) -> int:
+        p = 16
+        while p < n:
+            p *= 2
+        return min(p, self.cfg.max_seq)
+
+    def generate(
+        self,
+        prompt_tokens: List[int],
+        max_new_tokens: int = 128,
+        sample: SampleConfig = SampleConfig(),
+        seed: Optional[int] = None,
+        stop_tokens: Tuple[int, ...] = (),
+    ) -> Tuple[List[int], Dict[str, float]]:
+        """Returns (generated token ids, timing stats)."""
+        c = self.cfg
+        n_prompt = len(prompt_tokens)
+        if n_prompt == 0:
+            raise ValueError("empty prompt")
+        if n_prompt + max_new_tokens > c.max_seq:
+            max_new_tokens = c.max_seq - n_prompt
+            if max_new_tokens <= 0:
+                raise ValueError(f"prompt ({n_prompt}) exceeds ctx {c.max_seq}")
+
+        t0 = time.time()
+        bucket = self._bucket(n_prompt)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n_prompt] = prompt_tokens
+        caches = init_kv_caches(c, 1, dtype=self.cache_dtype)
+        length = jnp.asarray([n_prompt], jnp.int32)
+        logits, caches = self._prefill(self.params, jnp.asarray(tokens), length, caches)
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None else seed)
+
+        # first sampled token comes from prefill logits: reuse decode's sampling
+        # by treating it as a temperature/top-k draw on the host side once.
+        t_prefill = time.time() - t0
+        t0 = time.time()
+
+        out: List[int] = []
+        next_tok = self._sample_host(logits, sample, key)
+        key = jax.random.fold_in(key, 0)
+        for i in range(max_new_tokens):
+            tok = int(next_tok)
+            out.append(tok)
+            if tok in stop_tokens:
+                break
+            step_key, key = jax.random.split(key)
+            next_tok_arr, caches = self._decode_step(
+                self.params, jnp.asarray([[tok]], jnp.int32),
+                jnp.asarray(n_prompt + i, jnp.int32), caches, step_key,
+                jnp.float32(sample.temperature), jnp.int32(sample.top_k),
+                jnp.bool_(sample.greedy))
+            next_tok = np.asarray(next_tok_arr)[0]
+        t_decode = time.time() - t0
+        n_gen = len(out)
+        return out, {
+            "prompt_tokens": n_prompt,
+            "generated_tokens": n_gen,
+            "prefill_s": t_prefill,
+            "decode_s": t_decode,
+            "tokens_per_s": n_gen / t_decode if t_decode > 0 and n_gen else 0.0,
+        }
+
+    @staticmethod
+    def _sample_host(logits, sample: SampleConfig, key) -> int:
+        logits = np.asarray(logits, np.float32)[0]
+        if sample.greedy:
+            return int(np.argmax(logits))
+        scaled = logits / max(sample.temperature, 1e-4)
+        if sample.top_k > 0 and sample.top_k < scaled.shape[-1]:
+            kth = np.partition(scaled, -sample.top_k)[-sample.top_k]
+            scaled = np.where(scaled >= kth, scaled, -np.inf)
+        probs = np.exp(scaled - scaled.max())
+        probs /= probs.sum()
+        rng = np.random.RandomState(int(jax.random.randint(key, (), 0, 2**31 - 1)))
+        return int(rng.choice(len(probs), p=probs))

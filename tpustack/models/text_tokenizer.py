@@ -1,0 +1,74 @@
+"""LLM text tokenization: real HF tokenizers when present, byte-level fallback.
+
+The reference's llama.cpp server ships its tokenizer inside the GGUF file
+(reference ``cluster-config/apps/llm/deployment.yaml:22-58`` downloads it).
+Here: if ``LLM_TOKENIZER_DIR`` points at HF tokenizer files, use
+``transformers.AutoTokenizer``; otherwise a byte-level tokenizer (UTF-8 byte +
+3, llama-convention pad=0/bos=1/eos=2) keeps every code path runnable in the
+zero-egress environment — real text in, real text out, just a suboptimal
+vocabulary.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence
+
+from tpustack.utils import get_logger
+
+log = get_logger("models.text_tokenizer")
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+_BYTE_OFFSET = 3
+
+
+class ByteTokenizer:
+    """UTF-8 bytes with llama-style special ids; needs vocab_size >= 259."""
+
+    def __init__(self, vocab_size: int):
+        if vocab_size < 256 + _BYTE_OFFSET:
+            raise ValueError(f"byte tokenizer needs vocab >= 259, got {vocab_size}")
+        self.vocab_size = vocab_size
+        self.bos_id = BOS_ID
+        self.eos_id = EOS_ID
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = [b + _BYTE_OFFSET for b in text.encode("utf-8")]
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i - _BYTE_OFFSET for i in ids
+                     if _BYTE_OFFSET <= i < 256 + _BYTE_OFFSET)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    def __init__(self, tok):
+        self._tok = tok
+        self.vocab_size = len(tok)
+        self.bos_id = tok.bos_token_id if tok.bos_token_id is not None else BOS_ID
+        self.eos_id = tok.eos_token_id if tok.eos_token_id is not None else EOS_ID
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_text_tokenizer(vocab_size: int):
+    tok_dir = os.environ.get("LLM_TOKENIZER_DIR", "")
+    if tok_dir and os.path.isdir(tok_dir):
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(tok_dir)
+            log.info("Loaded HF tokenizer from %s (vocab %d)", tok_dir, len(tok))
+            return HFTokenizer(tok)
+        except Exception as e:
+            log.warning("HF tokenizer load failed (%s); using byte tokenizer", e)
+    log.warning("Using byte-level tokenizer (LLM_TOKENIZER_DIR unset/missing)")
+    return ByteTokenizer(vocab_size)

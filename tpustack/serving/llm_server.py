@@ -1,0 +1,226 @@
+"""LLM HTTP server — TPU-native replacement for the reference's llama.cpp pod.
+
+The reference runs ``ghcr.io/ggml-org/llama.cpp:server-cuda`` with
+``llama-server -m qwen2.5-7b-q4k.gguf --ctx-size 4096 --n-gpu-layers 35`` on
+:8080 (reference ``cluster-config/apps/llm/deployment.yaml:61-87``).  This
+server keeps llama.cpp's HTTP surface so existing clients/Gateway routes work:
+
+- ``GET  /health``              → ``{"status": "ok"}``
+- ``POST /completion``          → llama.cpp-style {content, tokens_predicted,
+                                  tokens_evaluated, timings, model, stop}
+- ``POST /tokenize``            → {tokens};  ``POST /detokenize`` → {content}
+- ``POST /v1/chat/completions`` → OpenAI-compatible chat endpoint
+- ``GET  /props``               → minimal server properties
+
+but the engine is this package's JAX prefill+KV-cache generator on TPU: bf16
+whole-model on-chip (no GGUF quantisation, no ``--n-gpu-layers`` CPU split —
+v5e HBM holds 7B), ctx 4096 parity via ``LLM_CTX`` env.
+
+Env: ``LLM_PRESET`` (``qwen25_7b``|``llama2_7b``|``tiny``), ``LLM_CTX``,
+``MODEL_DIR`` (HF safetensors), ``LLM_TOKENIZER_DIR``, ``PORT`` (8080).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from tpustack.utils import get_logger
+
+log = get_logger("serving.llm_server")
+
+
+def _or_default(value, default):
+    return default if value is None else value
+
+
+def _build_generator():
+    import jax.numpy as jnp
+
+    from tpustack.models.llama import LlamaConfig
+    from tpustack.models.llm_generate import Generator
+    from tpustack.models.text_tokenizer import load_text_tokenizer
+
+    import dataclasses
+
+    preset = os.environ.get("LLM_PRESET", "qwen25_7b")
+    ctx = int(os.environ.get("LLM_CTX", "4096"))
+    if preset == "tiny":
+        cfg = LlamaConfig.tiny(max_seq=min(ctx, 128))
+        dtype = jnp.float32
+    elif preset == "llama2_7b":
+        cfg = dataclasses.replace(LlamaConfig.llama2_7b(), max_seq=ctx)
+        dtype = jnp.bfloat16
+    else:
+        cfg = dataclasses.replace(LlamaConfig.qwen25_7b(), max_seq=ctx)
+        dtype = jnp.bfloat16
+
+    model_dir = os.environ.get("MODEL_DIR", "")
+    if model_dir:
+        gen = Generator.from_checkpoint(cfg, model_dir, dtype=dtype)
+    else:
+        gen = Generator(cfg, dtype=dtype)
+    tok = load_text_tokenizer(cfg.vocab_size)
+    return gen, tok, preset
+
+
+class LLMServer:
+    def __init__(self, generator=None, tokenizer=None, model_name: str = "tpustack"):
+        if generator is None:
+            generator, tokenizer, model_name = _build_generator()
+        self.gen = generator
+        self.tok = tokenizer
+        self.model_name = model_name
+        self._lock = asyncio.Lock()
+
+    # ------------------------------------------------------------ helpers
+    def _complete(self, prompt: str, n_predict: int, temperature: float,
+                  top_k: int, seed: Optional[int], greedy: bool):
+        from tpustack.models.llm_generate import SampleConfig
+
+        ids = self.tok.encode(prompt)
+        out_ids, stats = self.gen.generate(
+            ids, max_new_tokens=n_predict,
+            sample=SampleConfig(temperature=temperature, top_k=top_k,
+                                greedy=greedy or temperature <= 0),
+            seed=seed, stop_tokens=(self.tok.eos_id,))
+        if out_ids and out_ids[-1] == self.tok.eos_id:
+            out_ids = out_ids[:-1]
+            stopped_eos = True
+        else:
+            stopped_eos = False
+        return self.tok.decode(out_ids), stats, stopped_eos
+
+    # ----------------------------------------------------------- handlers
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def props(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "model": self.model_name,
+            "n_ctx": self.gen.cfg.max_seq,
+            "backend": "jax/tpu",
+        })
+
+    async def completion(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid json"}, status=400)
+        prompt = body.get("prompt", "")
+        if not isinstance(prompt, str) or not prompt:
+            return web.json_response({"error": "prompt is required"}, status=400)
+        try:  # explicit None checks — 0 is a meaningful value (greedy temp)
+            n_predict = int(_or_default(body.get("n_predict"), 128))
+            temperature = float(_or_default(body.get("temperature"), 0.8))
+            top_k = int(_or_default(body.get("top_k"), 40))
+        except (TypeError, ValueError) as e:
+            return web.json_response({"error": f"invalid parameter: {e}"}, status=400)
+        if n_predict < 0:  # llama.cpp: -1 means "until EOS / context limit"
+            n_predict = self.gen.cfg.max_seq
+        seed = body.get("seed")
+
+        t0 = time.time()
+        try:
+            async with self._lock:
+                content, stats, stopped_eos = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: self._complete(prompt, n_predict, temperature,
+                                                 top_k, seed, False))
+        except ValueError as e:  # e.g. prompt longer than the context window
+            return web.json_response({"error": str(e)}, status=400)
+        log.info("completion: %d prompt tok, %d gen tok, %.2fs",
+                 stats["prompt_tokens"], stats["generated_tokens"], time.time() - t0)
+        return web.json_response({
+            "content": content,
+            "model": self.model_name,
+            "stop": True,
+            "stopped_eos": stopped_eos,
+            "stopped_limit": not stopped_eos,
+            "tokens_evaluated": stats["prompt_tokens"],
+            "tokens_predicted": stats["generated_tokens"],
+            "timings": {
+                "prompt_n": stats["prompt_tokens"],
+                "prompt_ms": stats["prefill_s"] * 1e3,
+                "predicted_n": stats["generated_tokens"],
+                "predicted_ms": stats["decode_s"] * 1e3,
+                "predicted_per_second": stats["tokens_per_s"],
+            },
+        })
+
+    async def tokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        ids = self.tok.encode(str(body.get("content", "")), add_bos=False)
+        return web.json_response({"tokens": ids})
+
+    async def detokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        return web.json_response({"content": self.tok.decode(body.get("tokens", []))})
+
+    async def chat_completions(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid json"}, status=400)
+        messages = body.get("messages", [])
+        if not messages:
+            return web.json_response(
+                {"error": {"message": "messages required"}}, status=400)
+        # simple generic chat template (no model-specific tokens baked in)
+        parts = [f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages]
+        prompt = "\n".join(parts) + "\nassistant:"
+        try:
+            n_predict = int(_or_default(body.get("max_tokens"), 128))
+            temperature = float(_or_default(body.get("temperature"), 0.8))
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": {"message": f"invalid parameter: {e}"}}, status=400)
+
+        try:
+            async with self._lock:
+                content, stats, stopped_eos = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: self._complete(prompt, n_predict, temperature,
+                                                 40, body.get("seed"), False))
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        return web.json_response({
+            "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": content},
+                "finish_reason": "stop" if stopped_eos else "length",
+            }],
+            "usage": {
+                "prompt_tokens": stats["prompt_tokens"],
+                "completion_tokens": stats["generated_tokens"],
+                "total_tokens": stats["prompt_tokens"] + stats["generated_tokens"],
+            },
+        })
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/props", self.props)
+        app.router.add_post("/completion", self.completion)
+        app.router.add_post("/tokenize", self.tokenize)
+        app.router.add_post("/detokenize", self.detokenize)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        return app
+
+
+def main() -> None:
+    port = int(os.environ.get("PORT", "8080"))
+    server = LLMServer()
+    web.run_app(server.build_app(), port=port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()
