@@ -1,0 +1,49 @@
+"""Ring attention vs dense attention on the 8-virtual-CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpustack.ops.attention import dot_product_attention
+from tpustack.parallel import build_mesh
+from tpustack.parallel.ring_attention import ring_attention_sharded
+
+
+@pytest.fixture(scope="module")
+def sp_mesh(devices8):
+    return build_mesh((1, 1, 1, 8))
+
+
+def _rand(shape, key):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_dense(sp_mesh, causal):
+    b, s, h, d = 2, 64, 2, 16   # 8 shards of 8 tokens
+    q, k, v = _rand((b, s, h, d), 0), _rand((b, s, h, d), 1), _rand((b, s, h, d), 2)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = ring_attention_sharded(q, k, v, sp_mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_on_partial_sp_axis(devices8):
+    """sp=2 inside a larger mesh (dp=2, fsdp=2, tp=1, sp=2)."""
+    mesh = build_mesh((2, 2, 1, 2))
+    b, s, h, d = 2, 32, 2, 8
+    q, k, v = _rand((b, s, h, d), 3), _rand((b, s, h, d), 4), _rand((b, s, h, d), 5)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = ring_attention_sharded(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_long_context_shape(sp_mesh):
+    """8k tokens over 8 shards — each chip only ever sees 1k x 1k scores."""
+    b, s, h, d = 1, 8192, 1, 8
+    q = _rand((b, s, h, d), 6)
+    out = ring_attention_sharded(q, q, q, sp_mesh, causal=True)
+    assert out.shape == (b, s, h, d)
+    assert bool(jnp.isfinite(out).all())

@@ -1,0 +1,86 @@
+"""BERT-base encoder in Flax — training-ladder config #4 (BASELINE.json:
+"BERT-base fine-tune Job, jax.pmap over v5e-8").
+
+Fine-tune shape: encoder + pooled [CLS] classification head.  The DP-over-8-
+chips execution uses the mesh/pjit path (``dp`` axis of
+``tpustack.parallel.mesh``) — the modern equivalent of ``jax.pmap``, same
+per-chip SPMD program, but composable with the other mesh axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpustack.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_classes: int = 2
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=1000, hidden_size=64, num_layers=2, num_heads=4,
+                   intermediate_size=128, max_position=64)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        dense = lambda name: nn.Dense(c.hidden_size, dtype=self.dtype, name=name)
+        split = lambda t: t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
+        attn = dot_product_attention(
+            split(dense("q")(x)), split(dense("k")(x)), split(dense("v")(x)),
+            mask=mask[:, None, None, :])
+        attn = dense("attn_out")(attn.reshape(x.shape))
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=self.dtype,
+                         name="attn_norm")(x + attn)
+        h = nn.Dense(c.intermediate_size, dtype=self.dtype, name="ffn_in")(x)
+        h = nn.Dense(c.hidden_size, dtype=self.dtype, name="ffn_out")(nn.gelu(h))
+        return nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=self.dtype,
+                            name="ffn_norm")(x + h)
+
+
+class BertClassifier(nn.Module):
+    """``(input_ids, attention_mask) → class logits`` (fine-tune head)."""
+
+    cfg: BertConfig
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, attention_mask: jax.Array) -> jax.Array:
+        c = self.cfg
+        b, s = input_ids.shape
+        x = nn.Embed(c.vocab_size, c.hidden_size, dtype=self.dtype, name="tok_embed")(input_ids)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (c.max_position, c.hidden_size))
+        x = x + pos[None, :s].astype(self.dtype)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=self.dtype, name="embed_norm")(x)
+        mask = attention_mask.astype(bool)
+        for i in range(c.num_layers):
+            x = BertLayer(c, self.dtype, name=f"layer_{i}")(x, mask)
+        pooled = nn.tanh(nn.Dense(c.hidden_size, dtype=self.dtype, name="pooler")(x[:, 0]))
+        return nn.Dense(c.num_classes, dtype=jnp.float32, name="classifier")(
+            pooled.astype(jnp.float32))

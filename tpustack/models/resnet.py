@@ -1,0 +1,65 @@
+"""ResNet-50 in Flax, NHWC — training-ladder config #3 (BASELINE.json).
+
+The reference stack has no training; this model exists for the TPU build's
+benchmark ladder ("ResNet-50 training Job, 1 TPU chip").  NHWC + bf16 compute
+keeps the convolutions on the MXU; BatchNorm statistics live in the standard
+flax ``batch_stats`` collection (threaded by the resnet train step in
+``tpustack.train.tasks``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(bn(name="bn1")(y))
+        y = conv(self.filters, (3, 3), self.strides, name="conv2")(y)
+        y = nn.relu(bn(name="bn2")(y))
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = bn(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), self.strides,
+                            name="proj_conv")(residual)
+            residual = bn(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BottleneckBlock(64 * 2 ** stage, strides, self.dtype,
+                                    name=f"stage{stage}_block{block}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32))

@@ -1,0 +1,123 @@
+"""Flash attention for TPU in Pallas.
+
+The hot-op playbook from ``/opt/skills/guides/pallas_guide.md``: tile the
+query sequence onto the grid, stream K/V through VMEM, never materialise the
+``[S, S]`` score matrix in HBM.  XLA's fused attention is already strong at
+SD1.5's 4k-token spatial attention; this kernel targets the places XLA's
+generic fusion loses to a hand-tile — long single-device sequences (the
+multi-device long-context path is ``tpustack.parallel.ring_attention``, which
+uses its own per-shard partials) — and is exercised in interpret mode on CPU
+in CI.
+
+Layout contract: BSHD in, BSHD out (same as ``tpustack.ops.attention``).
+Internally ``[B*H, S, D]`` with the q-sequence tiled at ``block_q`` rows per
+grid step; the full per-head K/V panel lives in VMEM (fine to ~8k tokens at
+D=128 bf16; ring attention keeps per-shard S small beyond that).
+
+Constraints: D should be a multiple of 128 for peak MXU lane use (64 works,
+down-tiled); q/k lengths must divide by the chosen block (the wrapper pads
+and masks).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                 kv_len: int, block_q: int):
+    """One (batch*head, q-block) grid step: softmax(q·kᵀ)·v, fp32 accumulate."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)            # [block_q, D]
+    k = k_ref[0].astype(jnp.float32)            # [S_pad, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [block_q, S_pad]
+
+    s_pad = logits.shape[-1]
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_q, s_pad), 1)
+    valid = col < kv_len                              # mask K padding
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, s_pad), 0)
+        valid = valid & (col <= row + qi * block_q)
+    logits = jnp.where(valid, logits, NEG_INF)
+
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    out = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32) / denom
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "interpret"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """``[B, S, H, D]`` flash attention (kv heads must already match q heads).
+
+    ``interpret`` defaults to True off-TPU so CPU tests exercise the same
+    kernel code path the chip runs.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if k.shape[2] != h:
+        raise ValueError("flash_attention expects pre-repeated kv heads")
+    if scale is None:
+        scale = d ** -0.5
+
+    bq = min(block_q, max(8, sq))
+    # fold heads into batch; [BH, S, D]
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf = _pad_to(qf, 1, bq)
+    kf = _pad_to(kf, 1, 128)
+    vf = _pad_to(vf, 1, 128)
+    sq_pad, sk_pad = qf.shape[1], kf.shape[1]
+
+    grid = (b * h, sq_pad // bq)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale, causal=causal,
+                          kv_len=sk, block_q=bq),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[:, :sq]                                  # drop q padding
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,103 @@
+"""Parameter/activation sharding rules (GSPMD via PartitionSpec).
+
+The reference has no tensor-level parallelism at all — its scale story is k8s
+Jobs with one GPU each and NCCL is never configured (SURVEY.md §2.10, §5.8).
+The TPU build replaces that with the standard JAX recipe: pick a mesh
+(``tpustack.parallel.mesh``), annotate params/activations with
+``PartitionSpec``s, and let XLA insert the collectives over ICI/DCN.
+
+Rules are (regex, spec) pairs matched against ``/``-joined param paths —
+first match wins, scalars stay replicated.  The Llama rules are megatron-style
+TP with FSDP on the complementary axis:
+
+    column-parallel (q/k/v, gate/up, lm_head): kernel [in, out] → (fsdp, tp)
+    row-parallel (o_proj, down_proj):          kernel [in, out] → (tp, fsdp)
+    embeddings: vocab on tp, model dim on fsdp; norms replicated
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from tpustack.utils.tree import flat_paths as tree_paths
+
+Rules = Sequence[Tuple[str, PS]]
+
+LLAMA_RULES: Rules = (
+    (r"embed_tokens/embedding$", PS("tp", "fsdp")),
+    (r"(q_proj|k_proj|v_proj)/kernel$", PS("fsdp", "tp")),
+    (r"(q_proj|k_proj|v_proj)/bias$", PS("tp")),
+    (r"o_proj/kernel$", PS("tp", "fsdp")),
+    (r"(gate_proj|up_proj)/kernel$", PS("fsdp", "tp")),
+    (r"down_proj/kernel$", PS("tp", "fsdp")),
+    (r"lm_head/kernel$", PS("fsdp", "tp")),
+    (r"(layernorm|norm)[^/]*/scale$", PS()),
+    (r".*", PS()),
+)
+
+# SD1.5 UNet/VAE/CLIP: conv-heavy; at serving batch sizes the win is DP over
+# images + replicated params (a 1GB bf16 UNet fits any chip), with TP on the
+# big transformer Dense layers when a mesh is used.
+SD15_RULES: Rules = (
+    (r"(to_q|to_k|to_v|q_proj|k_proj|v_proj|fc1|proj_in)/kernel$", PS(None, "tp")),
+    (r"(to_out|out_proj|fc2|proj_out)/kernel$", PS("tp", None)),
+    (r".*", PS()),
+)
+
+
+def match_partition_rules(rules: Rules, params: Dict[str, Any]):
+    """Pytree of PartitionSpec matching ``params``' structure.
+
+    Pattern follows public JAX LLM codebases (see SNIPPETS.md [1]): regex over
+    the joined path; 0-d/1-element leaves are always replicated.
+    """
+
+    def spec_for(path: str, leaf) -> PS:
+        if getattr(leaf, "ndim", 0) == 0 or getattr(leaf, "size", 2) == 1:
+            return PS()
+        for pat, spec in rules:
+            if re.search(pat, path):
+                return _clip_spec(spec, leaf.ndim)
+        raise ValueError(f"no partition rule for {path}")
+
+    flat = tree_paths(params)
+    specs = {path: spec_for(path, leaf) for path, leaf in flat}
+
+    def rebuild(node, prefix):
+        return {
+            k: (rebuild(v, f"{prefix}/{k}" if prefix else k) if isinstance(v, dict)
+                else specs[f"{prefix}/{k}" if prefix else k])
+            for k, v in node.items()
+        }
+
+    return rebuild(params, "")
+
+
+def _clip_spec(spec: PS, ndim: int) -> PS:
+    """Trim a spec to the leaf's rank (rules written for 2-d kernels also hit
+    biases etc.)."""
+    parts = tuple(spec)
+    if len(parts) <= ndim:
+        return spec
+    return PS(*parts[:ndim])
+
+
+def shard_params(params, specs, mesh: Mesh):
+    """device_put every leaf with its NamedSharding (host → sharded HBM)."""
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
+
+
+def constrain(x, mesh: Mesh, spec: PS):
+    """with_sharding_constraint that is a no-op outside jit/mesh contexts."""
+    try:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    except ValueError:
+        return x
+
+
+BATCH_SPEC = PS(("dp", "fsdp"), "sp")  # tokens [B, S]: batch over dp+fsdp, seq over sp

@@ -1,0 +1,8 @@
+from tpustack.train.trainer import (
+    TrainerConfig,
+    TrainState,
+    make_sharded_train_step,
+    make_train_state,
+)
+
+__all__ = ["TrainerConfig", "TrainState", "make_sharded_train_step", "make_train_state"]

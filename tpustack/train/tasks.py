@@ -1,0 +1,233 @@
+"""Training-ladder task CLIs (the BASELINE.json configs, run by the k8s Jobs
+in ``cluster-config/jobs/``):
+
+    python -m tpustack.train.tasks resnet50 --steps 100 --batch 256
+    python -m tpustack.train.tasks bert     --steps 200 --batch 64 --dp 8
+    python -m tpustack.train.tasks llama2   --steps 100 --batch 16 --fsdp 8 --tp 2
+
+Each task: synthetic data (the reference ships no datasets; throughput is the
+metric), the shared sharded train step, Orbax checkpoint/resume (the
+checkpoint/restore subsystem the reference lacked entirely — SURVEY.md §5),
+and a steps/sec + examples/sec report on stdout.  ``llama2`` initialises
+``jax.distributed`` from JobSet env when NUM_PROCESSES>1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpustack.utils import get_logger
+
+log = get_logger("train.tasks")
+
+
+def _report(step: int, metrics: Dict[str, Any], t0: float, n_done: int,
+            batch: int) -> None:
+    dt = time.time() - t0
+    log.info("step=%d loss=%.4f steps/s=%.3f examples/s=%.1f",
+             step, float(metrics["loss"]), n_done / dt, n_done * batch / dt)
+
+
+def _maybe_restore(ckpt_dir: Optional[str], state):
+    if not ckpt_dir:
+        return state, None
+    import orbax.checkpoint as ocp
+
+    mngr = ocp.CheckpointManager(ckpt_dir, options=ocp.CheckpointManagerOptions(
+        max_to_keep=3, save_interval_steps=50))
+    latest = mngr.latest_step()
+    if latest is not None:
+        shardings = jax.tree.map(lambda x: getattr(x, "sharding", None), state)
+        state = mngr.restore(latest, args=ocp.args.StandardRestore(state))
+        # orbax does not re-apply every leaf's sharding (scalars come back on
+        # one device); re-place so the jitted step sees a consistent mesh
+        state = jax.tree.map(
+            lambda x, s: jax.device_put(x, s) if s is not None else x,
+            state, shardings)
+        log.info("Resumed from checkpoint step %d", latest)
+    return state, mngr
+
+
+def _maybe_save(mngr, step: int, state) -> None:
+    if mngr is None:
+        return
+    import orbax.checkpoint as ocp
+
+    mngr.save(step, args=ocp.args.StandardSave(state))
+
+
+# --------------------------------------------------------------------- tasks
+
+def run_resnet50(args) -> None:
+    """Config #3: ResNet-50, 1 chip.  BatchNorm stats threaded explicitly."""
+    import optax
+
+    from tpustack.models.resnet import ResNet50
+    from tpustack.train.trainer import TrainerConfig, make_optimizer
+
+    model = ResNet50(num_classes=args.classes,
+                     dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    size = args.image_size
+    rng = jax.random.PRNGKey(0)
+    fake = jnp.zeros((args.batch, size, size, 3), jnp.float32)
+    variables = jax.jit(model.init, static_argnums=(2,))(rng, fake, True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tcfg = TrainerConfig(learning_rate=args.lr)
+    opt = make_optimizer(tcfg)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step_fn(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images, True,
+                mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(labels, args.classes)
+            loss = optax.softmax_cross_entropy(logits, onehot).mean()
+            return loss, mut["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, {"loss": loss}
+
+    data_rng = np.random.RandomState(0)
+    t0 = None
+    for i in range(args.steps):
+        images = jnp.asarray(data_rng.rand(args.batch, size, size, 3), jnp.float32)
+        labels = jnp.asarray(data_rng.randint(0, args.classes, args.batch))
+        params, batch_stats, opt_state, metrics = step_fn(
+            params, batch_stats, opt_state, images, labels)
+        if i == 0:
+            jax.block_until_ready(metrics["loss"])
+            t0 = time.time()  # exclude compile from throughput
+        elif (i + 1) % 10 == 0 or i == args.steps - 1:
+            jax.block_until_ready(metrics["loss"])
+            _report(i + 1, metrics, t0, i, args.batch)
+    log.info("resnet50 done: %d steps", args.steps)
+
+
+def _generic_lm_task(args, kind: str) -> None:
+    """Configs #4/#5: BERT DP and Llama-2 FSDP+TP via the shared machinery."""
+    from jax.sharding import PartitionSpec as PS
+
+    from tpustack.parallel import build_mesh
+    from tpustack.parallel.distributed import initialize_from_env
+    from tpustack.parallel.sharding import BATCH_SPEC, LLAMA_RULES
+    from tpustack.train.trainer import (TrainerConfig, make_sharded_train_step,
+                                        make_train_state)
+
+    initialize_from_env()  # no-op single-process; JobSet env multi-host
+
+    n_dev = len(jax.devices())
+    if kind == "bert":
+        from tpustack.models.bert import BertClassifier, BertConfig
+
+        cfg = BertConfig.tiny() if args.tiny else BertConfig.base()
+        model = BertClassifier(cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+        seq = args.seq or 128
+        rules = ((r".*", PS()),)  # DP fine-tune: replicate params, shard the batch
+        dp = args.dp or n_dev
+        mesh = build_mesh((dp, 1, 1, 1))
+
+        def make_batch(rng):
+            ids = rng.randint(0, cfg.vocab_size, (args.batch, seq))
+            mask = np.ones((args.batch, seq), np.int32)
+            labels = rng.randint(0, cfg.num_classes, (args.batch,))
+            return {"ids": jnp.asarray(ids), "mask": jnp.asarray(mask),
+                    "labels": jnp.asarray(labels)}
+
+        def loss_fn(params, batch, rng):
+            import optax
+
+            logits = model.apply({"params": params}, batch["ids"], batch["mask"])
+            onehot = jax.nn.one_hot(batch["labels"], cfg.num_classes)
+            return optax.softmax_cross_entropy(logits, onehot).mean()
+
+        init_batch = make_batch(np.random.RandomState(0))
+        params = jax.jit(model.init)(jax.random.PRNGKey(0), init_batch["ids"],
+                                     init_batch["mask"])["params"]
+    else:  # llama2
+        from tpustack.models.llama import LlamaConfig, LlamaModel, causal_lm_loss
+
+        cfg = LlamaConfig.tiny() if args.tiny else LlamaConfig.llama2_7b()
+        model = LlamaModel(cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+        seq = args.seq or min(cfg.max_seq, 2048)
+        rules = LLAMA_RULES
+        tp = args.tp or 1
+        fsdp = args.fsdp or (n_dev // tp)
+        dp = n_dev // (tp * fsdp)
+        mesh = build_mesh((dp, fsdp, tp, 1))
+
+        def make_batch(rng):
+            return jnp.asarray(rng.randint(0, cfg.vocab_size, (args.batch, seq)))
+
+        def loss_fn(params, batch, rng):
+            logits, _ = model.apply({"params": params}, batch)
+            return causal_lm_loss(logits, batch)
+
+        params = jax.jit(model.init)(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    tcfg = TrainerConfig(learning_rate=args.lr, remat=args.remat)
+    state, specs = make_train_state(params, tcfg, mesh=mesh, rules=rules)
+    state, mngr = _maybe_restore(args.ckpt_dir, state)
+    step = make_sharded_train_step(loss_fn, tcfg, mesh=mesh,
+                                   batch_spec=BATCH_SPEC)
+
+    data_rng = np.random.RandomState(1)
+    rng = jax.random.PRNGKey(2)
+    t0 = None
+    start = int(state.step)
+    for i in range(start, args.steps):
+        batch = make_batch(data_rng)
+        state, metrics = step(state, batch, rng)
+        if i == start:
+            jax.block_until_ready(metrics["loss"])
+            t0 = time.time()
+        elif (i + 1) % 10 == 0 or i == args.steps - 1:
+            jax.block_until_ready(metrics["loss"])
+            _report(i + 1, metrics, t0, i - start, args.batch)
+            _maybe_save(mngr, i + 1, state)
+    if mngr is not None:
+        mngr.wait_until_finished()
+    log.info("%s done: %d steps on mesh %s", kind, args.steps - start,
+             dict(zip(mesh.axis_names, mesh.devices.shape)))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="tpustack training ladder")
+    p.add_argument("task", choices=["resnet50", "bert", "llama2"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--seq", type=int, default=0)
+    p.add_argument("--dp", type=int, default=0)
+    p.add_argument("--fsdp", type=int, default=0)
+    p.add_argument("--tp", type=int, default=0)
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--no-bf16", dest="bf16", action="store_false")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny model config (CI / smoke)")
+    p.add_argument("--ckpt-dir", default="")
+    args = p.parse_args(argv)
+
+    if args.task == "resnet50":
+        run_resnet50(args)
+    else:
+        _generic_lm_task(args, args.task)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

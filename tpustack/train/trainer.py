@@ -1,0 +1,136 @@
+"""Sharded training loop machinery (the BASELINE.json training ladder).
+
+The reference has **no training at all** — its "parallelism" is k8s Job
+fan-out (SURVEY.md §2.10).  The TPU build's ladder (BASELINE.json configs):
+ResNet-50 on 1 chip → BERT-base DP over v5e-8 → Llama-2-7B multi-host on
+v5e-16.  All three run through this one train-step factory:
+
+- params/opt-state sharded by regex partition rules (``parallel.sharding``),
+- batches sharded ``(dp, fsdp)`` over the batch axis, ``sp`` over sequence,
+- ``jax.jit`` with explicit in/out shardings → XLA inserts psum/all-gather/
+  reduce-scatter over ICI/DCN (the NCCL-equivalent layer, SURVEY.md §5.8),
+- optax AdamW + optional ``jax.checkpoint`` rematerialisation of the model fn.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from tpustack.parallel.sharding import match_partition_rules, shard_params
+from tpustack.utils import get_logger
+
+log = get_logger("train.trainer")
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerConfig:
+    learning_rate: float = 1e-4
+    weight_decay: float = 0.01
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    remat: bool = False
+
+
+def make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(cfg.learning_rate, b1=cfg.b1, b2=cfg.b2,
+                    weight_decay=cfg.weight_decay),
+    )
+
+
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array
+    params: Dict[str, Any]
+    opt_state: Any
+
+    def tree_flatten(self):
+        return (self.step, self.params, self.opt_state), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
+
+
+def make_train_state(params, cfg: TrainerConfig, mesh: Optional[Mesh] = None,
+                     rules=None) -> Tuple[TrainState, Any]:
+    """Build (sharded) TrainState; returns (state, param_specs)."""
+    opt = make_optimizer(cfg)
+    step0 = jnp.zeros((), jnp.int32)
+    if mesh is not None and rules is not None:
+        specs = match_partition_rules(rules, params)
+        params = shard_params(params, specs, mesh)
+        # init opt state under jit so first/second moments inherit shardings
+        opt_state = jax.jit(opt.init)(params)
+        # XLA leaves scalar outputs (adam count etc.) on a single device;
+        # normalise everything non-sharded to mesh-replicated, or checkpoint
+        # restore later produces a state the jitted step rejects as mixing
+        # device sets
+        repl = NamedSharding(mesh, PS())
+        opt_state = jax.tree.map(
+            lambda x: x if isinstance(getattr(x, "sharding", None), NamedSharding)
+            else jax.device_put(x, repl), opt_state)
+        step0 = jax.device_put(step0, repl)
+    else:
+        specs = None
+        opt_state = opt.init(params)
+    return TrainState(step=step0, params=params, opt_state=opt_state), specs
+
+
+def make_sharded_train_step(
+    loss_fn: Callable[[Dict[str, Any], Any, jax.Array], jax.Array],
+    cfg: TrainerConfig,
+    mesh: Optional[Mesh] = None,
+    batch_spec: PS = PS(("dp", "fsdp")),
+):
+    """Compile ``(state, batch, rng) → (state, metrics)``.
+
+    ``loss_fn(params, batch, rng) → scalar``.  With a mesh, in/out shardings
+    are pinned so XLA lays out params per the rules and batches over dp/fsdp;
+    gradients reduce with psum over the data axes automatically.
+    """
+    opt = make_optimizer(cfg)
+    loss_for_grad = jax.checkpoint(loss_fn) if cfg.remat else loss_fn
+
+    def step_fn(state: TrainState, batch, rng):
+        loss, grads = jax.value_and_grad(loss_for_grad)(state.params, batch, rng)
+        updates, opt_state = opt.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    # Pin only what we know (the batch layout); params/opt-state already carry
+    # their NamedShardings from make_train_state, and SPMD propagation derives
+    # the rest — XLA inserts the psum/reduce-scatter collectives.
+    def place_batch(batch):
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, _clip_to_rank(batch_spec, x.ndim))), batch)
+
+    def wrapped(state, batch, rng):
+        batch = place_batch(batch)
+        return step_fn(state, batch, rng)
+
+    return jax.jit(wrapped, donate_argnums=(0,))
+
+
+def _clip_to_rank(spec: PS, ndim: int) -> PS:
+    parts = tuple(spec)[:ndim]
+    return PS(*parts) if parts else PS()
