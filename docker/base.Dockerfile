@@ -1,0 +1,20 @@
+# ghcr.io/tpustack/jax-tpu — base image for all TPU workloads (smoke Jobs,
+# training ladder, clients).
+#
+# Replaces the reference's prebuilt accelerator images (nvcr.io cuda-sample,
+# pytorch/pytorch:2.3.1-cuda11.8 — /root/reference/cluster-config/apps/
+# sd15-api/deployment.yaml:21, README.md:283): the native layer here is
+# jax[tpu]'s bundled libtpu/XLA (C++), SURVEY.md §2.9.
+FROM python:3.12-slim
+
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    flax optax orbax-checkpoint einops \
+    aiohttp pydantic safetensors pillow requests transformers
+
+WORKDIR /app
+COPY tpustack /app/tpustack
+COPY scripts /app/scripts
+COPY pyproject.toml /app/
+ENV PYTHONPATH=/app
+ENTRYPOINT ["python"]

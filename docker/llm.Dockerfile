@@ -1,0 +1,8 @@
+# ghcr.io/tpustack/llm-server — the LLM serving image
+# (replaces ghcr.io/ggml-org/llama.cpp:server-cuda,
+# /root/reference/cluster-config/apps/llm/deployment.yaml:61).
+FROM ghcr.io/tpustack/jax-tpu:0.1.0
+
+EXPOSE 8080
+ENV PORT=8080 LLM_PRESET=qwen25_7b LLM_CTX=4096
+CMD ["-m", "tpustack.serving.llm_server"]

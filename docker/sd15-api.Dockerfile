@@ -1,0 +1,9 @@
+# ghcr.io/tpustack/sd15-api — the SD1.5 REST API serving image.
+# (Reference ran pytorch/pytorch:2.3.1-cuda11.8 + pip-install-at-startup,
+# /root/reference/cluster-config/apps/sd15-api/deployment.yaml:21-42; baking
+# the deps removes the startup pip step and the content-hash PVC dance.)
+FROM ghcr.io/tpustack/jax-tpu:0.1.0
+
+EXPOSE 8000
+ENV PORT=8000 SD15_PRESET=sd15
+CMD ["-m", "tpustack.serving.sd_server"]

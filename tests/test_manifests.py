@@ -1,0 +1,144 @@
+"""IaC validation: every YAML parses, kustomizations reference real files,
+the accelerator contract is TPU-only (zero NVIDIA components — the
+BASELINE.json north star), and key parity invariants hold.
+
+kubectl/kustomize aren't in this image, so this is a pure-Python structural
+check (a minimal kustomize resolver), mirroring the reference's own lack of
+manifest CI (SURVEY.md §4: its "tests" were README-driven smoke Jobs)."""
+
+import os
+from pathlib import Path
+
+import yaml
+
+REPO = Path(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CLUSTER = REPO / "cluster-config"
+
+
+def _load_all(path: Path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def all_yaml_files():
+    return sorted(
+        list(CLUSTER.rglob("*.yaml")) + list((REPO / "tpu-installation").rglob("*.yaml"))
+    )
+
+
+def all_cluster_docs():
+    docs = []
+    for p in CLUSTER.rglob("*.yaml"):
+        for d in _load_all(p):
+            docs.append((p, d))
+    return docs
+
+
+def test_every_yaml_parses():
+    files = all_yaml_files()
+    assert len(files) > 20, f"expected a full manifest tree, found {len(files)}"
+    for p in files:
+        docs = _load_all(p)
+        assert docs, f"{p} parsed to nothing"
+
+
+def test_kustomizations_reference_existing_files():
+    for p in CLUSTER.rglob("kustomization.yaml"):
+        for doc in _load_all(p):
+            for res in doc.get("resources", []):
+                target = p.parent / res
+                assert target.exists(), f"{p}: missing resource {res}"
+
+
+def test_zero_nvidia_components():
+    """North star (BASELINE.json): zero NVIDIA components in-cluster."""
+    for p, d in all_cluster_docs():
+        text = yaml.safe_dump(d)
+        assert "nvidia.com/gpu" not in text, f"{p} requests nvidia.com/gpu"
+        assert "runtimeClassName" not in text, f"{p} uses a RuntimeClass (no TPU analog)"
+        assert "nvcr.io" not in text, f"{p} references an NVIDIA registry image"
+
+
+def test_tpu_resource_requests_present():
+    """Every accelerator workload must request google.com/tpu."""
+    tpu_requests = 0
+    for p, d in all_cluster_docs():
+        if d.get("kind") in ("Deployment", "Job", "JobSet"):
+            text = yaml.safe_dump(d)
+            if "google.com/tpu" in text:
+                tpu_requests += 1
+    assert tpu_requests >= 6, f"expected >=6 TPU workloads, found {tpu_requests}"
+
+
+def test_flux_fanout_dependencies():
+    """Workload apps must depend on tpu-stack, like the reference's llm
+    depended on nvidia (apps-kustomization.yaml:50-53)."""
+    path = CLUSTER / "cluster" / "flux-system" / "apps-kustomization.yaml"
+    docs = {d["metadata"]["name"]: d for d in _load_all(path)}
+    assert set(docs) >= {"tpu-stack", "renovate", "sd15-api", "llm", "smoke-jobs"}
+    for app in ("sd15-api", "llm", "smoke-jobs"):
+        deps = [x["name"] for x in docs[app]["spec"].get("dependsOn", [])]
+        assert "tpu-stack" in deps, f"{app} must dependsOn tpu-stack"
+    for name, d in docs.items():
+        assert d["spec"]["prune"] is True
+        assert d["spec"]["sourceRef"]["name"] == "flux-system"
+
+
+def test_sd15_service_keeps_nodeport_30800():
+    """Client compatibility: reference NodePort 30800 (service.yaml:7-13)."""
+    svc = _load_all(CLUSTER / "apps" / "sd15-api" / "service.yaml")[0]
+    port = svc["spec"]["ports"][0]
+    assert svc["spec"]["type"] == "NodePort"
+    assert port["nodePort"] == 30800
+    assert port["targetPort"] == 8000
+
+
+def test_llm_ctx_parity():
+    """Reference parity: llama.cpp --ctx-size 4096 (llm/deployment.yaml:67-68)."""
+    dep = _load_all(CLUSTER / "apps" / "llm" / "deployment.yaml")[0]
+    env = {e["name"]: e.get("value") for e in
+           dep["spec"]["template"]["spec"]["containers"][0]["env"]
+           if "value" in e}
+    assert env["LLM_CTX"] == "4096"
+
+
+def test_smoke_job_runs_vectoradd_module():
+    docs = _load_all(CLUSTER / "jobs" / "jax-vectoradd.yaml")
+    job = next(d for d in docs if d["kind"] == "Job")
+    cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[-1] == "tpustack.ops.vectoradd"
+    assert job["spec"]["backoffLimit"] == 0
+
+
+def test_isolation_job_two_parallel_pods():
+    docs = _load_all(CLUSTER / "jobs" / "tpu-isolation-test.yaml")
+    job = next(d for d in docs if d["kind"] == "Job")
+    assert job["spec"]["completions"] == 2
+    assert job["spec"]["parallelism"] == 2
+    limits = job["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == 1
+
+
+def test_jobset_multihost_topology():
+    docs = _load_all(CLUSTER / "jobs" / "train-llama2-jobset.yaml")
+    js = next(d for d in docs if d["kind"] == "JobSet")
+    tmpl = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert tmpl["parallelism"] == 2 and tmpl["completions"] == 2
+    pod = tmpl["template"]["spec"]["containers"][0]
+    env = {e["name"] for e in pod["env"]}
+    assert {"NUM_PROCESSES", "PROCESS_ID", "COORDINATOR_ADDRESS"} <= env
+    assert pod["resources"]["limits"]["google.com/tpu"] == 8
+
+
+def test_ansible_playbook_shapes():
+    """3-playbook surface parity with rke2-installation (SURVEY.md §2.1)."""
+    inst = REPO / "tpu-installation"
+    for name in ("install-k8s-tpu.yaml", "fetch-kubeconfig.yaml",
+                 "uninstall-k8s-tpu.yaml"):
+        docs = _load_all(inst / name)
+        plays = [p for doc in docs for p in (doc if isinstance(doc, list) else [doc])]
+        assert plays and all("hosts" in p for p in plays), f"{name} not a playbook"
+    gv = _load_all(inst / "group_vars" / "all.yaml")[0]
+    assert "kubernetes_version" in gv and "libtpu_version" in gv
+    inventory = (inst / "inventory.ini").read_text()
+    assert "[masters]" in inventory and "k8s_cluster:children" in inventory
