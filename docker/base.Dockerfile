@@ -15,6 +15,11 @@ RUN pip install --no-cache-dir \
 WORKDIR /app
 COPY tpustack /app/tpustack
 COPY scripts /app/scripts
+COPY native /app/native
 COPY pyproject.toml /app/
+# build the native runtime (PNG encoder) so serving doesn't fall back to PIL
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make zlib1g-dev \
+    && make -C /app/native \
+    && apt-get purge -y g++ make && apt-get autoremove -y && rm -rf /var/lib/apt/lists/*
 ENV PYTHONPATH=/app
 ENTRYPOINT ["python"]

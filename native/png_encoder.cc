@@ -1,0 +1,98 @@
+// Fast PNG encoder for the serving hot path (C++, zlib-backed).
+//
+// The reference's native layer arrived entirely via container images
+// (SURVEY.md §2.9); this repo's own native runtime starts here: the SD15
+// server's post-TPU work is PNG-encoding the uint8 image
+// (reference behavior: PIL image.save(buf, "PNG"),
+// /root/reference/cluster-config/apps/sd15-api/configmap.yaml:113-114).
+// This encoder writes RGB8 PNGs (filter 0 scanlines, one zlib stream) and is
+// loaded from Python over ctypes (tpustack/runtime/__init__.py) — no
+// pybind11 dependency.
+//
+// Exported C ABI:
+//   long tpustack_png_encode(const uint8_t* rgb, int h, int w,
+//                            int compression, uint8_t* out, long out_cap);
+//     returns bytes written, or -1 if out_cap is too small / args invalid.
+
+#include <cstdint>
+#include <cstring>
+#include <new>
+#include <zlib.h>
+
+namespace {
+
+inline void put_u32(uint8_t* p, uint32_t v) {
+  p[0] = (v >> 24) & 0xff;
+  p[1] = (v >> 16) & 0xff;
+  p[2] = (v >> 8) & 0xff;
+  p[3] = v & 0xff;
+}
+
+// Writes one chunk (length, type, payload, crc); returns bytes written.
+long write_chunk(uint8_t* out, const char type[4], const uint8_t* payload,
+                 uint32_t len) {
+  put_u32(out, len);
+  std::memcpy(out + 4, type, 4);
+  if (len) std::memcpy(out + 8, payload, len);
+  uint32_t crc = crc32(0L, Z_NULL, 0);
+  crc = crc32(crc, out + 4, len + 4);
+  put_u32(out + 8 + len, crc);
+  return 12 + static_cast<long>(len);
+}
+
+}  // namespace
+
+extern "C" long tpustack_png_encode(const uint8_t* rgb, int h, int w,
+                                    int compression, uint8_t* out,
+                                    long out_cap) {
+  if (!rgb || !out || h <= 0 || w <= 0) return -1;
+  const long stride = 3L * w;
+  const long raw_len = (stride + 1) * h;  // +1 filter byte per scanline
+
+  // filtered scanlines (filter type 0 = None)
+  uint8_t* raw = new (std::nothrow) uint8_t[raw_len];
+  if (!raw) return -1;
+  for (long y = 0; y < h; ++y) {
+    raw[y * (stride + 1)] = 0;
+    std::memcpy(raw + y * (stride + 1) + 1, rgb + y * stride, stride);
+  }
+
+  uLongf zcap = compressBound(raw_len);
+  uint8_t* zbuf = new (std::nothrow) uint8_t[zcap];
+  if (!zbuf) {
+    delete[] raw;
+    return -1;
+  }
+  int level = compression < 0 ? 6 : (compression > 9 ? 9 : compression);
+  int rc = compress2(zbuf, &zcap, raw, raw_len, level);
+  delete[] raw;
+  if (rc != Z_OK) {
+    delete[] zbuf;
+    return -1;
+  }
+
+  const long need = 8 + 25 + (12 + static_cast<long>(zcap)) + 12;
+  if (out_cap < need) {
+    delete[] zbuf;
+    return -1;
+  }
+
+  long off = 0;
+  static const uint8_t sig[8] = {0x89, 'P', 'N', 'G', '\r', '\n', 0x1a, '\n'};
+  std::memcpy(out, sig, 8);
+  off += 8;
+
+  uint8_t ihdr[13];
+  put_u32(ihdr, static_cast<uint32_t>(w));
+  put_u32(ihdr + 4, static_cast<uint32_t>(h));
+  ihdr[8] = 8;   // bit depth
+  ihdr[9] = 2;   // color type RGB
+  ihdr[10] = 0;  // compression
+  ihdr[11] = 0;  // filter
+  ihdr[12] = 0;  // interlace
+  off += write_chunk(out + off, "IHDR", ihdr, 13);
+  off += write_chunk(out + off, "IDAT", zbuf, static_cast<uint32_t>(zcap));
+  off += write_chunk(out + off, "IEND", nullptr, 0);
+  delete[] zbuf;
+  return off;
+}

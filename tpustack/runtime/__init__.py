@@ -1,0 +1,80 @@
+"""Native runtime bindings (C++ via ctypes — no pybind11 dependency).
+
+The reference consumed all native code as prebuilt images (SURVEY.md §2.9);
+tpustack's own native layer lives in ``native/`` and is loaded here.  Current
+surface:
+
+- ``png_encode(img)`` — zlib-backed RGB8 PNG writer used by the serving hot
+  path (``tpustack.utils.image`` falls back to PIL when the library isn't
+  built).
+
+The shared object is built on first import when a compiler is available
+(``make -C native``); set ``TPUSTACK_NO_NATIVE=1`` to skip entirely.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtpustack_runtime.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("TPUSTACK_NO_NATIVE") == "1":
+        return None
+    if not os.path.exists(_SO_PATH):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            _load_failed = True  # don't re-pay the failing build per call
+            return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        _load_failed = True
+        return None
+    lib.tpustack_png_encode.restype = ctypes.c_long
+    lib.tpustack_png_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def png_encode(img: np.ndarray, compression: int = 6) -> bytes:
+    """Encode ``[H, W, 3]`` uint8 (C-contiguous) as PNG bytes."""
+    lib = _load()
+    if lib is None:
+        raise ImportError("native runtime not built (see native/Makefile)")
+    img = np.ascontiguousarray(img)
+    if img.dtype != np.uint8 or img.ndim != 3 or img.shape[2] != 3:
+        raise ValueError(f"expected [H,W,3] uint8, got {img.shape} {img.dtype}")
+    h, w = int(img.shape[0]), int(img.shape[1])
+    # worst case: header + zlib bound (~raw + raw/1000 + 64) + chunk overhead
+    cap = 8 + 25 + 12 + (3 * w + 1) * h + ((3 * w + 1) * h) // 500 + 1024 + 12
+    out = (ctypes.c_uint8 * cap)()
+    n = lib.tpustack_png_encode(
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w,
+        compression, out, cap)
+    if n <= 0:
+        raise RuntimeError("native png_encode failed")
+    return ctypes.string_at(out, n)

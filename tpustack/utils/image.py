@@ -18,13 +18,11 @@ def array_to_png(img: np.ndarray) -> bytes:
     img = np.asarray(img)
     if img.dtype != np.uint8:
         raise ValueError(f"expected uint8 image, got {img.dtype}")
-    try:
-        from tpustack.runtime import png_encode  # native fast path (C)
-    except ImportError:
-        png_encode = None
-    if png_encode is not None:
+    from tpustack import runtime
+
+    if runtime.available():  # caches build/load failures internally
         # A real encode failure should surface, not silently fall back.
-        return png_encode(img)
+        return runtime.png_encode(img)
     from PIL import Image
 
     buf = io.BytesIO()
