@@ -1,0 +1,321 @@
+#!/usr/bin/env python3
+"""Batch text-to-video client for the TPU Wan graph server.
+
+TPU-native counterpart of the reference's ComfyUI batch client (reference
+``cluster-config/apps/llm/scripts/generate_wan_t2v.py``): builds the same
+node-graph JSON, submits it over the same HTTP API (``/prompt`` →
+``/history/<id>`` → ``/view``), auto port-forwards to the ``wan-video-gen``
+deployment, and writes an ``index.html`` gallery.  Differences, all fixes:
+
+- The ``wan-video-gen`` deployment it targets actually exists in this repo
+  (``cluster-config/apps/llm/wan-deployment.yaml``) — the reference client
+  pointed at a deployment its manifests never shipped (SURVEY.md §2.6).
+- If the server does not advertise ``SaveWEBM`` (no ffmpeg in the image), the
+  client falls back to animated WebP instead of failing mid-batch.
+- stdlib-only, like the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from datetime import datetime
+from pathlib import Path
+
+DEFAULT_UNET = "wan2.1_t2v_1.3B_bf16.safetensors"
+DEFAULT_CLIP = "umt5_xxl_fp16.safetensors"
+DEFAULT_VAE = "wan_2.1_vae.safetensors"
+
+
+# ----------------------------------------------------------------- graph build
+def build_graph(*, prompt, negative, seed, width, height, frames, steps, cfg,
+                sampler, scheduler, denoise, unet_name=DEFAULT_UNET,
+                clip_name=DEFAULT_CLIP, vae_name=DEFAULT_VAE,
+                filename_prefix="wan_t2v", fps_webm=24, fps_webp=16,
+                save_webm=False, save_webp=False, save_images=False):
+    """ComfyUI-style {id: {class_type, inputs}} graph, same wiring as the
+    reference workflow (UNET/CLIP/VAE loaders → encode ×2 → empty latent →
+    KSampler → VAEDecode → save nodes)."""
+    g = {
+        "unet": {"class_type": "UNETLoader",
+                 "inputs": {"unet_name": unet_name, "weight_dtype": "default"}},
+        "clip": {"class_type": "CLIPLoader",
+                 "inputs": {"clip_name": clip_name, "type": "wan",
+                            "device": "default"}},
+        "vae": {"class_type": "VAELoader", "inputs": {"vae_name": vae_name}},
+        "pos": {"class_type": "CLIPTextEncode",
+                "inputs": {"clip": ["clip", 0], "text": prompt}},
+        "neg": {"class_type": "CLIPTextEncode",
+                "inputs": {"clip": ["clip", 0], "text": negative}},
+        "latent": {"class_type": "EmptyHunyuanLatentVideo",
+                   "inputs": {"width": width, "height": height,
+                              "length": frames, "batch_size": 1}},
+        "sample": {"class_type": "KSampler",
+                   "inputs": {"model": ["unet", 0], "positive": ["pos", 0],
+                              "negative": ["neg", 0],
+                              "latent_image": ["latent", 0], "seed": seed,
+                              "steps": steps, "cfg": cfg,
+                              "sampler_name": sampler, "scheduler": scheduler,
+                              "denoise": denoise}},
+        "decode": {"class_type": "VAEDecode",
+                   "inputs": {"samples": ["sample", 0], "vae": ["vae", 0]}},
+    }
+    if save_webp:
+        g["save_webp"] = {"class_type": "SaveAnimatedWEBP",
+                          "inputs": {"images": ["decode", 0],
+                                     "filename_prefix": filename_prefix,
+                                     "fps": fps_webp, "lossless": False,
+                                     "quality": 90, "method": "default"}}
+    if save_webm:
+        g["save_webm"] = {"class_type": "SaveWEBM",
+                          "inputs": {"images": ["decode", 0],
+                                     "filename_prefix": filename_prefix,
+                                     "codec": "vp9", "fps": fps_webm,
+                                     "crf": 32}}
+    if save_images:
+        g["save_img"] = {"class_type": "SaveImage",
+                         "inputs": {"images": ["decode", 0],
+                                    "filename_prefix": filename_prefix}}
+    return g
+
+
+# ------------------------------------------------------------------- http/k8s
+def get_json(base_url, path, payload=None, timeout=30):
+    url = urllib.parse.urljoin(base_url, path)
+    data = json.dumps(payload).encode() if payload is not None else None
+    headers = {"Content-Type": "application/json"} if data else {}
+    req = urllib.request.Request(url, data=data, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def server_reachable(base_url):
+    try:
+        get_json(base_url, "/queue", timeout=3)
+        return True
+    except Exception:
+        return False
+
+
+def wait_for_server(base_url, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if server_reachable(base_url):
+            return True
+        time.sleep(1)
+    return False
+
+
+def start_port_forward(namespace, deployment, local_port, remote_port=8181):
+    cmd = ["kubectl", "port-forward", "-n", namespace, f"deploy/{deployment}",
+           f"{local_port}:{remote_port}", "--address", "127.0.0.1"]
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def url_port(url, default=8181):
+    return urllib.parse.urlparse(url).port or default
+
+
+# ------------------------------------------------------------------ api steps
+def loader_options(info, node, field):
+    spec = info.get(node, {}).get("input", {}).get("required", {}).get(field)
+    if isinstance(spec, list) and spec and isinstance(spec[0], list):
+        return spec[0]
+    return spec or []
+
+
+def preflight(base_url, unet, clip, vae):
+    info = get_json(base_url, "/object_info", timeout=30)
+    missing = []
+    for label, name, node, field in (("UNET", unet, "UNETLoader", "unet_name"),
+                                     ("CLIP", clip, "CLIPLoader", "clip_name"),
+                                     ("VAE", vae, "VAELoader", "vae_name")):
+        if name not in loader_options(info, node, field):
+            missing.append(f"{label}: {name}")
+    if missing:
+        raise RuntimeError("Missing model files on server: " + ", ".join(missing))
+    return info
+
+
+def submit(base_url, graph, client_id):
+    try:
+        resp = get_json(base_url, "/prompt",
+                        payload={"prompt": graph, "client_id": client_id})
+    except urllib.error.HTTPError as e:
+        # surface the server's JSON error body, not just "400 Bad Request"
+        try:
+            detail = json.loads(e.read().decode()).get("error", "")
+        except Exception:
+            detail = ""
+        raise RuntimeError(f"Server rejected graph ({e.code}): "
+                           f"{detail or e.reason}") from None
+    if "error" in resp:
+        raise RuntimeError(f"Server rejected graph: {resp['error']}")
+    if "prompt_id" not in resp:
+        raise RuntimeError(f"Unexpected /prompt response: {resp}")
+    return resp["prompt_id"]
+
+
+def wait_for_result(base_url, prompt_id, timeout=3600, poll=5):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        hist = get_json(base_url, f"/history/{prompt_id}", timeout=30)
+        entry = hist.get(prompt_id)
+        if entry and entry.get("status", {}).get("completed"):
+            status = entry["status"]
+            if status.get("status_str") != "success":
+                msgs = ", ".join(status.get("messages") or [])
+                raise RuntimeError(f"Generation failed: {msgs or status}")
+            return entry
+        time.sleep(poll)
+    raise TimeoutError(f"Timed out waiting for prompt {prompt_id}")
+
+
+def result_files(entry):
+    files = []
+    for node_output in (entry.get("outputs") or {}).values():
+        for kind in ("images", "videos", "gifs"):
+            for item in node_output.get(kind) or []:
+                if isinstance(item, dict) and "filename" in item:
+                    files.append(item)
+    return files
+
+
+def download(base_url, file_info, dest_dir: Path) -> Path:
+    params = urllib.parse.urlencode({
+        "filename": file_info["filename"],
+        "subfolder": file_info.get("subfolder", ""),
+        "type": file_info.get("type", "output")})
+    url = urllib.parse.urljoin(base_url, "/view") + "?" + params
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    dest = dest_dir / file_info["filename"]
+    with urllib.request.urlopen(url, timeout=120) as resp:
+        dest.write_bytes(resp.read())
+    return dest
+
+
+def write_gallery(dest_dir: Path, prompt, paths):
+    rows = []
+    for p in paths:
+        if p.suffix.lower() in (".webm", ".mp4"):
+            rows.append(f'<div><video controls src="{p.name}" '
+                        'style="max-width:100%"></video></div>')
+        else:
+            rows.append(f'<div><img src="{p.name}" style="max-width:100%"></div>')
+    html = ("<!doctype html><html><head><meta charset='utf-8'>"
+            "<title>Wan T2V outputs</title></head><body>"
+            f"<h1>Prompt</h1><p>{prompt}</p>" + "\n".join(rows)
+            + "</body></html>")
+    (dest_dir / "index.html").write_text(html, encoding="utf-8")
+
+
+# ------------------------------------------------------------------------ main
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Generate Wan text-to-video outputs on the TPU graph server.")
+    ap.add_argument("--prompt", required=True, help="Text prompt.")
+    ap.add_argument("--negative", default="blurry, low quality, artifacts")
+    ap.add_argument("--count", type=int, default=5,
+                    help="Number of outputs to generate.")
+    ap.add_argument("--width", type=int, default=512)
+    ap.add_argument("--height", type=int, default=320)
+    ap.add_argument("--frames", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=25)
+    ap.add_argument("--cfg", type=float, default=6.0)
+    ap.add_argument("--sampler", default="uni_pc")
+    ap.add_argument("--scheduler", default="simple")
+    ap.add_argument("--denoise", type=float, default=1.0)
+    ap.add_argument("--mode", choices=["video", "image"], default="video")
+    ap.add_argument("--format", choices=["webm", "webp", "both"], default="webm")
+    ap.add_argument("--server-url", "--comfy-url", dest="server_url",
+                    default="http://127.0.0.1:8181")
+    ap.add_argument("--output-dir", default="generated")
+    ap.add_argument("--seed", type=int, default=None, help="Base seed.")
+    ap.add_argument("--port-forward", action="store_true",
+                    help="Start kubectl port-forward automatically.")
+    ap.add_argument("--namespace", default="llm")
+    ap.add_argument("--deployment", default="wan-video-gen")
+    ap.add_argument("--skip-check", action="store_true",
+                    help="Skip model presence preflight.")
+    ap.add_argument("--unet", default=DEFAULT_UNET)
+    ap.add_argument("--clip", default=DEFAULT_CLIP)
+    ap.add_argument("--vae", default=DEFAULT_VAE)
+    args = ap.parse_args(argv)
+
+    want_webm = args.mode == "video" and args.format in ("webm", "both")
+    want_webp = args.mode == "video" and args.format in ("webp", "both")
+    want_images = args.mode == "image"
+    frames = 1 if args.mode == "image" else args.frames
+
+    rng = random.SystemRandom()
+    seeds = [rng.randrange(0, 2**63) if args.seed is None else args.seed + i
+             for i in range(args.count)]
+    run_dir = (Path(args.output_dir).expanduser().resolve()
+               / datetime.now().strftime("%Y%m%d_%H%M%S"))
+    run_dir.mkdir(parents=True, exist_ok=True)
+
+    pf_proc = None
+    saved = []
+    try:
+        if not server_reachable(args.server_url):
+            if not args.port_forward:
+                raise RuntimeError(
+                    "Server not reachable. Use --port-forward or --server-url.")
+            pf_proc = start_port_forward(args.namespace, args.deployment,
+                                         url_port(args.server_url))
+            if not wait_for_server(args.server_url):
+                raise RuntimeError("Port-forward up but server unreachable.")
+
+        info = None
+        if not args.skip_check:
+            info = preflight(args.server_url, args.unet, args.clip, args.vae)
+        if want_webm and info is not None and "SaveWEBM" not in info:
+            print("note: server has no WebM encoder; falling back to "
+                  "animated WebP")
+            want_webm, want_webp = False, True
+
+        client_id = f"cli-{rng.randrange(0, 1_000_000)}"
+        for i, seed in enumerate(seeds, start=1):
+            prefix = ("wan_t2v" if args.mode == "video" else "wan_t2i") + f"_{i:02d}"
+            graph = build_graph(
+                prompt=args.prompt, negative=args.negative, seed=seed,
+                width=args.width, height=args.height, frames=frames,
+                steps=args.steps, cfg=args.cfg, sampler=args.sampler,
+                scheduler=args.scheduler, denoise=args.denoise,
+                unet_name=args.unet, clip_name=args.clip, vae_name=args.vae,
+                filename_prefix=prefix, save_webm=want_webm,
+                save_webp=want_webp, save_images=want_images)
+            print(f"[{i}/{args.count}] queueing (seed={seed})...")
+            pid = submit(args.server_url, graph, client_id)
+            entry = wait_for_result(args.server_url, pid)
+            files = result_files(entry)
+            if not files:
+                raise RuntimeError("No output files in history response.")
+            for f in files:
+                dest = download(args.server_url, f, run_dir)
+                saved.append(dest)
+                print(f"  saved: {dest}")
+    finally:
+        if pf_proc is not None:
+            pf_proc.terminate()
+            try:
+                pf_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pf_proc.kill()
+
+    if saved:
+        write_gallery(run_dir, args.prompt, saved)
+        print(f"\nDone. Open {run_dir / 'index.html'} to view results.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

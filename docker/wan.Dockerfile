@@ -1,0 +1,16 @@
+# ghcr.io/tpustack/wan-server — the Wan T2V graph-serving image.
+#
+# Replaces the out-of-band ComfyUI server the reference's batch client drives
+# (/root/reference/cluster-config/apps/llm/scripts/generate_wan_t2v.py:320
+# targets a `wan-video-gen` deployment its repo never ships, SURVEY.md §2.6).
+# ffmpeg is installed so the SaveWEBM graph node (vp9) is available; without
+# it the server simply does not advertise SaveWEBM and clients fall back to
+# animated WebP.
+FROM ghcr.io/tpustack/jax-tpu:0.1.0
+
+RUN apt-get update && apt-get install -y --no-install-recommends ffmpeg \
+    && rm -rf /var/lib/apt/lists/*
+
+EXPOSE 8181
+ENV PORT=8181 WAN_PRESET=wan_1_3b WAN_MODELS_DIR=/models WAN_OUTPUT_DIR=/outputs
+CMD ["-m", "tpustack.serving.graph_server"]

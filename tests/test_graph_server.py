@@ -1,0 +1,218 @@
+"""Graph server contract tests — the exact API surface the reference client
+drives (reference ``generate_wan_t2v.py``: /queue, /object_info, /prompt,
+/history/<id>, /view), executed end-to-end with THIS repo's client module."""
+
+import asyncio
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLIENT_PATH = os.path.join(REPO_ROOT, "cluster-config", "apps", "llm",
+                           "scripts", "generate_wan_t2v.py")
+
+
+def load_client():
+    spec = importlib.util.spec_from_file_location("wan_client", CLIENT_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+client_mod = load_client()
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from tpustack.models.wan import WanConfig, WanPipeline
+    from tpustack.serving.graph_server import GraphServer, WanRuntime
+
+    out = tmp_path_factory.mktemp("wan-out")
+    models = tmp_path_factory.mktemp("wan-models")
+    rt = WanRuntime(models_dir=str(models), output_dir=str(out),
+                    pipeline=WanPipeline(WanConfig.tiny()))
+    srv = GraphServer(runtime=rt)
+    yield srv
+    srv.shutdown()
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _tiny_graph(**kw):
+    defaults = dict(prompt="a panda", negative="blurry", seed=3, width=32,
+                    height=32, frames=5, steps=1, cfg=6.0, sampler="uni_pc",
+                    scheduler="simple", denoise=1.0, save_webp=True)
+    defaults.update(kw)
+    return client_mod.build_graph(**defaults)
+
+
+async def _submit_and_wait(http, graph, timeout=300):
+    r = await http.post("/prompt", json={"prompt": graph, "client_id": "t"})
+    assert r.status == 200, await r.text()
+    pid = (await r.json())["prompt_id"]
+    for _ in range(timeout * 2):
+        r = await http.get(f"/history/{pid}")
+        hist = await r.json()
+        if pid in hist and hist[pid]["status"]["completed"]:
+            return pid, hist[pid]
+        await asyncio.sleep(0.5)
+    raise TimeoutError("prompt never completed")
+
+
+def test_object_info_advertises_canonical_models(server):
+    """Zero-egress mode still passes the reference client's preflight
+    (generate_wan_t2v.py:204-221 checks these exact names)."""
+    info = server.executor.object_info()
+    assert client_mod.DEFAULT_UNET in client_mod.loader_options(
+        info, "UNETLoader", "unet_name")
+    assert client_mod.DEFAULT_CLIP in client_mod.loader_options(
+        info, "CLIPLoader", "clip_name")
+    assert client_mod.DEFAULT_VAE in client_mod.loader_options(
+        info, "VAELoader", "vae_name")
+    # no ffmpeg in the dev image → SaveWEBM must NOT be advertised
+    from tpustack.serving.graph_server import _ffmpeg
+
+    assert ("SaveWEBM" in info) == (_ffmpeg() is not None)
+
+
+def test_models_dir_discovery(tmp_path):
+    from tpustack.serving.graph_server import WanRuntime
+
+    d = tmp_path / "diffusion_models"
+    d.mkdir()
+    (d / "custom_model.safetensors").write_bytes(b"x")
+    rt = WanRuntime(models_dir=str(tmp_path), output_dir=str(tmp_path / "o"))
+    assert rt.unet_names() == ["custom_model.safetensors"]
+
+
+def test_submit_rejects_unknown_node(server):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        http = TestClient(TestServer(server.build_app()))
+        await http.start_server()
+        try:
+            r = await http.post("/prompt", json={
+                "prompt": {"1": {"class_type": "EvilNode", "inputs": {}}}})
+            assert r.status == 400
+            assert "EvilNode" in (await r.json())["error"]
+            r = await http.post("/prompt", json={})
+            assert r.status == 400
+        finally:
+            await http.close()
+
+    _run(scenario())
+
+
+def test_e2e_webp_and_image_graphs(server):
+    """Full client-vs-server loop: queue → submit → poll → download."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        http = TestClient(TestServer(server.build_app()))
+        await http.start_server()
+        try:
+            r = await http.get("/queue")  # the client's reachability probe
+            assert r.status == 200
+            q = await r.json()
+            assert "queue_running" in q and "queue_pending" in q
+
+            # animated-WebP video graph (the ffmpeg-less default path)
+            pid, entry = await _submit_and_wait(http, _tiny_graph())
+            files = client_mod.result_files(entry)
+            assert len(files) == 1 and files[0]["filename"].endswith(".webp")
+            r = await http.get("/view", params={
+                "filename": files[0]["filename"], "subfolder": "",
+                "type": "output"})
+            assert r.status == 200
+            body = await r.read()
+            assert body[:4] == b"RIFF" and body[8:12] == b"WEBP"
+
+            # image-mode graph → one PNG per frame (frames=1 here)
+            pid, entry = await _submit_and_wait(
+                http, _tiny_graph(frames=1, save_webp=False, save_images=True))
+            files = client_mod.result_files(entry)
+            assert len(files) == 1 and files[0]["filename"].endswith(".png")
+            r = await http.get("/view", params={
+                "filename": files[0]["filename"], "subfolder": "",
+                "type": "output"})
+            assert (await r.read())[:8] == b"\x89PNG\r\n\x1a\n"
+
+            # unknown history id → empty object (client treats as pending)
+            r = await http.get("/history/nope")
+            assert await r.json() == {}
+        finally:
+            await http.close()
+
+    _run(scenario())
+
+
+def test_graph_failure_surfaces_in_history(server):
+    """Node-level errors must land in status.messages, not crash the worker
+    (the client raises them as 'Generation failed: …')."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        http = TestClient(TestServer(server.build_app()))
+        await http.start_server()
+        try:
+            graph = _tiny_graph()
+            graph["unet"]["inputs"]["unet_name"] = "missing.safetensors"
+            pid, entry = await _submit_and_wait(http, graph)
+            assert entry["status"]["status_str"] == "error"
+            assert any("missing.safetensors" in m
+                       for m in entry["status"]["messages"])
+            # worker must still be alive for the next graph
+            pid, entry = await _submit_and_wait(
+                http, _tiny_graph(frames=1, save_webp=False, save_images=True))
+            assert entry["status"]["status_str"] == "success"
+        finally:
+            await http.close()
+
+    _run(scenario())
+
+
+def test_view_stays_inside_output_dir(server):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        http = TestClient(TestServer(server.build_app()))
+        await http.start_server()
+        try:
+            r = await http.get("/view", params={
+                "filename": "../../../etc/passwd", "subfolder": "",
+                "type": "output"})
+            assert r.status == 404
+        finally:
+            await http.close()
+
+    _run(scenario())
+
+
+def test_client_graph_wiring():
+    """The built graph must wire exactly like the reference workflow
+    (loaders → encode ×2 → latent → KSampler → decode → save)."""
+    g = _tiny_graph(save_webm=True, save_images=True)
+    assert g["sample"]["inputs"]["positive"] == ["pos", 0]
+    assert g["sample"]["inputs"]["negative"] == ["neg", 0]
+    assert g["sample"]["inputs"]["latent_image"] == ["latent", 0]
+    assert g["decode"]["inputs"]["samples"] == ["sample", 0]
+    assert g["save_webp"]["inputs"]["images"] == ["decode", 0]
+    assert g["save_webm"]["inputs"]["codec"] == "vp9"
+    assert g["pos"]["inputs"]["text"] == "a panda"
+    assert g["neg"]["inputs"]["text"] == "blurry"
+
+
+def test_client_gallery(tmp_path):
+    paths = [tmp_path / "a.webp", tmp_path / "b.webm"]
+    for p in paths:
+        p.write_bytes(b"x")
+    client_mod.write_gallery(tmp_path, "a panda", paths)
+    html = (tmp_path / "index.html").read_text()
+    assert '<img src="a.webp"' in html
+    assert '<video controls src="b.webm"' in html

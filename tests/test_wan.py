@@ -1,0 +1,147 @@
+"""Wan T2V family tests: components, schedule, fused pipeline.
+
+Mirrors the reference's workload shape (512x320, 16 frames, 25 steps — its
+client defaults, reference ``generate_wan_t2v.py:305-308``) at tiny scale."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpustack.models.wan import WanConfig, WanPipeline
+from tpustack.models.wan.dit import WanDiT, rope_3d
+from tpustack.models.wan.scheduler import (canonical_sampler,
+                                           make_flow_schedule)
+from tpustack.models.wan.tokenizer import T5HashTokenizer
+from tpustack.models.wan.umt5 import UMT5Encoder
+from tpustack.models.wan.vae3d import VAE3DDecoder, VAE3DEncoder
+
+CFG = WanConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    return WanPipeline(CFG)
+
+
+# ----------------------------------------------------------------- components
+def test_latent_shape_math():
+    cfg = WanConfig.wan_1_3b()
+    # 81 frames, 512x320 → (81-1)/4+1=21 latent frames, /8 spatial, z=16
+    assert cfg.latent_shape(81, 320, 512) == (21, 40, 64, 16)
+    with pytest.raises(ValueError):
+        cfg.latent_shape(81, 321, 512)  # not a multiple of 16
+
+
+def test_flow_schedule_shift():
+    s = make_flow_schedule(8, shift=5.0)
+    assert s.sigmas.shape == (9,) and s.timesteps.shape == (8,)
+    assert float(s.sigmas[0]) == pytest.approx(1.0)
+    assert float(s.sigmas[-1]) == pytest.approx(0.0)
+    assert np.all(np.diff(np.asarray(s.sigmas)) < 0)  # strictly descending
+    # shift pushes mass toward high noise: midpoint sigma > unshifted 0.5
+    mid = float(s.sigmas[4])
+    assert mid > 0.5
+
+
+def test_sampler_name_compat():
+    # the reference client sends uni_pc (generate_wan_t2v.py:310)
+    assert canonical_sampler("uni_pc") == "heun"
+    assert canonical_sampler("euler") == "euler"
+    assert canonical_sampler("whatever") == "euler"
+
+
+def test_umt5_masking():
+    enc = UMT5Encoder(CFG.text)
+    ids = jnp.ones((2, CFG.text.max_length), jnp.int32)
+    mask = jnp.asarray(np.tile(np.arange(CFG.text.max_length) < 5, (2, 1)))
+    params = enc.init(jax.random.PRNGKey(0), ids, mask)["params"]
+    out = enc.apply({"params": params}, ids, mask)
+    assert out.shape == (2, CFG.text.max_length, CFG.text.dim)
+    # padding positions are zeroed so cross-attention sees clean context
+    assert np.allclose(np.asarray(out[:, 5:]), 0.0)
+    assert not np.allclose(np.asarray(out[:, :5]), 0.0)
+
+
+def test_vae3d_shapes_roundtrip():
+    cfg = CFG.vae
+    enc, dec = VAE3DEncoder(cfg), VAE3DDecoder(cfg)
+    # 9 pixel frames → (9-1)/4+1 = 3 latent frames; 32x32 → 4x4
+    x = jnp.zeros((1, 9, 32, 32, 3))
+    pe = enc.init(jax.random.PRNGKey(0), x)["params"]
+    dist = enc.apply({"params": pe}, x)
+    assert dist.shape == (1, 3, 4, 4, 2 * cfg.z_channels)
+    z = dist[..., : cfg.z_channels]
+    pd = dec.init(jax.random.PRNGKey(1), z)["params"]
+    out = dec.apply({"params": pd}, z)
+    assert out.shape == (1, 9, 32, 32, 3)
+    assert np.all(np.abs(np.asarray(out)) <= 1.0)  # tanh range
+
+
+def test_vae3d_temporal_causality():
+    """Frame t of the encoding must not depend on frames > t."""
+    cfg = CFG.vae
+    enc = VAE3DEncoder(cfg)
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (1, 9, 16, 16, 3))
+    params = enc.init(jax.random.PRNGKey(0), x)["params"]
+    base = np.asarray(enc.apply({"params": params}, x))
+    # perturb ONLY the last 4 pixel frames → first latent frame (from pixel
+    # frame 0, temporal scale 4) must be bit-identical
+    x2 = x.at[:, 5:].set(jax.random.normal(jax.random.PRNGKey(3), (1, 4, 16, 16, 3)))
+    pert = np.asarray(enc.apply({"params": params}, x2))
+    np.testing.assert_array_equal(base[:, 0], pert[:, 0])
+    assert not np.array_equal(base[:, -1], pert[:, -1])
+
+
+def test_dit_shapes_and_rope():
+    cfg = CFG.dit
+    head_dim = cfg.dim // cfg.num_heads
+    cos, sin = rope_3d((2, 4, 4), head_dim)
+    assert cos.shape == (32, head_dim // 2) and sin.shape == cos.shape
+
+    dit = WanDiT(cfg)
+    lat = jnp.zeros((2, 2, 8, 8, cfg.in_channels))
+    t = jnp.zeros((2,), jnp.float32)
+    text = jnp.zeros((2, 8, cfg.text_dim))
+    params = dit.init(jax.random.PRNGKey(0), lat, t, text)["params"]
+    out = dit.apply({"params": params}, lat, t, text)
+    assert out.shape == (2, 2, 8, 8, cfg.out_channels)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_tokenizer_framing():
+    tok = T5HashTokenizer(vocab_size=512, max_length=8)
+    ids, mask = tok(["a panda", ""])
+    assert ids.shape == (2, 8) and mask.shape == (2, 8)
+    assert ids[0, 2] == 1 and mask[0, :3].all() and not mask[0, 3:].any()  # EOS
+    assert ids[1, 0] == 1 and mask[1, 0] and not mask[1, 1:].any()  # empty → EOS
+    ids2, _ = tok(["a panda"])
+    np.testing.assert_array_equal(ids[0], ids2[0])  # deterministic
+
+
+# ------------------------------------------------------------------- pipeline
+def test_pipeline_generate_and_determinism(pipe):
+    vid, latency = pipe.generate("a panda riding a motorbike", frames=5,
+                                 steps=2, width=32, height=32, seed=7)
+    assert vid.shape == (1, 5, 32, 32, 3) and vid.dtype == np.uint8
+    assert latency > 0
+    vid2, _ = pipe.generate("a panda riding a motorbike", frames=5, steps=2,
+                            width=32, height=32, seed=7)
+    np.testing.assert_array_equal(vid, vid2)
+    vid3, _ = pipe.generate("a panda riding a motorbike", frames=5, steps=2,
+                            width=32, height=32, seed=8)
+    assert not np.array_equal(vid, vid3)
+
+
+def test_pipeline_frame_floor_convention(pipe):
+    # ComfyUI convention: 16 requested → 13 delivered (1 + 4·⌊15/4⌋);
+    # the reference behaves identically through its VAE
+    vid, _ = pipe.generate("x", frames=16, steps=1, width=32, height=32, seed=0)
+    assert vid.shape[1] == 13
+
+
+def test_pipeline_image_mode(pipe):
+    # frames=1 → single frame (the client's --mode image path)
+    vid, _ = pipe.generate("x", frames=1, steps=1, width=32, height=32, seed=0)
+    assert vid.shape[1] == 1

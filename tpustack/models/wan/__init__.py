@@ -1,0 +1,14 @@
+"""Wan2.1-class text-to-video family (TPU-native).
+
+Replaces the out-of-band ComfyUI + ``wan2.1_t2v_1.3B_bf16`` stack the
+reference's batch client drives (reference
+``cluster-config/apps/llm/scripts/generate_wan_t2v.py``, SURVEY.md §2.6) —
+which the reference never actually ships a server or model for.
+"""
+
+from tpustack.models.wan.config import (UMT5Config, WanConfig, WanDiTConfig,
+                                        WanVAEConfig)
+from tpustack.models.wan.pipeline import WanPipeline
+
+__all__ = ["WanConfig", "WanDiTConfig", "WanVAEConfig", "UMT5Config",
+           "WanPipeline"]

@@ -1,0 +1,116 @@
+"""Wan2.1-class text-to-video model configuration.
+
+The reference drives a Wan2.1 1.3B T2V ComfyUI graph from its batch client
+(reference ``cluster-config/apps/llm/scripts/generate_wan_t2v.py:347-349``:
+``wan2.1_t2v_1.3B_bf16.safetensors`` + ``umt5_xxl_fp16`` + wan VAE) but never
+ships the server or model code — the target ``wan-video-gen`` deployment does
+not exist in its manifests (SURVEY.md §2.6).  This package supplies the whole
+family TPU-natively: a UMT5 text encoder, a causal 3D VAE, a space-time DiT
+denoiser, and a flow-matching sampler, all sized to the real Wan2.1 1.3B
+dimensions so the serving shape (512x320, 16 frames, 25 steps — reference
+client defaults, ``generate_wan_t2v.py:305-308``) is the default workload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class UMT5Config:
+    """UMT5 encoder (google/umt5-xxl shape for the real checkpoint)."""
+
+    vocab_size: int = 256384
+    dim: int = 4096
+    ffn_dim: int = 10240
+    num_heads: int = 64
+    head_dim: int = 64
+    num_layers: int = 24
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    max_length: int = 512
+    dropout: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class WanVAEConfig:
+    """Causal 3D video VAE: 8x spatial, 4x temporal compression, z=16."""
+
+    z_channels: int = 16
+    base_channels: int = 96
+    channel_mults: Tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    # temporal downsampling happens at the first len(temporal_downsample)
+    # spatial downsamples that are marked True (Wan: 4x = two 2x stages)
+    temporal_downsample: Tuple[bool, ...] = (False, True, True)
+    scaling_factor: float = 1.0
+
+    @property
+    def spatial_scale(self) -> int:
+        return 2 ** (len(self.channel_mults) - 1)
+
+    @property
+    def temporal_scale(self) -> int:
+        return 2 ** sum(self.temporal_downsample)
+
+
+@dataclasses.dataclass(frozen=True)
+class WanDiTConfig:
+    """Space-time diffusion transformer (Wan2.1 1.3B shape)."""
+
+    dim: int = 1536
+    ffn_dim: int = 8960
+    num_heads: int = 12
+    num_layers: int = 30
+    in_channels: int = 16
+    out_channels: int = 16
+    text_dim: int = 4096
+    freq_dim: int = 256
+    patch_size: Tuple[int, int, int] = (1, 2, 2)  # (frames, h, w)
+    qk_norm: bool = True
+    eps: float = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class WanConfig:
+    text: UMT5Config
+    vae: WanVAEConfig
+    dit: WanDiTConfig
+    # flow-matching timestep shift; video models push sigmas toward the
+    # high-noise end (Wan T2V default 5.0 ≙ ComfyUI "simple" + ModelSampling shift)
+    flow_shift: float = 5.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def wan_1_3b() -> "WanConfig":
+        return WanConfig(text=UMT5Config(), vae=WanVAEConfig(), dit=WanDiTConfig())
+
+    @staticmethod
+    def tiny() -> "WanConfig":
+        """Shape-preserving miniature for tests/CI (CPU-friendly)."""
+        return WanConfig(
+            text=UMT5Config(vocab_size=512, dim=32, ffn_dim=64, num_heads=2,
+                            head_dim=16, num_layers=2, max_length=16),
+            vae=WanVAEConfig(z_channels=4, base_channels=8,
+                             channel_mults=(1, 2, 4, 4), num_res_blocks=1,
+                             temporal_downsample=(False, True, True)),
+            dit=WanDiTConfig(dim=32, ffn_dim=64, num_heads=2, num_layers=2,
+                             in_channels=4, out_channels=4, text_dim=32,
+                             freq_dim=32),
+            flow_shift=5.0,
+            compute_dtype=jnp.float32,
+        )
+
+    def latent_shape(self, frames: int, height: int, width: int) -> Tuple[int, int, int, int]:
+        """[F', H', W', C] latent shape for a pixel-space request."""
+        ts, ss = self.vae.temporal_scale, self.vae.spatial_scale
+        if (frames - 1) % ts:
+            raise ValueError(f"frames must be 1 + multiple of {ts}, got {frames}")
+        if height % (ss * self.dit.patch_size[1]) or width % (ss * self.dit.patch_size[2]):
+            raise ValueError(
+                f"height/width must be multiples of {ss * self.dit.patch_size[1]}")
+        return ((frames - 1) // ts + 1, height // ss, width // ss,
+                self.vae.z_channels)

@@ -1,0 +1,196 @@
+"""Space-time diffusion transformer (Wan2.1-class T2V denoiser).
+
+TPU-native counterpart of the ``wan2.1_t2v_1.3B_bf16.safetensors`` UNET the
+reference loads via ComfyUI's UNETLoader (reference
+``generate_wan_t2v.py:36-41,347``).  This is a DiT, not a UNet: the 3D latent
+is patchified to one flat token stream (frames × H/2 × W/2 tokens), processed
+by ``num_layers`` blocks of [self-attn over space-time, cross-attn to UMT5
+text, FFN], each modulated by the flow-matching timestep, and unpatchified
+back to a velocity prediction.
+
+The parameterisation matches the released Wan2.1 checkpoints tensor-for-tensor
+(see ``tpustack.models.wan.weights``): one shared ``time_projection`` to six
+modulation vectors plus a learned per-block ``modulation`` offset, biased
+q/k/v/o projections with fp32 RMS q/k-norm, an affine LayerNorm (``norm3``)
+in front of cross-attention, and a plain GELU FFN.
+
+TPU choices:
+- One flat token stream → attention is a handful of *large* matmuls that tile
+  straight onto the MXU; no windowing/no dynamic shapes.
+- 3D axial RoPE (frame/height/width each rotate a slice of the head dim) is
+  precomputed per shape and folded into the jitted program as constants.
+- Residual stream, norms, and modulation run in fp32; matmuls in bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpustack.models.wan.config import WanDiTConfig
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal embedding of continuous t in [0, 1000] → ``[B, dim]``."""
+    half = dim // 2
+    freqs = jnp.exp(-np.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def rope_3d(grid: Tuple[int, int, int], head_dim: int, theta: float = 10000.0):
+    """Axial 3D RoPE tables: cos/sin ``[F*H*W, head_dim//2]``.
+
+    The head dim is split (frames get the remainder — Wan's split) and each
+    slice rotates with its own coordinate.
+    """
+    f, h, w = grid
+    d_h = d_w = 2 * (head_dim // 6)
+    d_f = head_dim - 2 * d_h
+
+    def axis_freqs(n, d):
+        inv = 1.0 / (theta ** (np.arange(0, d, 2, dtype=np.float64) / d))
+        return np.outer(np.arange(n, dtype=np.float64), inv)  # [n, d/2]
+
+    ff = axis_freqs(f, d_f)[:, None, None, :]
+    fh = axis_freqs(h, d_h)[None, :, None, :]
+    fw = axis_freqs(w, d_w)[None, None, :, :]
+    full = np.concatenate([
+        np.broadcast_to(ff, (f, h, w, d_f // 2)),
+        np.broadcast_to(fh, (f, h, w, d_h // 2)),
+        np.broadcast_to(fw, (f, h, w, d_w // 2)),
+    ], axis=-1).reshape(f * h * w, head_dim // 2)
+    return jnp.asarray(np.cos(full), jnp.float32), jnp.asarray(np.sin(full), jnp.float32)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate pairs of channels; ``x`` is ``[B, S, H, D]``, tables ``[S, D/2]``."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c, s = cos[None, :, None, :], sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        out = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + self.eps)
+        return (out * scale).astype(x.dtype)
+
+
+def _attention(q, k, v, heads: int):
+    """BSHD attention with fp32 logits; returns ``[B, S, heads*D]``."""
+    b, s = q.shape[0], q.shape[1]
+    head_dim = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * (head_dim ** -0.5)
+    att = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(b, s, heads * head_dim)
+
+
+class DiTBlock(nn.Module):
+    cfg: WanDiTConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, text, e0, rope):
+        """``e0`` is the shared time projection ``[B, 6, dim]``; each block adds
+        its learned ``modulation`` offset (Wan checkpoint layout)."""
+        c = self.cfg
+        b, s, _ = x.shape
+        head_dim = c.dim // c.num_heads
+        cos, sin = rope
+
+        mod = self.param("modulation", nn.initializers.normal(0.02),
+                         (1, 6, c.dim))
+        e = mod.astype(jnp.float32) + e0
+        sh_sa, sc_sa, g_sa, sh_ff, sc_ff, g_ff = [e[:, i] for i in range(6)]
+
+        def heads(y):
+            return y.reshape(b, -1, c.num_heads, head_dim)
+
+        ln = nn.LayerNorm(use_bias=False, use_scale=False, epsilon=c.eps)
+
+        # --- self-attention over the full space-time token stream
+        h = (ln(x) * (1.0 + sc_sa[:, None]) + sh_sa[:, None]).astype(self.dtype)
+        q = heads(nn.Dense(c.dim, dtype=self.dtype, name="q")(h))
+        k = heads(nn.Dense(c.dim, dtype=self.dtype, name="k")(h))
+        v = heads(nn.Dense(c.dim, dtype=self.dtype, name="v")(h))
+        if c.qk_norm:
+            q = RMSNorm(name="q_norm")(q)
+            k = RMSNorm(name="k_norm")(k)
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        o = nn.Dense(c.dim, dtype=self.dtype, name="o")(_attention(q, k, v, c.num_heads))
+        x = x + g_sa[:, None] * o.astype(jnp.float32)
+
+        # --- cross-attention to UMT5 text (affine norm3, no RoPE, no gate)
+        h = nn.LayerNorm(epsilon=c.eps, name="norm3")(x).astype(self.dtype)
+        q = heads(nn.Dense(c.dim, dtype=self.dtype, name="xq")(h))
+        k = heads(nn.Dense(c.dim, dtype=self.dtype, name="xk")(text))
+        v = heads(nn.Dense(c.dim, dtype=self.dtype, name="xv")(text))
+        if c.qk_norm:
+            q = RMSNorm(name="xq_norm")(q)
+            k = RMSNorm(name="xk_norm")(k)
+        o = nn.Dense(c.dim, dtype=self.dtype, name="xo")(_attention(q, k, v, c.num_heads))
+        x = x + o.astype(jnp.float32)
+
+        # --- FFN (plain GELU-tanh, Wan style)
+        h = (ln(x) * (1.0 + sc_ff[:, None]) + sh_ff[:, None]).astype(self.dtype)
+        h = nn.Dense(c.ffn_dim, dtype=self.dtype, name="ffn_in")(h)
+        h = nn.Dense(c.dim, dtype=self.dtype, name="ffn_out")(nn.gelu(h, approximate=True))
+        return x + g_ff[:, None] * h.astype(jnp.float32)
+
+
+class WanDiT(nn.Module):
+    """(latent ``[B,F,H,W,C]``, t ``[B]``, text ``[B,L,text_dim]``) → velocity."""
+
+    cfg: WanDiTConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, latent, t, text):
+        c = self.cfg
+        b, f, hh, ww, _ = latent.shape
+        pf, ph, pw = c.patch_size
+        grid = (f // pf, hh // ph, ww // pw)
+
+        x = nn.Conv(c.dim, kernel_size=c.patch_size, strides=c.patch_size,
+                    dtype=self.dtype, name="patch_embed")(latent.astype(self.dtype))
+        x = x.reshape(b, grid[0] * grid[1] * grid[2], c.dim).astype(jnp.float32)
+
+        # shared time embedding + projection to 6 modulation vectors
+        t_emb = timestep_embedding(t, c.freq_dim)
+        t_emb = nn.Dense(c.dim, dtype=jnp.float32, name="t_proj_1")(t_emb)
+        t_emb = nn.Dense(c.dim, dtype=jnp.float32, name="t_proj_2")(nn.silu(t_emb))
+        e0 = nn.Dense(6 * c.dim, dtype=jnp.float32, name="time_proj")(
+            nn.silu(t_emb)).reshape(b, 6, c.dim)
+
+        text = nn.Dense(c.dim, dtype=self.dtype, name="text_proj_1")(
+            text.astype(self.dtype))
+        text = nn.Dense(c.dim, dtype=self.dtype, name="text_proj_2")(
+            nn.gelu(text, approximate=True))
+
+        rope = rope_3d(grid, c.dim // c.num_heads)
+        for i in range(c.num_layers):
+            x = DiTBlock(c, dtype=self.dtype, name=f"block_{i}")(x, text, e0, rope)
+
+        # head: its own 2-vector modulation offset over the *time embedding*
+        head_mod = self.param("head_modulation", nn.initializers.normal(0.02),
+                              (1, 2, c.dim))
+        e = head_mod.astype(jnp.float32) + t_emb[:, None]
+        shift, scale = e[:, 0], e[:, 1]
+        x = nn.LayerNorm(use_bias=False, use_scale=False, epsilon=c.eps)(x)
+        x = x * (1.0 + scale[:, None]) + shift[:, None]
+        x = nn.Dense(pf * ph * pw * c.out_channels, dtype=jnp.float32,
+                     kernel_init=nn.initializers.zeros, name="unpatch")(x)
+
+        x = x.reshape(b, *grid, pf, ph, pw, c.out_channels)
+        x = jnp.einsum("bfhwpqrc->bfphqwrc", x)  # interleave patch dims
+        return x.reshape(b, f, hh, ww, c.out_channels)

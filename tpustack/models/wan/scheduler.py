@@ -1,0 +1,53 @@
+"""Flow-matching sampling schedule for the Wan T2V family.
+
+The reference's KSampler runs ``sampler_name: uni_pc, scheduler: simple``
+over a flow-matching video model (reference ``generate_wan_t2v.py:81-94,
+310-312``).  TPU-native equivalents:
+
+- ``simple`` schedule: uniform sigmas in (1, 0] warped by the video timestep
+  shift ``σ' = s·σ / (1 + (s-1)·σ)`` (Wan T2V uses s=5 — high-noise heavy).
+- Samplers: ``euler`` (1st order) and ``heun`` (2nd order, 2 NFE/step).
+  ComfyUI sampler names map onto these (``uni_pc``/``dpmpp_2m`` → ``heun``,
+  everything else → ``euler``) so reference client invocations run unchanged;
+  the mapping is logged by the graph server.
+
+Rectified-flow convention: ``x_σ = (1-σ)·x₀ + σ·ε``; the model predicts the
+velocity ``v = ε - x₀``, and a step is ``x ← x + (σ_next - σ)·v``.  Timesteps
+fed to the DiT are ``σ·1000``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class FlowSchedule(NamedTuple):
+    sigmas: jnp.ndarray     # [steps + 1], descending, sigmas[-1] == 0
+    timesteps: jnp.ndarray  # [steps], sigma * 1000 (DiT conditioning)
+
+
+def make_flow_schedule(num_steps: int, shift: float = 5.0) -> FlowSchedule:
+    sig = jnp.linspace(1.0, 0.0, num_steps + 1)
+    sig = shift * sig / (1.0 + (shift - 1.0) * sig)
+    return FlowSchedule(sigmas=sig, timesteps=sig[:-1] * 1000.0)
+
+
+def euler_step(i, x, v, sched: FlowSchedule):
+    dt = sched.sigmas[i + 1] - sched.sigmas[i]
+    return x + dt * v
+
+
+def heun_step(i, x, v, v_next, sched: FlowSchedule):
+    """Trapezoidal correction using the velocity at the predicted endpoint."""
+    dt = sched.sigmas[i + 1] - sched.sigmas[i]
+    return x + dt * 0.5 * (v + v_next)
+
+
+# ComfyUI sampler-name compatibility (reference client sends "uni_pc")
+_SECOND_ORDER = {"uni_pc", "uni_pc_bh2", "heun", "dpmpp_2m", "dpmpp_2m_sde"}
+
+
+def canonical_sampler(name: str) -> str:
+    return "heun" if name in _SECOND_ORDER else "euler"

@@ -1,0 +1,78 @@
+"""UMT5 prompt tokenization for the Wan family.
+
+Real checkpoints use the umt5-xxl SentencePiece tokenizer; in-cluster it is
+loaded from HF files cached on the PVC (same pattern as the reference's HF
+cache env, reference ``cluster-config/apps/sd15-api/deployment.yaml:49-50``).
+Zero-egress fallback: a deterministic hash tokenizer with T5 framing
+(ids… EOS, pad 0) — same shapes and masks, stable ids, clearly logged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from tpustack.utils import get_logger
+
+log = get_logger("models.wan.tokenizer")
+
+PAD_ID = 0
+EOS_ID = 1
+_WORD_RE = re.compile(r"[a-z0-9]+|[^\sa-z0-9]")
+
+
+class T5HashTokenizer:
+    """Word→id hashing with T5 ``ids… EOS pad…`` framing + attention mask."""
+
+    def __init__(self, vocab_size: int, max_length: int):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+
+    def _ids(self, text: str) -> List[int]:
+        words = _WORD_RE.findall(text.lower())
+        out = []
+        for w in words:
+            h = int.from_bytes(hashlib.sha1(w.encode()).digest()[:4], "little")
+            out.append(2 + h % (self.vocab_size - 2))  # keep 0/1 special
+        return out
+
+    def __call__(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        ids = np.full((len(texts), self.max_length), PAD_ID, np.int32)
+        mask = np.zeros((len(texts), self.max_length), bool)
+        for i, t in enumerate(texts):
+            toks = (self._ids(t) + [EOS_ID])[: self.max_length]
+            ids[i, : len(toks)] = toks
+            mask[i, : len(toks)] = True
+        return ids, mask
+
+
+class HFT5Tokenizer:
+    def __init__(self, tok, max_length: int):
+        self._tok = tok
+        self.max_length = max_length
+
+    def __call__(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        enc = self._tok(list(texts), padding="max_length", truncation=True,
+                        max_length=self.max_length, return_tensors="np")
+        return (enc["input_ids"].astype(np.int32),
+                enc["attention_mask"].astype(bool))
+
+
+def load_tokenizer(vocab_size: int, max_length: int):
+    tok_dir = os.environ.get("WAN_TOKENIZER_DIR", "")
+    if tok_dir:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(tok_dir)
+            log.info("Loaded UMT5 tokenizer from %s", tok_dir)
+            return HFT5Tokenizer(tok, max_length)
+        except Exception as e:  # noqa: BLE001 — fall back, but say why
+            log.warning("WAN_TOKENIZER_DIR=%s unusable (%s); hash fallback",
+                        tok_dir, e)
+    log.warning("Using deterministic HASH tokenizer (not the umt5 vocab)")
+    return T5HashTokenizer(vocab_size, max_length)

@@ -1,0 +1,150 @@
+"""Causal 3D video VAE (Wan-class): 8x spatial, 4x temporal, z=16.
+
+The reference's graph decodes video latents with ``wan_2.1_vae.safetensors``
+via ComfyUI's VAELoader/VAEDecode nodes (reference
+``generate_wan_t2v.py:52-56,95-101``).  TPU-native rewrite as a Flax module:
+
+- **Causal temporal convs** — every 3D conv pads time on the left only; norms are channel-wise RMS (GroupNorm would mix
+  statistics across frames and break causality), so
+  frame ``t`` never sees ``t+1``; the first frame is self-contained, which is
+  what makes ``F = 1 + 4k`` video/image-joint latents work.
+- **Static shapes** end-to-end: temporal up/downsampling uses stride-2 convs
+  and ``repeat+trim`` (``F → 2F-1``), so encode(decode(z)) round-trips shapes
+  exactly and XLA sees a fixed program per (F, H, W).
+- Channels-last ``[B, F, H, W, C]`` everywhere (TPU conv layout).
+
+Frame counts follow the ComfyUI convention: pixel frames ``F`` map to
+``(F-1)//4 + 1`` latent frames; decode returns ``1 + 4*(F'-1)`` frames.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpustack.models.wan.config import WanVAEConfig
+from tpustack.models.wan.dit import RMSNorm
+
+
+class CausalConv3D(nn.Module):
+    """3D conv, SAME spatial padding, causal (left-only) temporal padding."""
+
+    features: int
+    kernel: Tuple[int, int, int] = (3, 3, 3)
+    temporal_stride: int = 1
+    spatial_stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kt, kh, kw = self.kernel
+        pad = [(kt - 1, 0), ((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)]
+        return nn.Conv(
+            self.features, self.kernel,
+            strides=(self.temporal_stride, self.spatial_stride, self.spatial_stride),
+            padding=pad, dtype=self.dtype)(x)
+
+
+class ResBlock3D(nn.Module):
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = RMSNorm(name="norm_1")(x)
+        h = CausalConv3D(self.features, dtype=self.dtype)(nn.silu(h))
+        h = RMSNorm(name="norm_2")(h)
+        h = CausalConv3D(self.features, dtype=self.dtype)(nn.silu(h))
+        if x.shape[-1] != self.features:
+            x = nn.Dense(self.features, dtype=self.dtype, name="skip")(x)
+        return x + h
+
+
+class SpatialAttnBlock(nn.Module):
+    """Per-frame spatial self-attention at the bottleneck (mid block)."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, f, hh, ww, c = x.shape
+        h = RMSNorm(name="norm")(x)
+        h = h.reshape(b * f, hh * ww, c)
+        q = nn.Dense(c, dtype=self.dtype, name="q")(h)
+        k = nn.Dense(c, dtype=self.dtype, name="k")(h)
+        v = nn.Dense(c, dtype=self.dtype, name="v")(h)
+        logits = jnp.einsum("bqc,bkc->bqk", q, k,
+                            preferred_element_type=jnp.float32) * (c ** -0.5)
+        h = jnp.einsum("bqk,bkc->bqc",
+                       jnp.asarray(nn.softmax(logits, axis=-1), v.dtype), v)
+        h = nn.Dense(c, dtype=self.dtype, name="o")(h).reshape(b, f, hh, ww, c)
+        return x + h
+
+
+def _temporal_upsample(x):
+    """``F → 2F-1`` causal upsample: interleave-repeat then drop the lead dup."""
+    return jnp.repeat(x, 2, axis=1)[:, 1:]
+
+
+def _spatial_upsample(x):
+    return jnp.repeat(jnp.repeat(x, 2, axis=2), 2, axis=3)
+
+
+class VAE3DEncoder(nn.Module):
+    """``[B, F, H, W, 3]`` in [-1, 1] → latent dist params ``[B,F',H',W',2z]``."""
+
+    cfg: WanVAEConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        h = CausalConv3D(c.base_channels, dtype=self.dtype, name="conv_in")(x)
+        for i, mult in enumerate(c.channel_mults):
+            feats = c.base_channels * mult
+            for j in range(c.num_res_blocks):
+                h = ResBlock3D(feats, dtype=self.dtype, name=f"down_{i}_res_{j}")(h)
+            if i < len(c.channel_mults) - 1:
+                ts = 2 if c.temporal_downsample[i] else 1
+                h = CausalConv3D(feats, temporal_stride=ts, spatial_stride=2,
+                                 dtype=self.dtype, name=f"down_{i}_ds")(h)
+        h = ResBlock3D(h.shape[-1], dtype=self.dtype, name="mid_res_0")(h)
+        h = SpatialAttnBlock(dtype=self.dtype, name="mid_attn")(h)
+        h = ResBlock3D(h.shape[-1], dtype=self.dtype, name="mid_res_1")(h)
+        h = RMSNorm(name="norm_out")(h)
+        return CausalConv3D(2 * c.z_channels, kernel=(1, 3, 3),
+                            dtype=self.dtype, name="conv_out")(nn.silu(h))
+
+
+class VAE3DDecoder(nn.Module):
+    """Latents ``[B, F', H', W', z]`` → frames ``[B, F, H, W, 3]`` in [-1, 1]."""
+
+    cfg: WanVAEConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z):
+        c = self.cfg
+        mults = tuple(reversed(c.channel_mults))
+        h = CausalConv3D(c.base_channels * mults[0], dtype=self.dtype,
+                         name="conv_in")(z)
+        h = ResBlock3D(h.shape[-1], dtype=self.dtype, name="mid_res_0")(h)
+        h = SpatialAttnBlock(dtype=self.dtype, name="mid_attn")(h)
+        h = ResBlock3D(h.shape[-1], dtype=self.dtype, name="mid_res_1")(h)
+        for i, mult in enumerate(mults):
+            feats = c.base_channels * mult
+            for j in range(c.num_res_blocks + 1):
+                h = ResBlock3D(feats, dtype=self.dtype, name=f"up_{i}_res_{j}")(h)
+            if i < len(mults) - 1:
+                # mirror the encoder: the downsample applied *after* stage i of
+                # the encoder is undone *before* stage i+1 of the decoder
+                if c.temporal_downsample[len(mults) - 2 - i]:
+                    h = _temporal_upsample(h)
+                h = _spatial_upsample(h)
+                h = CausalConv3D(feats, dtype=self.dtype, name=f"up_{i}_us")(h)
+        h = RMSNorm(name="norm_out")(h)
+        h = CausalConv3D(3, kernel=(1, 3, 3), dtype=self.dtype,
+                         name="conv_out")(nn.silu(h))
+        return jnp.tanh(h)

@@ -1,0 +1,561 @@
+"""ComfyUI-compatible node-graph server for the Wan T2V family.
+
+The reference's video path drives a ComfyUI server that its repo never ships —
+the client targets a ``wan-video-gen`` deployment that does not exist in its
+manifests (reference ``generate_wan_t2v.py:320``, SURVEY.md §2.6).  This
+module closes that gap TPU-natively: the same HTTP API surface the reference
+client speaks, executing node graphs on this package's jitted Wan pipeline.
+
+API (exactly what ``generate_wan_t2v.py`` uses):
+
+- ``GET  /queue``                 → {"queue_running": [...], "queue_pending": [...]}
+- ``GET  /object_info``           → node schemas incl. loader file options
+  (client preflight, reference ``generate_wan_t2v.py:204-221``)
+- ``POST /prompt``                → {"prompt_id": ...}; body {prompt, client_id}
+- ``GET  /history/{prompt_id}``   → {id: {status, outputs}} once known
+- ``GET  /view?filename=&subfolder=&type=`` → output file bytes
+
+Node set: UNETLoader, CLIPLoader, VAELoader, EmptyHunyuanLatentVideo,
+CLIPTextEncode, KSampler, VAEDecode, SaveImage, SaveAnimatedWEBP and —
+when an ``ffmpeg`` binary is present (the serving image installs one; dev
+images may not) — SaveWEBM.
+
+TPU twist: the graph is a *serving* abstraction, not a compute schedule.
+``KSampler`` returns a symbolic sampling spec; ``VAEDecode`` triggers the
+single fused XLA program (UMT5 → CFG flow-matching loop → causal-3D-VAE
+decode) from ``WanPipeline``.  Intermediate latents never round-trip to the
+host, which is precisely what a node-per-op executor cannot avoid.
+Graphs wired outside this shape are rejected with a clear error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import re
+import shutil
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+from aiohttp import web
+
+from tpustack.utils import get_logger
+from tpustack.utils.image import array_to_png
+
+log = get_logger("serving.graph_server")
+
+# canonical checkpoint filenames (what the reference client preflights for,
+# reference generate_wan_t2v.py:347-349)
+CANONICAL_UNET = "wan2.1_t2v_1.3B_bf16.safetensors"
+CANONICAL_CLIP = "umt5_xxl_fp16.safetensors"
+CANONICAL_VAE = "wan_2.1_vae.safetensors"
+
+_SAMPLERS = ["uni_pc", "uni_pc_bh2", "euler", "heun", "dpmpp_2m"]
+_SCHEDULERS = ["simple", "normal"]
+
+
+def _ffmpeg() -> Optional[str]:
+    return shutil.which("ffmpeg")
+
+
+# --------------------------------------------------------------------- values
+@dataclass(frozen=True)
+class Conditioning:
+    text: str
+
+
+@dataclass(frozen=True)
+class LatentSpec:
+    width: int
+    height: int
+    frames: int
+    batch_size: int
+
+
+@dataclass(frozen=True)
+class SampleSpec:
+    latent: LatentSpec
+    positive: Conditioning
+    negative: Conditioning
+    seed: int
+    steps: int
+    cfg: float
+    sampler_name: str
+    denoise: float
+
+
+@dataclass
+class Frames:
+    array: np.ndarray  # [F, H, W, 3] uint8
+
+
+@dataclass
+class OutputFile:
+    filename: str
+    subfolder: str = ""
+    type: str = "output"
+    kind: str = "images"  # history key: images | videos
+
+    def as_history(self) -> Dict[str, str]:
+        return {"filename": self.filename, "subfolder": self.subfolder,
+                "type": self.type}
+
+
+# --------------------------------------------------------------------- runtime
+class WanRuntime:
+    """Owns the (lazily built) pipeline + models/output directories."""
+
+    def __init__(self, models_dir: Optional[str] = None,
+                 output_dir: Optional[str] = None, pipeline=None):
+        self.models_dir = models_dir or os.environ.get("WAN_MODELS_DIR", "/models")
+        self.output_dir = output_dir or os.environ.get("WAN_OUTPUT_DIR",
+                                                       "/tmp/wan-outputs")
+        os.makedirs(self.output_dir, exist_ok=True)
+        self._pipeline = pipeline
+        self._lock = threading.Lock()
+
+    # ---- model discovery (ComfyUI directory layout)
+    def _list(self, sub: str, canonical: str) -> List[str]:
+        names = []
+        d = os.path.join(self.models_dir, sub)
+        if os.path.isdir(d):
+            names = sorted(f for f in os.listdir(d)
+                           if f.endswith((".safetensors", ".sft", ".pt")))
+        if not names and self._allow_random():
+            # zero-egress / random-weights mode still advertises the canonical
+            # names so the reference client's preflight passes
+            names = [canonical]
+        return names
+
+    @staticmethod
+    def _allow_random() -> bool:
+        return os.environ.get("WAN_ALLOW_RANDOM", "1") not in ("0", "false")
+
+    def unet_names(self) -> List[str]:
+        return self._list("diffusion_models", CANONICAL_UNET)
+
+    def clip_names(self) -> List[str]:
+        return self._list("text_encoders", CANONICAL_CLIP)
+
+    def vae_names(self) -> List[str]:
+        return self._list("vae", CANONICAL_VAE)
+
+    def pipeline(self):
+        with self._lock:
+            if self._pipeline is None:
+                from tpustack.models.wan import WanConfig, WanPipeline
+
+                preset = os.environ.get("WAN_PRESET", "wan_1_3b")
+                cfg = (WanConfig.tiny() if preset == "tiny"
+                       else WanConfig.wan_1_3b())
+                log.info("Building Wan pipeline (preset=%s)...", preset)
+                pipe = WanPipeline(cfg)
+                unets, clips = self.unet_names(), self.clip_names()
+                have_real = os.path.isdir(
+                    os.path.join(self.models_dir, "diffusion_models"))
+                if have_real and unets and clips:
+                    # real checkpoints on the PVC → map them in (DiT + UMT5);
+                    # any mismatch raises rather than silently serving noise
+                    from tpustack.models.wan.weights import load_wan_safetensors
+
+                    pipe.params = load_wan_safetensors(
+                        self.models_dir, cfg, pipe.params,
+                        unet_name=unets[0], clip_name=clips[0],
+                        allow_partial=os.environ.get("WAN_WEIGHTS_PARTIAL", "0")
+                        in ("1", "true"))
+                elif not self._allow_random():
+                    raise RuntimeError(
+                        f"no Wan checkpoints under {self.models_dir} and "
+                        "WAN_ALLOW_RANDOM=0 — refusing to serve random weights")
+                self._pipeline = pipe
+            return self._pipeline
+
+
+# ----------------------------------------------------------------- graph exec
+class GraphError(ValueError):
+    pass
+
+
+class GraphExecutor:
+    """Topologically executes a ComfyUI-style ``{id: {class_type, inputs}}``
+    graph.  Node functions are methods ``node_<ClassType>``."""
+
+    def __init__(self, runtime: WanRuntime):
+        self.rt = runtime
+        self._counter_lock = threading.Lock()
+        self._counter = self._scan_counter()
+
+    def _scan_counter(self) -> int:
+        """Resume numbering after the max existing ``*_NNNNN_.*`` output so
+        restarts and concurrent prefixes never overwrite earlier files."""
+        best = 0
+        try:
+            for name in os.listdir(self.rt.output_dir):
+                m = re.search(r"_(\d{5,})_\.\w+$", name)
+                if m:
+                    best = max(best, int(m.group(1)))
+        except OSError:
+            pass
+        return best
+
+    def _next_counter(self) -> int:
+        with self._counter_lock:
+            self._counter += 1
+            return self._counter
+
+    # -- node implementations ------------------------------------------------
+    def node_UNETLoader(self, inputs, _ctx):
+        name = inputs.get("unet_name")
+        if name not in self.rt.unet_names():
+            raise GraphError(f"UNET not found: {name}")
+        return (("unet", name),)
+
+    def node_CLIPLoader(self, inputs, _ctx):
+        name = inputs.get("clip_name")
+        if name not in self.rt.clip_names():
+            raise GraphError(f"CLIP not found: {name}")
+        return (("clip", name),)
+
+    def node_VAELoader(self, inputs, _ctx):
+        name = inputs.get("vae_name")
+        if name not in self.rt.vae_names():
+            raise GraphError(f"VAE not found: {name}")
+        return (("vae", name),)
+
+    def node_CLIPTextEncode(self, inputs, _ctx):
+        return (Conditioning(text=str(inputs.get("text", ""))),)
+
+    def node_EmptyHunyuanLatentVideo(self, inputs, _ctx):
+        return (LatentSpec(width=int(inputs.get("width", 512)),
+                           height=int(inputs.get("height", 320)),
+                           frames=int(inputs.get("length", 16)),
+                           batch_size=int(inputs.get("batch_size", 1))),)
+
+    def node_KSampler(self, inputs, _ctx):
+        latent = inputs.get("latent_image")
+        pos, neg = inputs.get("positive"), inputs.get("negative")
+        if not isinstance(latent, LatentSpec):
+            raise GraphError("KSampler latent_image must come from "
+                             "EmptyHunyuanLatentVideo")
+        if not isinstance(pos, Conditioning) or not isinstance(neg, Conditioning):
+            raise GraphError("KSampler positive/negative must come from "
+                             "CLIPTextEncode")
+        denoise = float(inputs.get("denoise", 1.0))
+        if denoise != 1.0:
+            raise GraphError("partial denoise (img2vid) not supported yet")
+        if latent.batch_size != 1:
+            # refuse rather than silently discard items 1..B-1 after paying
+            # the full fused-generate cost for all of them
+            raise GraphError("batch_size > 1 not supported yet; submit one "
+                             "graph per seed (the batch client does this)")
+        return (SampleSpec(latent=latent, positive=pos, negative=neg,
+                           seed=int(inputs.get("seed", 0)),
+                           steps=int(inputs.get("steps", 25)),
+                           cfg=float(inputs.get("cfg", 6.0)),
+                           sampler_name=str(inputs.get("sampler_name", "uni_pc")),
+                           denoise=denoise),)
+
+    def node_VAEDecode(self, inputs, _ctx):
+        spec = inputs.get("samples")
+        if not isinstance(spec, SampleSpec):
+            raise GraphError("VAEDecode samples must come from KSampler")
+        pipe = self.rt.pipeline()
+        log.info("Sampling: %dx%d f=%d steps=%d cfg=%.1f sampler=%s seed=%d",
+                 spec.latent.width, spec.latent.height, spec.latent.frames,
+                 spec.steps, spec.cfg, spec.sampler_name, spec.seed)
+        vid, latency = pipe.generate(
+            spec.positive.text, negative_prompt=spec.negative.text,
+            frames=spec.latent.frames, steps=spec.steps,
+            guidance_scale=spec.cfg, seed=spec.seed,
+            width=spec.latent.width, height=spec.latent.height,
+            sampler=spec.sampler_name, batch_size=spec.latent.batch_size)
+        log.info("Sampled %s in %.2fs", vid.shape, latency)
+        return (Frames(array=vid[0]),)
+
+    # -- save nodes
+    def _out_path(self, prefix: str, ext: str, counter: int) -> Tuple[str, str]:
+        safe = re.sub(r"[^A-Za-z0-9_.-]", "_", prefix) or "out"
+        name = f"{safe}_{counter:05d}_.{ext}"
+        return name, os.path.join(self.rt.output_dir, name)
+
+    def node_SaveImage(self, inputs, ctx):
+        frames = inputs.get("images")
+        if not isinstance(frames, Frames):
+            raise GraphError("SaveImage images must come from VAEDecode")
+        prefix = str(inputs.get("filename_prefix", "out"))
+        files = []
+        for frame in frames.array:
+            name, path = self._out_path(prefix, "png", self._next_counter())
+            with open(path, "wb") as f:
+                f.write(array_to_png(frame))
+            files.append(OutputFile(filename=name, kind="images"))
+        return (files,)
+
+    def node_SaveAnimatedWEBP(self, inputs, ctx):
+        frames = inputs.get("images")
+        if not isinstance(frames, Frames):
+            raise GraphError("SaveAnimatedWEBP images must come from VAEDecode")
+        from PIL import Image
+
+        fps = float(inputs.get("fps", 16))
+        quality = int(inputs.get("quality", 90))
+        lossless = bool(inputs.get("lossless", False))
+        imgs = [Image.fromarray(f) for f in frames.array]
+        name, path = self._out_path(str(inputs.get("filename_prefix", "out")),
+                                    "webp", self._next_counter())
+        imgs[0].save(path, format="WEBP", save_all=True, append_images=imgs[1:],
+                     duration=max(1, int(round(1000.0 / fps))), loop=0,
+                     quality=quality, lossless=lossless)
+        return ([OutputFile(filename=name, kind="images")],)
+
+    def node_SaveWEBM(self, inputs, ctx):
+        frames = inputs.get("images")
+        if not isinstance(frames, Frames):
+            raise GraphError("SaveWEBM images must come from VAEDecode")
+        exe = _ffmpeg()
+        if exe is None:
+            raise GraphError("SaveWEBM requires an ffmpeg binary in the image")
+        fps = float(inputs.get("fps", 24))
+        crf = int(inputs.get("crf", 32))
+        codec = str(inputs.get("codec", "vp9"))
+        arr = frames.array
+        name, path = self._out_path(str(inputs.get("filename_prefix", "out")),
+                                    "webm", self._next_counter())
+        cmd = [exe, "-y", "-f", "rawvideo", "-pix_fmt", "rgb24",
+               "-s", f"{arr.shape[2]}x{arr.shape[1]}", "-r", str(fps),
+               "-i", "-", "-c:v", "libvpx-vp9" if codec == "vp9" else codec,
+               "-crf", str(crf), "-b:v", "0", "-pix_fmt", "yuv420p", path]
+        proc = subprocess.run(cmd, input=arr.tobytes(),
+                              capture_output=True, check=False)
+        if proc.returncode != 0:
+            raise GraphError(f"ffmpeg failed: {proc.stderr[-500:].decode(errors='replace')}")
+        return ([OutputFile(filename=name, kind="videos")],)
+
+    # -- schema for /object_info --------------------------------------------
+    def object_info(self) -> Dict[str, Any]:
+        def req(**kw):
+            return {"input": {"required": kw}}
+
+        info = {
+            "UNETLoader": req(unet_name=[self.rt.unet_names()],
+                              weight_dtype=[["default", "fp8_e4m3fn"]]),
+            "CLIPLoader": req(clip_name=[self.rt.clip_names()],
+                              type=[["wan", "stable_diffusion"]],
+                              device=[["default", "cpu"]]),
+            "VAELoader": req(vae_name=[self.rt.vae_names()]),
+            "CLIPTextEncode": req(text=["STRING"], clip=["CLIP"]),
+            "EmptyHunyuanLatentVideo": req(width=["INT"], height=["INT"],
+                                           length=["INT"], batch_size=["INT"]),
+            "KSampler": req(model=["MODEL"], positive=["CONDITIONING"],
+                            negative=["CONDITIONING"], latent_image=["LATENT"],
+                            seed=["INT"], steps=["INT"], cfg=["FLOAT"],
+                            sampler_name=[_SAMPLERS], scheduler=[_SCHEDULERS],
+                            denoise=["FLOAT"]),
+            "VAEDecode": req(samples=["LATENT"], vae=["VAE"]),
+            "SaveImage": req(images=["IMAGE"], filename_prefix=["STRING"]),
+            "SaveAnimatedWEBP": req(images=["IMAGE"], filename_prefix=["STRING"],
+                                    fps=["FLOAT"], lossless=["BOOLEAN"],
+                                    quality=["INT"], method=[["default"]]),
+        }
+        if _ffmpeg() is not None:
+            info["SaveWEBM"] = req(images=["IMAGE"], filename_prefix=["STRING"],
+                                   codec=[["vp9"]], fps=["FLOAT"], crf=["INT"])
+        return info
+
+    # -- execution -----------------------------------------------------------
+    def execute(self, graph: Dict[str, Any]) -> Dict[str, Dict[str, List[Dict]]]:
+        """Run a graph; returns ComfyUI-style ``outputs`` keyed by node id."""
+        for nid, node in graph.items():
+            if not isinstance(node, dict):
+                raise GraphError(f"node {nid} must be an object, got "
+                                 f"{type(node).__name__}")
+            ct = node.get("class_type")
+            if not hasattr(self, f"node_{ct}"):
+                raise GraphError(f"unknown node class_type {ct!r} (node {nid})")
+            if ct == "SaveWEBM" and _ffmpeg() is None:
+                raise GraphError("SaveWEBM requires an ffmpeg binary in the image")
+
+        results: Dict[str, Tuple] = {}
+        ctx = {}
+        outputs: Dict[str, Dict[str, List[Dict]]] = {}
+
+        def resolve(nid: str, stack: Tuple[str, ...]) -> Tuple:
+            if nid in results:
+                return results[nid]
+            if nid in stack:
+                raise GraphError(f"cycle through node {nid}")
+            node = graph.get(nid)
+            if node is None:
+                raise GraphError(f"edge to missing node {nid}")
+            inputs = {}
+            for key, val in (node.get("inputs") or {}).items():
+                if (isinstance(val, list) and len(val) == 2
+                        and isinstance(val[0], str) and isinstance(val[1], int)):
+                    src = resolve(val[0], stack + (nid,))
+                    if val[1] >= len(src):
+                        raise GraphError(f"node {val[0]} has no output {val[1]}")
+                    inputs[key] = src[val[1]]
+                else:
+                    inputs[key] = val
+            fn = getattr(self, f"node_{node['class_type']}")
+            out = fn(inputs, ctx)
+            results[nid] = out
+            if out and isinstance(out[0], list) and out[0] and isinstance(out[0][0], OutputFile):
+                by_kind: Dict[str, List[Dict]] = {}
+                for f in out[0]:
+                    by_kind.setdefault(f.kind, []).append(f.as_history())
+                outputs[nid] = by_kind
+            return out
+
+        for nid in sorted(graph, key=lambda s: (len(s), s)):
+            resolve(nid, ())
+        return outputs
+
+
+# -------------------------------------------------------------------- server
+@dataclass
+class HistoryEntry:
+    prompt_id: str
+    client_id: str
+    completed: bool = False
+    status_str: str = "pending"
+    messages: List[str] = field(default_factory=list)
+    outputs: Dict[str, Any] = field(default_factory=dict)
+
+    def as_json(self) -> Dict[str, Any]:
+        return {"status": {"completed": self.completed,
+                           "status_str": self.status_str,
+                           "messages": list(self.messages)},
+                "outputs": self.outputs}
+
+
+class GraphServer:
+    """aiohttp app + one background worker thread (one chip, one queue —
+    same serialisation stance as the sd15 server)."""
+
+    def __init__(self, runtime: Optional[WanRuntime] = None):
+        self.rt = runtime or WanRuntime()
+        self.executor = GraphExecutor(self.rt)
+        self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._pending: Dict[str, Dict] = {}
+        self._history: Dict[str, HistoryEntry] = {}
+        self._running: Optional[str] = None
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._work, daemon=True,
+                                        name="wan-graph-worker")
+        self._worker.start()
+
+    # ---- worker
+    def _work(self):
+        while True:
+            pid = self._queue.get()
+            if pid is None:
+                return
+            with self._lock:
+                graph = self._pending.pop(pid, None)
+                self._running = pid
+                entry = self._history[pid]
+            try:
+                outputs = self.executor.execute(graph)
+                with self._lock:  # status_str before completed: pollers treat
+                    entry.outputs = outputs       # completed+non-success as failure
+                    entry.status_str = "success"
+                    entry.completed = True
+            except Exception as e:  # noqa: BLE001 — surfaced via /history
+                log.exception("prompt %s failed", pid)
+                with self._lock:
+                    entry.status_str = "error"
+                    entry.messages.append(f"{type(e).__name__}: {e}")
+                    entry.completed = True
+            finally:
+                with self._lock:
+                    self._running = None
+
+    def shutdown(self):
+        self._queue.put(None)
+
+    # ---- handlers
+    async def queue_state(self, request: web.Request) -> web.Response:
+        with self._lock:
+            running = [[0, self._running]] if self._running else []
+            pending = [[0, pid] for pid in self._pending]
+        return web.json_response({"queue_running": running,
+                                  "queue_pending": pending})
+
+    async def object_info(self, request: web.Request) -> web.Response:
+        return web.json_response(self.executor.object_info())
+
+    async def submit(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        graph = body.get("prompt")
+        if not isinstance(graph, dict) or not graph:
+            return web.json_response({"error": "missing prompt graph"}, status=400)
+        for nid, node in graph.items():
+            if not isinstance(node, dict):
+                return web.json_response(
+                    {"error": f"node {nid} must be an object"}, status=400)
+            ct = node.get("class_type")
+            if not hasattr(self.executor, f"node_{ct}"):
+                return web.json_response(
+                    {"error": f"unknown node class_type {ct!r} (node {nid})"},
+                    status=400)
+        pid = str(uuid.uuid4())
+        entry = HistoryEntry(prompt_id=pid,
+                             client_id=str(body.get("client_id", "")))
+        with self._lock:
+            self._history[pid] = entry
+            self._pending[pid] = graph
+        self._queue.put(pid)
+        return web.json_response({"prompt_id": pid, "number": len(self._history)})
+
+    async def history(self, request: web.Request) -> web.Response:
+        pid = request.match_info["prompt_id"]
+        with self._lock:  # serialise under the lock — the worker mutates entries
+            entry = self._history.get(pid)
+            payload = {} if entry is None else {pid: entry.as_json()}
+        return web.json_response(payload)
+
+    async def view(self, request: web.Request) -> web.Response:
+        filename = request.query.get("filename", "")
+        subfolder = request.query.get("subfolder", "")
+        base = os.path.realpath(self.rt.output_dir)
+        path = os.path.realpath(os.path.join(base, subfolder, filename))
+        # keep /view inside the output dir (the reference trusts ComfyUI here)
+        if not path.startswith(base + os.sep) or not os.path.isfile(path):
+            return web.json_response({"error": "not found"}, status=404)
+        # FileResponse streams from disk without blocking the event loop
+        return web.FileResponse(path)
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=4 << 20)
+        app.router.add_get("/queue", self.queue_state)
+        app.router.add_get("/object_info", self.object_info)
+        app.router.add_post("/prompt", self.submit)
+        app.router.add_get("/history/{prompt_id}", self.history)
+        app.router.add_get("/view", self.view)
+        app.router.add_get("/healthz", self.healthz)
+        return app
+
+
+def main() -> None:
+    port = int(os.environ.get("PORT", "8181"))
+    server = GraphServer()
+    log.info("Wan graph server on :%d (models=%s, outputs=%s)",
+             port, server.rt.models_dir, server.rt.output_dir)
+    web.run_app(server.build_app(), port=port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()
