@@ -8,8 +8,11 @@ surface:
   path (``tpustack.utils.image`` falls back to PIL when the library isn't
   built).
 
-The shared object is built on first import when a compiler is available
-(``make -C native``); set ``TPUSTACK_NO_NATIVE=1`` to skip entirely.
+The shared object is built on first use when a compiler is available
+(``make -C native``); set ``TPUSTACK_NO_NATIVE=1`` to skip entirely.  Servers
+should call ``available()`` once at startup so the (up to 120 s) build never
+lands inside a request; ``_load`` is locked so concurrent first calls cannot
+race two ``make`` processes against ``dlopen``.
 """
 
 from __future__ import annotations
@@ -17,6 +20,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional
 
 import numpy as np
@@ -24,9 +28,19 @@ import numpy as np
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libtpustack_runtime.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "png_encoder.cc")
 
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
+_load_lock = threading.Lock()
+
+
+def _stale() -> bool:
+    """True when the source is newer than the built .so (dev edits)."""
+    try:
+        return os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)
+    except OSError:
+        return False
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -35,25 +49,31 @@ def _load() -> Optional[ctypes.CDLL]:
         return _lib
     if _load_failed or os.environ.get("TPUSTACK_NO_NATIVE") == "1":
         return None
-    if not os.path.exists(_SO_PATH):
+    with _load_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO_PATH) or _stale():
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-B"], check=True,
+                               capture_output=True, timeout=120)
+            except Exception:
+                if not os.path.exists(_SO_PATH):
+                    _load_failed = True  # don't re-pay the failing build per call
+                    return None
+                # rebuild of a stale .so failed (e.g. no compiler in the
+                # image) — keep using the existing binary
         try:
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                           capture_output=True, timeout=120)
-        except Exception:
-            _load_failed = True  # don't re-pay the failing build per call
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _load_failed = True
             return None
-    try:
-        lib = ctypes.CDLL(_SO_PATH)
-    except OSError:
-        _load_failed = True
-        return None
-    lib.tpustack_png_encode.restype = ctypes.c_long
-    lib.tpustack_png_encode.argtypes = [
-        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
-    ]
-    _lib = lib
-    return lib
+        lib.tpustack_png_encode.restype = ctypes.c_long
+        lib.tpustack_png_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+        ]
+        _lib = lib
+        return lib
 
 
 def available() -> bool:

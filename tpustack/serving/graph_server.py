@@ -550,6 +550,9 @@ class GraphServer:
 
 
 def main() -> None:
+    from tpustack import runtime
+
+    runtime.available()  # build/load the native PNG encoder before serving
     port = int(os.environ.get("PORT", "8181"))
     server = GraphServer()
     log.info("Wan graph server on :%d (models=%s, outputs=%s)",

@@ -157,6 +157,9 @@ class SDServer:
 
 
 def main() -> None:
+    from tpustack import runtime
+
+    runtime.available()  # build/load the native PNG encoder before serving
     port = int(os.environ.get("PORT", "8000"))
     server = SDServer()
     if os.environ.get("SD15_WARMUP", "1") not in ("0", "false"):
