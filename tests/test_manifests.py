@@ -130,6 +130,53 @@ def test_jobset_multihost_topology():
     assert pod["resources"]["limits"]["google.com/tpu"] == 8
 
 
+def test_sd15_alt_helmrelease_self_contained():
+    """The alternative chart path must not repeat the reference's dead-code bug
+    (SURVEY.md §2.4: HelmRelease referencing a HelmRepository defined nowhere).
+    Ours ships the HelmRepository in the same file and stays out of the
+    kustomization, mirroring the reference's posture minus the bug."""
+    path = CLUSTER / "apps" / "sd15-api" / "helmrelease.yaml"
+    docs = _load_all(path)
+    kinds = {d["kind"]: d for d in docs}
+    assert {"HelmRepository", "HelmRelease"} <= set(kinds)
+    src = kinds["HelmRelease"]["spec"]["chart"]["spec"]["sourceRef"]
+    assert src["name"] == kinds["HelmRepository"]["metadata"]["name"]
+    kust = _load_all(CLUSTER / "apps" / "sd15-api" / "kustomization.yaml")[0]
+    assert "helmrelease.yaml" not in kust["resources"]
+    # same TPU contract as the Deployment path
+    text = yaml.safe_dump(kinds["HelmRelease"])
+    assert "google.com/tpu" in text and "30800" in text
+
+
+def test_renovate_markers_match_config_regex():
+    """Every `# renovate:` marker must actually match the regex manager in
+    renovate.json (the reference's only enabled manager, renovate.json:11),
+    and every marked file must be in managerFilePatterns."""
+    import json
+    import re
+
+    conf = json.loads((REPO / "renovate.json").read_text())
+    mgr = conf["customManagers"][0]
+    patterns = [re.compile(p) for p in mgr["managerFilePatterns"]]
+    # renovate matchStrings are ECMAScript regexes: (?<name>…) → (?P<name>…)
+    regexes = [re.compile(re.sub(r"\(\?<([A-Za-z]+)>", r"(?P<\1>", s))
+               for s in mgr["matchStrings"]]
+
+    marked = []
+    for p in all_yaml_files():
+        text = p.read_text()
+        if "# renovate:" not in text:
+            continue
+        rel = str(p.relative_to(REPO))
+        assert any(pat.search(rel) for pat in patterns), (
+            f"{rel} has renovate markers but is not in managerFilePatterns")
+        hits = [m for rx in regexes for m in rx.finditer(text)]
+        assert len(hits) == text.count("# renovate:"), (
+            f"{rel}: marker(s) present that the matchStrings regex misses")
+        marked.extend(m.group("depName") for m in hits)
+    assert {"kubernetes/kubernetes", "kubernetes-sigs/jobset", "libtpu"} <= set(marked)
+
+
 def test_ansible_playbook_shapes():
     """3-playbook surface parity with rke2-installation (SURVEY.md §2.1)."""
     inst = REPO / "tpu-installation"

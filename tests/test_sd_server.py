@@ -25,7 +25,7 @@ def _run(coro):
     return asyncio.new_event_loop().run_until_complete(coro)
 
 
-def test_rest_contract(server):
+def test_rest_contract(server, monkeypatch):
     from aiohttp.test_utils import TestClient, TestServer
 
     async def scenario():
@@ -81,6 +81,22 @@ def test_rest_contract(server):
                 "prompt": "a panda", "steps": 2, "width": 64, "height": 64,
                 "seed": 7})
             assert (await r1.read()) == body
+
+            # profiler capture (SURVEY.md §5 extra): xplane files + timing
+            trace_dir = "/tmp/sd15-trace-test"
+            monkeypatch.setenv("SD15_TRACE_DIR", trace_dir)
+            r = await client.post("/profile", json={
+                "steps": 2, "width": 64, "height": 64})
+            assert r.status == 200
+            prof = await r.json()
+            assert prof["trace_dir"] == trace_dir
+            assert prof["files"] and all(f.endswith(".xplane.pb")
+                                         for f in prof["files"])
+
+            # /profile input validation: bad bodies → 4xx, never a 500
+            for bad in ([1, 2], {"steps": "abc"}, {"width": {}}):
+                r = await client.post("/profile", json=bad)
+                assert r.status == 422, f"{bad} → {r.status}"
         finally:
             await client.close()
 

@@ -89,7 +89,7 @@ class CrossAttention(nn.Module):
         k = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
         v = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
         split = lambda t: t.reshape(t.shape[0], t.shape[1], self.heads, head_dim)
-        out = dot_product_attention(split(q), split(k), split(v))
+        out = dot_product_attention(split(q), split(k), split(v), impl="auto")
         out = out.reshape(x.shape[0], x.shape[1], self.dim)
         return nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
 

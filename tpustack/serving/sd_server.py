@@ -146,6 +146,51 @@ class SDServer:
         return web.Response(body=png, content_type="image/png",
                             headers={"X-Gen-Time": f"{latency:.2f}s"})
 
+    async def profile(self, request: web.Request) -> web.Response:
+        """Capture an XLA/TPU profile (xplane) around one small generate.
+
+        Observability beyond the reference's wall-clock-only `X-Gen-Time`
+        (SURVEY.md §5 "Tracing/profiling: none... JAX profiler/xplane is
+        optional extra").  ``POST /profile {steps?, width?, height?}`` →
+        {trace_dir, files, gen_time_s}; view with xprof/tensorboard."""
+        import glob
+
+        import jax
+
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except ValueError:
+            body = {}
+        if not isinstance(body, dict):
+            return web.json_response({"detail": "body must be a JSON object"},
+                                     status=422)
+        def _int(name: str, default: int) -> int:
+            v = body.get(name)
+            return default if v is None else int(v)
+
+        try:
+            steps, width, height = _int("steps", 4), _int("width", 512), _int("height", 512)
+        except (TypeError, ValueError) as e:
+            return web.json_response({"detail": f"bad parameter: {e}"}, status=422)
+        trace_dir = os.environ.get("SD15_TRACE_DIR", "/tmp/sd15-trace")
+        async with self._lock:
+            t0 = time.time()
+
+            def run():
+                with jax.profiler.trace(trace_dir):
+                    self.pipe.generate("profile capture", steps=steps,
+                                       width=width, height=height, seed=0)
+
+            try:
+                await asyncio.get_running_loop().run_in_executor(None, run)
+            except ValueError as e:
+                return web.json_response({"detail": str(e)}, status=400)
+            latency = time.time() - t0
+        files = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True))
+        return web.json_response(
+            {"trace_dir": trace_dir, "files": files[-4:],
+             "gen_time_s": round(latency, 2)})
+
     # ---------------------------------------------------------------- app
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=1 << 20)
@@ -153,6 +198,7 @@ class SDServer:
         app.router.add_get("/", self.index)
         app.router.add_get("/last", self.last)
         app.router.add_post("/generate", self.generate)
+        app.router.add_post("/profile", self.profile)
         return app
 
 
