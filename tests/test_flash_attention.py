@@ -50,6 +50,46 @@ def test_flash_gqa_via_entrypoint():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_auto_impl_dispatch():
+    """``impl="auto"``: numerically identical to xla at short AND long seq
+    (on CPU it resolves to xla; on TPU long self-attention goes flash — the
+    equivalence of the two impls is covered by the tests above)."""
+    for s in (64, 1536):
+        q = _rand((1, s, 2, 16), 11)
+        out = dot_product_attention(q, q, q, impl="auto")
+        ref = dot_product_attention(q, q, q, impl="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # masked calls must never dispatch to flash, whatever the backend
+    mask = jnp.ones((1, 1, 1536, 1536), bool)
+    q = _rand((1, 1536, 2, 16), 12)
+    out = dot_product_attention(q, q, q, mask=mask, impl="auto")
+    ref = dot_product_attention(q, q, q, mask=mask, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_auto_impl_backend_gating(monkeypatch):
+    """The auto range check: flash only for 1024 <= S <= 8192 on TPU (the
+    kernel stages full K/V panels in VMEM — huge video streams must fall back
+    to XLA, not OOM).  Force the backend decision and intercept the kernel."""
+    import tpustack.ops.attention as A
+
+    calls = []
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+
+    import tpustack.ops.pallas.flash_attention as F
+
+    real = F.flash_attention
+    monkeypatch.setattr(
+        F, "flash_attention",
+        lambda q, k, v, **kw: calls.append(q.shape[1]) or real(
+            q, k, v, interpret=True, **kw))
+
+    for s, expect_flash in ((512, False), (2048, True), (9000, False)):
+        q = _rand((1, s, 1, 8), s)
+        dot_product_attention(q, q, q, impl="auto")
+    assert calls == [2048]
+
+
 def test_flash_rejects_mask():
     q = _rand((1, 16, 1, 8), 10)
     with pytest.raises(NotImplementedError):

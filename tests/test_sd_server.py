@@ -89,9 +89,18 @@ def test_rest_contract(server, monkeypatch):
                 "steps": 2, "width": 64, "height": 64})
             assert r.status == 200
             prof = await r.json()
-            assert prof["trace_dir"] == trace_dir
-            assert prof["files"] and all(f.endswith(".xplane.pb")
-                                         for f in prof["files"])
+            # each capture gets its own subdir under SD15_TRACE_DIR
+            assert prof["trace_dir"].startswith(trace_dir + "/capture-")
+            assert prof["files"] and all(
+                f.endswith(".xplane.pb") and f.startswith(prof["trace_dir"])
+                for f in prof["files"])
+
+            # a second capture must not list the first capture's files
+            r2 = await client.post("/profile", json={
+                "steps": 2, "width": 64, "height": 64})
+            prof2 = await r2.json()
+            assert prof2["trace_dir"] != prof["trace_dir"]
+            assert not set(prof2["files"]) & set(prof["files"])
 
             # /profile input validation: bad bodies → 4xx, never a 500
             for bad in ([1, 2], {"steps": "abc"}, {"width": {}}):

@@ -120,6 +120,10 @@ class LlamaAttention(nn.Module):
             out = dot_product_attention(q, k_all, v_all, mask=attn_mask)
         else:
             new_cache = None
+            # Deliberately impl="xla": this no-cache path is also the training
+            # path, and the Pallas flash kernel has no VJP.  Serving prefill
+            # goes through the masked KV-cache branch above, so flash cannot
+            # apply there either (kernel supports causal, not arbitrary masks).
             out = dot_product_attention(q, k, v, causal=True, mask=attn_mask)
         out = out.reshape(b, s, c.n_heads * hd)
         return dense(c.dim, "o_proj", False)(out), new_cache

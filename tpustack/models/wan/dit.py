@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpustack.models.wan.config import WanDiTConfig
+from tpustack.ops.attention import dot_product_attention
 
 
 def timestep_embedding(t, dim: int, max_period: float = 10000.0):
@@ -86,13 +87,16 @@ class RMSNorm(nn.Module):
 
 
 def _attention(q, k, v, heads: int):
-    """BSHD attention with fp32 logits; returns ``[B, S, heads*D]``."""
+    """BSHD attention, fp32 accumulate; returns ``[B, S, heads*D]``.
+
+    ``impl="auto"`` routes the long space-time self-attention (thousands of
+    video tokens, D=128) through the Pallas flash kernel on TPU — the same
+    dispatch that cut SD1.5's UNet step 2.4x — while the 512-token text
+    cross-attention stays on plain XLA."""
     b, s = q.shape[0], q.shape[1]
     head_dim = q.shape[-1]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * (head_dim ** -0.5)
-    att = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(b, s, heads * head_dim)
+    out = dot_product_attention(q, k, v, impl="auto")
+    return out.reshape(b, s, heads * head_dim)
 
 
 class DiTBlock(nn.Module):

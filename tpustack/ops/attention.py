@@ -52,8 +52,13 @@ def dot_product_attention(
         v = jnp.repeat(v, h // hkv, axis=2)
 
     if impl == "auto":
-        long_seq = sq >= 1024 and k.shape[1] >= 1024
-        impl = ("flash" if long_seq and mask is None
+        # Lower bound: below ~1k tokens the [S,S] scores fit comfortably in
+        # cache-friendly fusions and the kernel's fixed cost loses to XLA.
+        # Upper bound: the kernel stages the full per-head K/V panel in VMEM
+        # (flash_attention docstring: fine to ~8k tokens); beyond that fall
+        # back to XLA rather than blow VMEM on huge video token streams.
+        in_range = 1024 <= sq <= 8192 and 1024 <= k.shape[1] <= 8192
+        impl = ("flash" if in_range and mask is None
                 and jax.default_backend() == "tpu" else "xla")
 
     if impl == "flash":

@@ -172,8 +172,12 @@ class SDServer:
             steps, width, height = _int("steps", 4), _int("width", 512), _int("height", 512)
         except (TypeError, ValueError) as e:
             return web.json_response({"detail": f"bad parameter: {e}"}, status=422)
-        trace_dir = os.environ.get("SD15_TRACE_DIR", "/tmp/sd15-trace")
+        base = os.environ.get("SD15_TRACE_DIR", "/tmp/sd15-trace")
         async with self._lock:
+            # fresh subdir per capture so the response lists exactly this
+            # run's xplane files, never residue from earlier captures
+            self._trace_seq = getattr(self, "_trace_seq", 0) + 1
+            trace_dir = os.path.join(base, f"capture-{self._trace_seq:04d}")
             t0 = time.time()
 
             def run():
@@ -188,7 +192,7 @@ class SDServer:
             latency = time.time() - t0
         files = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True))
         return web.json_response(
-            {"trace_dir": trace_dir, "files": files[-4:],
+            {"trace_dir": trace_dir, "files": files,
              "gen_time_s": round(latency, 2)})
 
     # ---------------------------------------------------------------- app
