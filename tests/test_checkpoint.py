@@ -1,0 +1,68 @@
+"""Checkpoint/resume (Orbax) for the training-ladder tasks.
+
+The reference has no compute checkpointing at all — its "resume" is PVC
+caching (SURVEY.md §5).  Our training ladder adds real save/restore: a k8s
+Job pod that dies mid-run restarts and continues from the latest step.
+
+Resume is asserted structurally: a resumed run saves only steps AFTER the
+restored one, so the step set distinguishes resume from restart-from-zero.
+"""
+
+from tpustack.train import tasks
+
+
+def _steps(ckpt_dir):
+    import orbax.checkpoint as ocp
+
+    mngr = ocp.CheckpointManager(ckpt_dir)
+    return sorted(mngr.all_steps()), mngr.latest_step()
+
+
+def test_llama2_task_saves_and_resumes(tmp_path):
+    ckpt = str(tmp_path / "llama2")
+    argv = ["llama2", "--tiny", "--steps", "3", "--batch", "2", "--seq", "16",
+            "--fsdp", "2", "--tp", "2", "--no-bf16",
+            "--ckpt-dir", ckpt, "--save-every", "2"]
+    assert tasks.main(argv) == 0
+    steps, latest = _steps(ckpt)
+    # orbax saves the first step it sees, then every save-every, then the
+    # forced final save
+    assert latest == 3 and steps == [1, 2, 3]
+
+    # Second run restores step 3 and runs only 4..5.  A from-scratch run would
+    # re-save step 2; a resumed one saves {4, 5} on top and never touches 2
+    # until max_to_keep eviction.
+    argv[argv.index("--steps") + 1] = "5"
+    assert tasks.main(argv) == 0
+    steps, latest = _steps(ckpt)
+    assert latest == 5
+    assert 3 in steps  # survivor from run 1 ⇒ run 2 did not restart from zero
+    assert steps == [3, 4, 5]  # max_to_keep=3 evicted step 2
+
+
+def test_llama2_task_resume_is_noop_when_done(tmp_path):
+    ckpt = str(tmp_path / "llama2b")
+    argv = ["llama2", "--tiny", "--steps", "2", "--batch", "2", "--seq", "16",
+            "--fsdp", "2", "--no-bf16", "--ckpt-dir", ckpt, "--save-every", "1"]
+    assert tasks.main(argv) == 0
+    # Re-running with the same --steps restores step 2; the loop body never
+    # executes and the checkpoint set is unchanged.
+    assert tasks.main(argv) == 0
+    steps, latest = _steps(ckpt)
+    assert latest == 2 and steps == [1, 2]
+
+
+def test_resnet50_task_saves_and_resumes(tmp_path):
+    ckpt = str(tmp_path / "resnet")
+    argv = ["resnet50", "--steps", "2", "--batch", "2", "--classes", "4",
+            "--image-size", "32", "--no-bf16",
+            "--ckpt-dir", ckpt, "--save-every", "1"]
+    assert tasks.main(argv) == 0
+    steps, latest = _steps(ckpt)
+    assert latest == 2 and steps == [1, 2]
+
+    argv[argv.index("--steps") + 1] = "4"
+    assert tasks.main(argv) == 0
+    steps, latest = _steps(ckpt)
+    assert latest == 4
+    assert steps == [2, 3, 4]  # resumed at 2; step 1 evicted by max_to_keep

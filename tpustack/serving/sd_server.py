@@ -154,6 +154,7 @@ class SDServer:
         optional extra").  ``POST /profile {steps?, width?, height?}`` →
         {trace_dir, files, gen_time_s}; view with xprof/tensorboard."""
         import glob
+        import tempfile
 
         import jax
 
@@ -175,9 +176,11 @@ class SDServer:
         base = os.environ.get("SD15_TRACE_DIR", "/tmp/sd15-trace")
         async with self._lock:
             # fresh subdir per capture so the response lists exactly this
-            # run's xplane files, never residue from earlier captures
-            self._trace_seq = getattr(self, "_trace_seq", 0) + 1
-            trace_dir = os.path.join(base, f"capture-{self._trace_seq:04d}")
+            # run's xplane files, never residue from earlier captures —
+            # mkdtemp stays unique even across server restarts onto the
+            # same persistent volume
+            os.makedirs(base, exist_ok=True)
+            trace_dir = tempfile.mkdtemp(prefix="capture-", dir=base)
             t0 = time.time()
 
             def run():

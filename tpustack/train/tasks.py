@@ -35,13 +35,13 @@ def _report(step: int, metrics: Dict[str, Any], t0: float, n_done: int,
              step, float(metrics["loss"]), n_done / dt, n_done * batch / dt)
 
 
-def _maybe_restore(ckpt_dir: Optional[str], state):
+def _maybe_restore(ckpt_dir: Optional[str], state, save_every: int = 50):
     if not ckpt_dir:
         return state, None
     import orbax.checkpoint as ocp
 
     mngr = ocp.CheckpointManager(ckpt_dir, options=ocp.CheckpointManagerOptions(
-        max_to_keep=3, save_interval_steps=50))
+        max_to_keep=3, save_interval_steps=save_every))
     latest = mngr.latest_step()
     if latest is not None:
         shardings = jax.tree.map(lambda x: getattr(x, "sharding", None), state)
@@ -55,12 +55,12 @@ def _maybe_restore(ckpt_dir: Optional[str], state):
     return state, mngr
 
 
-def _maybe_save(mngr, step: int, state) -> None:
+def _maybe_save(mngr, step: int, state, force: bool = False) -> None:
     if mngr is None:
         return
     import orbax.checkpoint as ocp
 
-    mngr.save(step, args=ocp.args.StandardSave(state))
+    mngr.save(step, args=ocp.args.StandardSave(state), force=force)
 
 
 # --------------------------------------------------------------------- tasks
@@ -83,6 +83,16 @@ def run_resnet50(args) -> None:
     opt = make_optimizer(tcfg)
     opt_state = opt.init(params)
 
+    # Checkpoint/resume: the k8s Job mounts /ckpt on the PVC and passes
+    # --ckpt-dir (cluster-config/jobs/train-resnet50.yaml); a pod restart
+    # (Recreate/backoff) continues from the latest saved step.
+    ckpt = {"step": jnp.zeros((), jnp.int32), "params": params,
+            "batch_stats": batch_stats, "opt_state": opt_state}
+    ckpt, mngr = _maybe_restore(args.ckpt_dir, ckpt, args.save_every)
+    params, batch_stats, opt_state = (
+        ckpt["params"], ckpt["batch_stats"], ckpt["opt_state"])
+    start = int(ckpt["step"])
+
     @jax.jit
     def step_fn(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
@@ -98,20 +108,28 @@ def run_resnet50(args) -> None:
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, {"loss": loss}
 
-    data_rng = np.random.RandomState(0)
     t0 = None
-    for i in range(args.steps):
+    for i in range(start, args.steps):
+        # per-step seed so a resumed run continues the exact data stream an
+        # uninterrupted run would have seen
+        data_rng = np.random.RandomState(i)
         images = jnp.asarray(data_rng.rand(args.batch, size, size, 3), jnp.float32)
         labels = jnp.asarray(data_rng.randint(0, args.classes, args.batch))
         params, batch_stats, opt_state, metrics = step_fn(
             params, batch_stats, opt_state, images, labels)
-        if i == 0:
+        if i == start:
             jax.block_until_ready(metrics["loss"])
             t0 = time.time()  # exclude compile from throughput
         elif (i + 1) % 10 == 0 or i == args.steps - 1:
             jax.block_until_ready(metrics["loss"])
-            _report(i + 1, metrics, t0, i, args.batch)
-    log.info("resnet50 done: %d steps", args.steps)
+            _report(i + 1, metrics, t0, i - start, args.batch)
+        _maybe_save(mngr, i + 1,
+                    {"step": jnp.asarray(i + 1, jnp.int32), "params": params,
+                     "batch_stats": batch_stats, "opt_state": opt_state},
+                    force=i == args.steps - 1)
+    if mngr is not None:
+        mngr.wait_until_finished()
+    log.info("resnet50 done: %d steps", args.steps - start)
 
 
 def _generic_lm_task(args, kind: str) -> None:
@@ -178,16 +196,16 @@ def _generic_lm_task(args, kind: str) -> None:
 
     tcfg = TrainerConfig(learning_rate=args.lr, remat=args.remat)
     state, specs = make_train_state(params, tcfg, mesh=mesh, rules=rules)
-    state, mngr = _maybe_restore(args.ckpt_dir, state)
+    state, mngr = _maybe_restore(args.ckpt_dir, state, args.save_every)
     step = make_sharded_train_step(loss_fn, tcfg, mesh=mesh,
                                    batch_spec=BATCH_SPEC)
 
-    data_rng = np.random.RandomState(1)
     rng = jax.random.PRNGKey(2)
     t0 = None
     start = int(state.step)
     for i in range(start, args.steps):
-        batch = make_batch(data_rng)
+        # per-step seed: deterministic data stream across checkpoint resume
+        batch = make_batch(np.random.RandomState(i))
         state, metrics = step(state, batch, rng)
         if i == start:
             jax.block_until_ready(metrics["loss"])
@@ -195,7 +213,7 @@ def _generic_lm_task(args, kind: str) -> None:
         elif (i + 1) % 10 == 0 or i == args.steps - 1:
             jax.block_until_ready(metrics["loss"])
             _report(i + 1, metrics, t0, i - start, args.batch)
-            _maybe_save(mngr, i + 1, state)
+        _maybe_save(mngr, i + 1, state, force=i == args.steps - 1)
     if mngr is not None:
         mngr.wait_until_finished()
     log.info("%s done: %d steps on mesh %s", kind, args.steps - start,
@@ -220,6 +238,8 @@ def main(argv=None) -> int:
     p.add_argument("--tiny", action="store_true",
                    help="tiny model config (CI / smoke)")
     p.add_argument("--ckpt-dir", default="")
+    p.add_argument("--save-every", type=int, default=50,
+                   help="checkpoint save interval in steps")
     args = p.parse_args(argv)
 
     if args.task == "resnet50":
