@@ -147,3 +147,66 @@ def test_llm_server_endpoints(gen):
             await client.close()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_llm_server_streaming(gen):
+    """SSE streaming: llama.cpp-style /completion chunks and OpenAI
+    chat.completion.chunk events ending in [DONE]."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.serving.llm_server import LLMServer
+
+    server = LLMServer(generator=gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test")
+
+    def parse_sse(raw: str):
+        events = []
+        for block in raw.split("\n\n"):
+            if block.startswith("data: "):
+                events.append(block[len("data: "):])
+        return events
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            # llama.cpp format: {"content", "stop": false} ... final stop:true
+            r = await client.post("/completion", json={
+                "prompt": "hello", "n_predict": 4, "seed": 3, "stream": True})
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            events = [__import__("json").loads(e)
+                      for e in parse_sse(await r.text())]
+            assert len(events) >= 2
+            assert all(ev["stop"] is False for ev in events[:-1])
+            final = events[-1]
+            assert final["stop"] is True
+            assert final["tokens_predicted"] <= 4
+            assert "predicted_per_second" in final["timings"]
+            # streamed deltas concatenate to the non-streamed completion
+            r2 = await client.post("/completion", json={
+                "prompt": "hello", "n_predict": 4, "seed": 3})
+            j2 = await r2.json()
+            assert "".join(ev["content"] for ev in events[:-1]) == j2["content"]
+
+            # OpenAI format: role chunk, content chunks, finish chunk, [DONE]
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hey"}],
+                "max_tokens": 4, "seed": 1, "stream": True})
+            assert r.status == 200
+            raw_events = parse_sse(await r.text())
+            assert raw_events[-1] == "[DONE]"
+            chunks = [__import__("json").loads(e) for e in raw_events[:-1]]
+            assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+            assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+            assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+            assert all(c["id"] == chunks[0]["id"] for c in chunks)
+
+            # over-long prompt fails as plain JSON 400, not a broken stream
+            r = await client.post("/completion", json={
+                "prompt": "x" * 500, "n_predict": 4, "stream": True})
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

@@ -126,8 +126,16 @@ class Generator:
         sample: SampleConfig = SampleConfig(),
         seed: Optional[int] = None,
         stop_tokens: Tuple[int, ...] = (),
+        on_token=None,
     ) -> Tuple[List[int], Dict[str, float]]:
-        """Returns (generated token ids, timing stats)."""
+        """Returns (generated token ids, timing stats).
+
+        ``on_token(tok_id)`` — optional per-token callback, invoked as soon as
+        each token id is known (including any stop token) — the hook the SSE
+        streaming endpoints use.  The decode step for token i+1 is already in
+        flight on device when the callback for token i runs, so streaming
+        costs no TPU idle time.
+        """
         c = self.cfg
         n_prompt = len(prompt_tokens)
         if n_prompt == 0:
@@ -157,6 +165,8 @@ class Generator:
         for i in range(max_new_tokens):
             tok = int(next_tok)
             out.append(tok)
+            if on_token is not None:
+                on_token(tok)
             if tok in stop_tokens:
                 break
             step_key, key = jax.random.split(key)

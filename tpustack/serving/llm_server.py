@@ -7,9 +7,11 @@ server keeps llama.cpp's HTTP surface so existing clients/Gateway routes work:
 
 - ``GET  /health``              → ``{"status": "ok"}``
 - ``POST /completion``          → llama.cpp-style {content, tokens_predicted,
-                                  tokens_evaluated, timings, model, stop}
+                                  tokens_evaluated, timings, model, stop};
+                                  ``"stream": true`` → SSE token chunks
 - ``POST /tokenize``            → {tokens};  ``POST /detokenize`` → {content}
-- ``POST /v1/chat/completions`` → OpenAI-compatible chat endpoint
+- ``POST /v1/chat/completions`` → OpenAI-compatible chat endpoint, incl.
+                                  ``"stream": true`` chunk events + [DONE]
 - ``GET  /props``               → minimal server properties
 
 but the engine is this package's JAX prefill+KV-cache generator on TPU: bf16
@@ -80,6 +82,26 @@ class LLMServer:
         self._lock = asyncio.Lock()
 
     # ------------------------------------------------------------ helpers
+    def _final_payload(self, stats, stopped_eos: bool, content: str) -> dict:
+        """llama.cpp-shaped result body, shared by the non-streamed response
+        and the terminal SSE event so the two can never drift apart."""
+        return {
+            "content": content,
+            "model": self.model_name,
+            "stop": True,
+            "stopped_eos": stopped_eos,
+            "stopped_limit": not stopped_eos,
+            "tokens_evaluated": stats["prompt_tokens"],
+            "tokens_predicted": stats["generated_tokens"],
+            "timings": {
+                "prompt_n": stats["prompt_tokens"],
+                "prompt_ms": stats["prefill_s"] * 1e3,
+                "predicted_n": stats["generated_tokens"],
+                "predicted_ms": stats["decode_s"] * 1e3,
+                "predicted_per_second": stats["tokens_per_s"],
+            },
+        }
+
     def _complete(self, prompt: str, n_predict: int, temperature: float,
                   top_k: int, seed: Optional[int], greedy: bool):
         from tpustack.models.llm_generate import SampleConfig
@@ -96,6 +118,125 @@ class LLMServer:
         else:
             stopped_eos = False
         return self.tok.decode(out_ids), stats, stopped_eos
+
+    async def _stream(self, request: web.Request, prompt: str, n_predict: int,
+                      temperature: float, top_k: int, seed, fmt: str):
+        """SSE streaming shared by /completion (llama.cpp chunk shape) and
+        /v1/chat/completions (OpenAI ``chat.completion.chunk`` + ``[DONE]``).
+
+        The blocking generate loop runs in the executor; its ``on_token``
+        callback feeds an asyncio queue.  Text deltas are computed by decoding
+        the accumulated ids and emitting the suffix, so multi-byte/BPE pieces
+        never split mid-character.
+        """
+        from tpustack.models.llm_generate import SampleConfig
+
+        ids = self.tok.encode(prompt)
+        if len(ids) >= self.gen.cfg.max_seq:  # fail as JSON before SSE starts
+            msg = f"prompt ({len(ids)}) exceeds ctx {self.gen.cfg.max_seq}"
+            if fmt == "openai":
+                return web.json_response({"error": {"message": msg}}, status=400)
+            return web.json_response({"error": msg}, status=400)
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+
+        async def send(payload) -> None:
+            await resp.write(b"data: " + json.dumps(payload).encode() + b"\n\n")
+
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def worker():
+            try:
+                return self.gen.generate(
+                    ids, max_new_tokens=n_predict,
+                    sample=SampleConfig(temperature=temperature, top_k=top_k,
+                                        greedy=temperature <= 0),
+                    seed=seed, stop_tokens=(self.tok.eos_id,),
+                    on_token=lambda t: loop.call_soon_threadsafe(q.put_nowait, t))
+            finally:
+                loop.call_soon_threadsafe(q.put_nowait, None)  # end-of-stream
+
+        chat_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+        created = int(time.time())
+
+        def chat_chunk(delta, finish=None):
+            return {"id": chat_id, "object": "chat.completion.chunk",
+                    "created": created, "model": self.model_name,
+                    "choices": [{"index": 0, "delta": delta,
+                                 "finish_reason": finish}]}
+
+        t0 = time.time()
+        async with self._lock:
+            fut = loop.run_in_executor(None, worker)
+            if fmt == "openai":
+                await send(chat_chunk({"role": "assistant", "content": ""}))
+            gen_ids, emitted = [], ""
+            while True:
+                tok = await q.get()
+                if tok is None:
+                    break
+                if tok == self.tok.eos_id:
+                    continue
+                gen_ids.append(tok)
+                text = self.tok.decode(gen_ids)
+                # hold back trailing U+FFFD: usually an incomplete multi-byte
+                # sequence that the next token completes; flushed after the loop
+                safe = text.rstrip("�")
+                if len(safe) <= len(emitted):
+                    continue
+                delta, emitted = safe[len(emitted):], safe
+                if fmt == "openai":
+                    await send(chat_chunk({"content": delta}))
+                else:
+                    await send({"content": delta, "stop": False})
+            try:
+                out_ids, stats = await fut
+            except ValueError as e:
+                # stream already started: surface the error as a final event
+                if fmt == "openai":
+                    await send(chat_chunk({}, finish="error") | {
+                        "error": {"message": str(e)}})
+                else:
+                    await send({"content": "", "stop": True, "error": str(e)})
+                await resp.write_eof()
+                return resp
+
+        # flush anything held back (trailing bytes that never completed)
+        tail = self.tok.decode(gen_ids)[len(emitted):]
+        if tail:
+            if fmt == "openai":
+                await send(chat_chunk({"content": tail}))
+            else:
+                await send({"content": tail, "stop": False})
+
+        stopped_eos = bool(out_ids) and out_ids[-1] == self.tok.eos_id
+        if fmt == "openai":
+            await send(chat_chunk({}, finish="stop" if stopped_eos else "length"))
+            await resp.write(b"data: [DONE]\n\n")
+        else:
+            await send({
+                "content": "", "model": self.model_name, "stop": True,
+                "stopped_eos": stopped_eos, "stopped_limit": not stopped_eos,
+                "tokens_evaluated": stats["prompt_tokens"],
+                "tokens_predicted": stats["generated_tokens"],
+                "timings": {
+                    "prompt_n": stats["prompt_tokens"],
+                    "prompt_ms": stats["prefill_s"] * 1e3,
+                    "predicted_n": stats["generated_tokens"],
+                    "predicted_ms": stats["decode_s"] * 1e3,
+                    "predicted_per_second": stats["tokens_per_s"],
+                },
+            })
+        log.info("stream %s: %d prompt tok, %d gen tok, %.2fs", fmt,
+                 stats["prompt_tokens"], stats["generated_tokens"],
+                 time.time() - t0)
+        await resp.write_eof()
+        return resp
 
     # ----------------------------------------------------------- handlers
     async def health(self, request: web.Request) -> web.Response:
@@ -125,6 +266,9 @@ class LLMServer:
         if n_predict < 0:  # llama.cpp: -1 means "until EOS / context limit"
             n_predict = self.gen.cfg.max_seq
         seed = body.get("seed")
+        if body.get("stream"):
+            return await self._stream(request, prompt, n_predict, temperature,
+                                      top_k, seed, fmt="llamacpp")
 
         t0 = time.time()
         try:
@@ -180,6 +324,9 @@ class LLMServer:
         except (TypeError, ValueError) as e:
             return web.json_response(
                 {"error": {"message": f"invalid parameter: {e}"}}, status=400)
+        if body.get("stream"):
+            return await self._stream(request, prompt, n_predict, temperature,
+                                      40, body.get("seed"), fmt="openai")
 
         try:
             async with self._lock:
