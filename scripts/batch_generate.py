@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import threading
 import time
 import traceback
 from pathlib import Path
@@ -26,40 +27,70 @@ import requests
 DEFAULT_URL = "http://127.0.0.1:30800/generate"
 
 
+_tls = threading.local()
+
+
+def _thread_session() -> requests.Session:
+    """One Session per worker thread — requests documents Session as not
+    thread-safe under concurrent mutation (cookies/redirects)."""
+    if getattr(_tls, "session", None) is None:
+        _tls.session = requests.Session()
+    return _tls.session
+
+
+def _one_request(url: str, payload: dict, target: Path, name: str) -> bool:
+    try:
+        resp = _thread_session().post(url, json=payload, timeout=600)
+        resp.raise_for_status()
+        target.write_bytes(resp.content)
+        gen_time = resp.headers.get("X-Gen-Time", "?")
+        print(f"    {name} done in {gen_time}")
+        return True
+    except requests.exceptions.RequestException as e:
+        print(f"    Request failed for {name}: {e}")
+        traceback.print_exc()
+    except Exception as e:
+        print(f"    Unexpected error for {name}: {e}")
+        traceback.print_exc()
+    return False
+
+
 def generate(prompt: str, steps: int, url: str, out_dir: Path, prefix: str,
              count: int, delay: float, width: int | None = None,
-             height: int | None = None) -> int:
+             height: int | None = None, concurrency: int = 1) -> int:
     out_dir.mkdir(parents=True, exist_ok=True)
-    session = requests.Session()
     ok = 0
     t_start = time.time()
 
-    for idx in range(1, count + 1):
-        name = f"{prefix}_{idx:02d}.png"
-        target = out_dir / name
-        payload = {"prompt": prompt, "steps": steps}
-        if width is not None:
-            payload["width"] = width
-        if height is not None:
-            payload["height"] = height
+    payload = {"prompt": prompt, "steps": steps}
+    if width is not None:
+        payload["width"] = width
+    if height is not None:
+        payload["height"] = height
 
-        print(f"[*] Generating {name} -> {target}")
-        try:
-            resp = session.post(url, json=payload, timeout=600)
-            resp.raise_for_status()
-            target.write_bytes(resp.content)
-            gen_time = resp.headers.get("X-Gen-Time", "?")
-            print(f"    done in {gen_time}")
-            ok += 1
-        except requests.exceptions.RequestException as e:
-            print(f"    Request failed for {name}: {e}")
-            traceback.print_exc()
-        except Exception as e:
-            print(f"    Unexpected error for {name}: {e}")
-            traceback.print_exc()
+    if concurrency > 1:
+        # in-flight requests land in the server's micro-batch window and ride
+        # one fused program across the pod's chips (SD15_DP); the reference
+        # could only send one at a time to its single GPU
+        from concurrent.futures import ThreadPoolExecutor
 
-        if delay > 0 and idx != count:
-            time.sleep(delay)
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            futs = []
+            for idx in range(1, count + 1):
+                name = f"{prefix}_{idx:02d}.png"
+                print(f"[*] Generating {name} -> {out_dir / name}")
+                futs.append(pool.submit(_one_request, url, dict(payload),
+                                        out_dir / name, name))
+                if delay > 0 and idx != count:  # paces submissions only
+                    time.sleep(delay)
+            ok = sum(f.result() for f in futs)
+    else:
+        for idx in range(1, count + 1):
+            name = f"{prefix}_{idx:02d}.png"
+            print(f"[*] Generating {name} -> {out_dir / name}")
+            ok += _one_request(url, dict(payload), out_dir / name, name)
+            if delay > 0 and idx != count:
+                time.sleep(delay)
 
     wall = time.time() - t_start
     if ok:
@@ -88,11 +119,15 @@ def main(argv: list[str]) -> int:
                         help="image width (server default if omitted)")
     parser.add_argument("--height", type=int, default=None,
                         help="image height (server default if omitted)")
+    parser.add_argument("--concurrency", type=int, default=1,
+                        help="in-flight requests; >1 lets the server micro-"
+                             "batch them across its chips (default: 1)")
     args = parser.parse_args(argv)
 
     out_dir = Path(args.out_dir)
     ok = generate(args.prompt, args.steps, args.url, out_dir, args.prefix,
-                  args.count, args.delay, args.width, args.height)
+                  args.count, args.delay, args.width, args.height,
+                  concurrency=args.concurrency)
     print(f"All done. Images saved under {out_dir.resolve()}")
     return 0 if ok == args.count else 1
 

@@ -210,3 +210,59 @@ def test_llm_server_streaming(gen):
             await client.close()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_stream_disconnect_cancels_worker_and_lock_outlives_handler(gen):
+    """A dead client's generate worker is (a) told to stop via the on_token
+    cancel hook and (b) the generation lock is held by an independent task
+    until the worker thread exits, even if the handler awaiting it is
+    cancelled (the one-generation-at-a-time invariant)."""
+    import threading
+
+    from tpustack.serving.llm_server import LLMServer, _Cancelled
+
+    server = LLMServer(generator=gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test")
+
+    # (a) the cancel hook aborts generation mid-flight
+    seen = []
+    cancel = threading.Event()
+
+    def on_token(t):
+        seen.append(t)
+        if len(seen) >= 2:
+            cancel.set()
+        if cancel.is_set():
+            raise _Cancelled()
+
+    with pytest.raises(_Cancelled):
+        gen.generate(ByteTokenizer(512).encode("hi"), max_new_tokens=32,
+                     sample=SampleConfig(greedy=True), seed=0,
+                     on_token=on_token)
+    assert len(seen) == 2  # stopped right after the cancel, not after 32
+
+    # (b) _run_on_device: cancelling the awaiting handler does NOT release
+    # the lock until the worker finishes; the next request then proceeds
+    async def scenario():
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow_worker():
+            started.set()
+            release.wait(timeout=10)
+            return "done"
+
+        handler = asyncio.ensure_future(server._run_on_device(slow_worker))
+        await asyncio.sleep(0.05)
+        assert started.is_set()
+        handler.cancel()  # simulated client teardown mid-generation
+        with pytest.raises(asyncio.CancelledError):
+            await handler
+        assert server._lock.locked()  # device still accounted for
+        nxt = asyncio.ensure_future(server._run_on_device(lambda: "next"))
+        await asyncio.sleep(0.05)
+        assert not nxt.done()  # queued behind the detached worker
+        release.set()
+        assert await nxt == "next"
+
+    asyncio.new_event_loop().run_until_complete(scenario())

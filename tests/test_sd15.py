@@ -86,6 +86,21 @@ def test_hash_tokenizer_deterministic():
     assert not (a[0] == b[0]).all()
 
 
+def test_pipeline_generate_dp_mesh(pipe, mesh8):
+    """DP generate over the 8-device mesh matches the unsharded program."""
+    kw = dict(steps=2, seed=7, width=64, height=64, batch_size=8)
+    ref, _ = pipe.generate("mesh test", **kw)
+    img, _ = pipe.generate("mesh test", mesh=mesh8, **kw)
+    assert img.shape == (8, 64, 64, 3)
+    # same fused program partitioned by GSPMD: pixel-identical up to reduction
+    # order; uint8 quantisation allows off-by-one
+    assert np.abs(img.astype(int) - ref.astype(int)).max() <= 1
+
+    with pytest.raises(ValueError, match="not divisible"):
+        pipe.generate("mesh test", mesh=mesh8, steps=2, width=64, height=64,
+                      batch_size=3)
+
+
 def test_pipeline_generate_tiny(pipe):
     img, latency = pipe.generate("a tiny test", steps=2, seed=42, width=64, height=64)
     assert img.shape == (1, 64, 64, 3)

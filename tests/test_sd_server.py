@@ -112,6 +112,57 @@ def test_rest_contract(server, monkeypatch):
     _run(scenario())
 
 
+def test_micro_batching_coalesces_requests(server, mesh8):
+    """Concurrent /generate requests with the same signature ride ONE
+    pipeline call (micro-batcher), padded for the mesh, and each caller
+    still gets its own seeded-deterministic image."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.serving.sd_server import SDServer
+
+    batched = SDServer(pipeline=server.pipe, mesh=mesh8,
+                       batch_window_ms=500, max_batch=4)
+    calls = []
+    real_generate = batched.pipe.generate
+
+    def counting_generate(*a, **kw):
+        calls.append(kw.get("seed"))
+        return real_generate(*a, **kw)
+
+    batched.pipe = type(server.pipe)(server.pipe.config, params=server.pipe.params)
+    batched.pipe.generate = counting_generate
+
+    async def scenario():
+        client = TestClient(TestServer(batched.build_app()))
+        await client.start_server()
+        try:
+            body = {"prompt": "a red panda", "steps": 2, "width": 64,
+                    "height": 64}
+            rs = await asyncio.gather(*[
+                client.post("/generate", json=dict(body, seed=s))
+                for s in (11, 12, 13)])
+            pngs = [await r.read() for r in rs]
+            assert all(r.status == 200 for r in rs)
+            assert all(p[:8] == PNG_MAGIC for p in pngs)
+            # one pipeline call for 3 requests, padded to dp*fsdp=4
+            # (arrival order within the window is not guaranteed — sort)
+            assert len(calls) == 1 and len(calls[0]) == 4
+            assert sorted(calls[0][:3]) == [11, 12, 13]
+            # per-request determinism survives batching: re-request seed 12
+            # alone and compare bytes
+            r = await client.post("/generate", json=dict(body, seed=12))
+            assert (await r.read()) == pngs[1]
+            # a mixed-signature request must not be batched with the others
+            r = await client.post("/generate", json=dict(body, seed=12,
+                                                         steps=3))
+            assert r.status == 200
+            assert len(calls) == 3
+        finally:
+            await client.close()
+
+    _run(scenario())
+
+
 @pytest.mark.slow
 def test_e2e_subprocess_with_batch_generate_client(tmp_path):
     """Full loop: real server process ← HTTP → the reference-parity client."""

@@ -54,6 +54,8 @@ class SD15Pipeline:
         self.tokenizer = load_tokenizer(self.config.text.vocab_size,
                                         self.config.text.max_length)
         self.params = params if params is not None else self._random_init(seed)
+        # (mesh, source params, replicated device params) cache for DP generate
+        self._mesh_params = None
 
     # ---------------------------------------------------------------- init
     def _random_init(self, seed: int) -> Dict[str, Any]:
@@ -104,21 +106,34 @@ class SD15Pipeline:
     # ---------------------------------------------------------------- public
     def generate(
         self,
-        prompt: str,
+        prompt,
         *,
         steps: int = 30,
         guidance_scale: float = 7.5,
-        seed: Optional[int] = None,
+        seed=None,
         width: int = 512,
         height: int = 512,
-        negative_prompt: str = "",
+        negative_prompt="",
         batch_size: int = 1,
+        mesh=None,
     ) -> Tuple[np.ndarray, float]:
         """Returns (``[B, H, W, 3]`` uint8 images, wall latency seconds).
 
         Matches the reference request schema {prompt, steps, guidance_scale,
         seed, width, height} (configmap.yaml:52-58); negative_prompt and
         batch_size are supersets.
+
+        ``prompt``/``negative_prompt``/``seed`` may each be a sequence (one
+        per image) — distinct requests batch into ONE fused program (the
+        server's micro-batcher relies on this); a scalar is broadcast over
+        ``batch_size``.
+
+        ``mesh``: optional ``jax.sharding.Mesh`` — images are data-parallel
+        over the ``dp``×``fsdp`` axes (params replicated; SD1.5 fits any
+        chip), the TPU equivalent of the reference's "one GPU per pod, k8s
+        spreads the Job" scale story (SURVEY.md §2.10) inside ONE program:
+        XLA partitions the same fused generate over all chips, no NCCL/no
+        per-pod orchestration.  ``batch_size`` must divide by dp*fsdp.
         """
         c = self.config
         # latents must survive the UNet's own down/up path cleanly
@@ -126,16 +141,61 @@ class SD15Pipeline:
         if width % factor or height % factor:
             raise ValueError(f"width/height must be multiples of {factor}")
         t0 = time.time()
-        cond = jnp.asarray(self.tokenizer([prompt] * batch_size))
-        uncond = jnp.asarray(self.tokenizer([negative_prompt] * batch_size))
-        key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None else seed)
-        noise = jax.random.normal(
-            key, (batch_size, height // c.vae_scale, width // c.vae_scale,
-                  c.unet.in_channels), jnp.float32)
-        img = self._generate(self.params, cond, uncond, noise, int(steps),
+        prompts = [prompt] * batch_size if isinstance(prompt, str) else list(prompt)
+        negs = ([negative_prompt] * len(prompts) if isinstance(negative_prompt, str)
+                else list(negative_prompt))
+        seeds = seed if isinstance(seed, (list, tuple)) else [seed] * len(prompts)
+        if not len(prompts) == len(negs) == len(seeds):
+            raise ValueError(
+                f"prompt/negative_prompt/seed lengths differ: "
+                f"{len(prompts)}/{len(negs)}/{len(seeds)}")
+        batch_size = len(prompts)
+        cond = jnp.asarray(self.tokenizer(prompts))
+        uncond = jnp.asarray(self.tokenizer(negs))
+        lat_hw = (height // c.vae_scale, width // c.vae_scale, c.unet.in_channels)
+        if isinstance(seed, (list, tuple)):  # per-image seeds → per-image draws
+            keys = [jax.random.PRNGKey(np.random.randint(0, 2**31) if s is None else s)
+                    for s in seeds]
+            noise = jnp.concatenate(
+                [jax.random.normal(k, (1,) + lat_hw, jnp.float32) for k in keys],
+                axis=0)
+        else:  # scalar seed: one draw over the whole batch (per-image variety)
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None else seed)
+            noise = jax.random.normal(key, (batch_size,) + lat_hw, jnp.float32)
+        params = self.params
+        if mesh is not None:
+            params, cond, uncond, noise = self._shard_for_mesh(
+                mesh, cond, uncond, noise)
+        img = self._generate(params, cond, uncond, noise, int(steps),
                              jnp.float32(guidance_scale))
         img = np.asarray(img)
         return img, time.time() - t0
+
+    def _shard_for_mesh(self, mesh, cond, uncond, noise):
+        """Replicate params on ``mesh`` (cached) and shard the batch inputs
+        over dp×fsdp; the jitted ``_generate`` then compiles as one
+        XLA-partitioned program across all mesh devices."""
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        from tpustack.parallel import data_parallel_size
+
+        data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        n_data = data_parallel_size(mesh) or 1
+        if noise.shape[0] % max(n_data, 1):
+            raise ValueError(
+                f"batch_size {noise.shape[0]} not divisible by mesh dp*fsdp={n_data}")
+        batch_sharding = NamedSharding(mesh, PS(data_axes or None))
+        cached = self._mesh_params
+        # key on the source params object too: pipe.params may be reassigned
+        # (e.g. weights loaded after a warmup) and must not serve stale HBM
+        if cached is None or cached[0] is not mesh or cached[1] is not self.params:
+            replicated = NamedSharding(mesh, PS())
+            self._mesh_params = (mesh, self.params, jax.device_put(
+                self.params, jax.tree.map(lambda _: replicated, self.params)))
+        params = self._mesh_params[2]
+        cond, uncond, noise = (jax.device_put(t, batch_sharding)
+                               for t in (cond, uncond, noise))
+        return params, cond, uncond, noise
 
     def warmup(self, **kw) -> float:
         """Compile the generate program for the given signature; returns seconds."""

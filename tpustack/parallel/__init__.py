@@ -1,3 +1,4 @@
-from tpustack.parallel.mesh import MeshConfig, build_mesh, best_mesh_shape
+from tpustack.parallel.mesh import (MeshConfig, best_mesh_shape, build_mesh,
+                                    data_parallel_size)
 
-__all__ = ["MeshConfig", "build_mesh", "best_mesh_shape"]
+__all__ = ["MeshConfig", "build_mesh", "best_mesh_shape", "data_parallel_size"]

@@ -66,6 +66,16 @@ def best_mesh_shape(n_devices: int, tp: int = 1, sp: int = 1, fsdp: Optional[int
     return (rest // fsdp, fsdp, tp, sp)
 
 
+def data_parallel_size(mesh: Optional[Mesh]) -> int:
+    """dp×fsdp ways of a mesh — the number of batch shards GSPMD will cut.
+    Single source of truth for batch-padding (server) and divisibility
+    checks (pipeline); 0 when ``mesh`` is None."""
+    if mesh is None:
+        return 0
+    axes = [a for a in ("dp", "fsdp") if a in mesh.axis_names]
+    return int(np.prod([mesh.shape[a] for a in axes])) if axes else 0
+
+
 def build_mesh(
     shape: Optional[Sequence[int]] = None,
     *,

@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import threading
 import time
 import uuid
 from typing import Optional
@@ -36,6 +37,11 @@ from aiohttp import web
 from tpustack.utils import get_logger
 
 log = get_logger("serving.llm_server")
+
+
+class _Cancelled(Exception):
+    """Raised inside the generate loop (via on_token) to abandon a stream
+    whose client went away — stops burning TPU on a dead connection."""
 
 
 def _or_default(value, default):
@@ -80,6 +86,23 @@ class LLMServer:
         self.tok = tokenizer
         self.model_name = model_name
         self._lock = asyncio.Lock()
+
+    async def _run_on_device(self, fn):
+        """Run blocking ``fn`` in the executor under the generation lock, in
+        a task INDEPENDENT of the calling handler: if the handler is torn
+        down (client disconnect, shutdown), the lock is still held until the
+        worker thread actually exits — one generation at a time, always."""
+        loop = asyncio.get_running_loop()
+
+        async def locked():
+            async with self._lock:
+                return await loop.run_in_executor(None, fn)
+
+        task = asyncio.ensure_future(locked())
+        # if we get cancelled below, the task runs on detached; swallow its
+        # result/exception so it never logs "exception was never retrieved"
+        task.add_done_callback(lambda t: t.cancelled() or t.exception())
+        return await asyncio.shield(task)
 
     # ------------------------------------------------------------ helpers
     def _final_payload(self, stats, stopped_eos: bool, content: str) -> dict:
@@ -145,19 +168,31 @@ class LLMServer:
         await resp.prepare(request)
 
         async def send(payload) -> None:
-            await resp.write(b"data: " + json.dumps(payload).encode() + b"\n\n")
+            # bounded write: a stalled-but-connected reader (TCP zero window)
+            # must not wedge this handler forever
+            await asyncio.wait_for(
+                resp.write(b"data: " + json.dumps(payload).encode() + b"\n\n"),
+                timeout=60)
 
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
+        cancel = threading.Event()
+
+        def on_token(t):
+            loop.call_soon_threadsafe(q.put_nowait, t)
+            if cancel.is_set():
+                raise _Cancelled()  # aborts generate inside the worker thread
 
         def worker():
             try:
+                if cancel.is_set():  # client died while we were queued:
+                    raise _Cancelled()  # skip the whole prefill
                 return self.gen.generate(
                     ids, max_new_tokens=n_predict,
                     sample=SampleConfig(temperature=temperature, top_k=top_k,
                                         greedy=temperature <= 0),
                     seed=seed, stop_tokens=(self.tok.eos_id,),
-                    on_token=lambda t: loop.call_soon_threadsafe(q.put_nowait, t))
+                    on_token=on_token)
             finally:
                 loop.call_soon_threadsafe(q.put_nowait, None)  # end-of-stream
 
@@ -170,12 +205,35 @@ class LLMServer:
                     "choices": [{"index": 0, "delta": delta,
                                  "finish_reason": finish}]}
 
+        # incremental detokenisation (the vLLM/TGI sliding-window recipe):
+        # decode a window that keeps a few tokens of context so BPE/
+        # sentencepiece spacing renders as it would in the full text, and
+        # hold back while the window ends in U+FFFD (incomplete multi-byte)
+        gen_ids = []
+        prefix_off = read_off = 0
+
+        def next_delta() -> str:
+            nonlocal prefix_off, read_off
+            prev = self.tok.decode(gen_ids[prefix_off:read_off])
+            text = self.tok.decode(gen_ids[prefix_off:])
+            if len(text) <= len(prev):
+                return ""
+            # hold back a trailing U+FFFD (incomplete multi-byte) — unless
+            # the window has stalled so long (genuinely invalid byte stream)
+            # that holding would grow it unboundedly
+            if text.endswith("�") and len(gen_ids) - read_off <= 16:
+                return ""
+            prefix_off = max(read_off - 4, 0)
+            read_off = len(gen_ids)
+            return text[len(prev):]
+
         t0 = time.time()
-        async with self._lock:
-            fut = loop.run_in_executor(None, worker)
+
+        locked_task = asyncio.ensure_future(self._run_on_device(worker))
+        locked_task.add_done_callback(lambda t: t.cancelled() or t.exception())
+        try:
             if fmt == "openai":
                 await send(chat_chunk({"role": "assistant", "content": ""}))
-            gen_ids, emitted = [], ""
             while True:
                 tok = await q.get()
                 if tok is None:
@@ -183,19 +241,15 @@ class LLMServer:
                 if tok == self.tok.eos_id:
                     continue
                 gen_ids.append(tok)
-                text = self.tok.decode(gen_ids)
-                # hold back trailing U+FFFD: usually an incomplete multi-byte
-                # sequence that the next token completes; flushed after the loop
-                safe = text.rstrip("�")
-                if len(safe) <= len(emitted):
+                delta = next_delta()
+                if not delta:
                     continue
-                delta, emitted = safe[len(emitted):], safe
                 if fmt == "openai":
                     await send(chat_chunk({"content": delta}))
                 else:
                     await send({"content": delta, "stop": False})
             try:
-                out_ids, stats = await fut
+                out_ids, stats = await locked_task
             except ValueError as e:
                 # stream already started: surface the error as a final event
                 if fmt == "openai":
@@ -205,9 +259,17 @@ class LLMServer:
                     await send({"content": "", "stop": True, "error": str(e)})
                 await resp.write_eof()
                 return resp
+        except BaseException:
+            # client gone / write timed out / handler cancelled: tell the
+            # worker to stop at its next token; _run_on_device keeps holding
+            # the lock until the worker actually exits, so the device stays
+            # accounted for without any orphan bookkeeping here
+            cancel.set()
+            raise
 
         # flush anything held back (trailing bytes that never completed)
-        tail = self.tok.decode(gen_ids)[len(emitted):]
+        tail = self.tok.decode(gen_ids[prefix_off:])[
+            len(self.tok.decode(gen_ids[prefix_off:read_off])):]
         if tail:
             if fmt == "openai":
                 await send(chat_chunk({"content": tail}))
@@ -219,19 +281,7 @@ class LLMServer:
             await send(chat_chunk({}, finish="stop" if stopped_eos else "length"))
             await resp.write(b"data: [DONE]\n\n")
         else:
-            await send({
-                "content": "", "model": self.model_name, "stop": True,
-                "stopped_eos": stopped_eos, "stopped_limit": not stopped_eos,
-                "tokens_evaluated": stats["prompt_tokens"],
-                "tokens_predicted": stats["generated_tokens"],
-                "timings": {
-                    "prompt_n": stats["prompt_tokens"],
-                    "prompt_ms": stats["prefill_s"] * 1e3,
-                    "predicted_n": stats["generated_tokens"],
-                    "predicted_ms": stats["decode_s"] * 1e3,
-                    "predicted_per_second": stats["tokens_per_s"],
-                },
-            })
+            await send(self._final_payload(stats, stopped_eos, content=""))
         log.info("stream %s: %d prompt tok, %d gen tok, %.2fs", fmt,
                  stats["prompt_tokens"], stats["generated_tokens"],
                  time.time() - t0)
@@ -272,30 +322,14 @@ class LLMServer:
 
         t0 = time.time()
         try:
-            async with self._lock:
-                content, stats, stopped_eos = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: self._complete(prompt, n_predict, temperature,
-                                                 top_k, seed, False))
+            content, stats, stopped_eos = await self._run_on_device(
+                lambda: self._complete(prompt, n_predict, temperature,
+                                       top_k, seed, False))
         except ValueError as e:  # e.g. prompt longer than the context window
             return web.json_response({"error": str(e)}, status=400)
         log.info("completion: %d prompt tok, %d gen tok, %.2fs",
                  stats["prompt_tokens"], stats["generated_tokens"], time.time() - t0)
-        return web.json_response({
-            "content": content,
-            "model": self.model_name,
-            "stop": True,
-            "stopped_eos": stopped_eos,
-            "stopped_limit": not stopped_eos,
-            "tokens_evaluated": stats["prompt_tokens"],
-            "tokens_predicted": stats["generated_tokens"],
-            "timings": {
-                "prompt_n": stats["prompt_tokens"],
-                "prompt_ms": stats["prefill_s"] * 1e3,
-                "predicted_n": stats["generated_tokens"],
-                "predicted_ms": stats["decode_s"] * 1e3,
-                "predicted_per_second": stats["tokens_per_s"],
-            },
-        })
+        return web.json_response(self._final_payload(stats, stopped_eos, content))
 
     async def tokenize(self, request: web.Request) -> web.Response:
         body = await request.json()
@@ -329,10 +363,9 @@ class LLMServer:
                                       40, body.get("seed"), fmt="openai")
 
         try:
-            async with self._lock:
-                content, stats, stopped_eos = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: self._complete(prompt, n_predict, temperature,
-                                                 40, body.get("seed"), False))
+            content, stats, stopped_eos = await self._run_on_device(
+                lambda: self._complete(prompt, n_predict, temperature,
+                                       40, body.get("seed"), False))
         except ValueError as e:
             return web.json_response({"error": {"message": str(e)}}, status=400)
         return web.json_response({

@@ -15,21 +15,27 @@ Implementation differences, all TPU-motivated: aiohttp instead of
 FastAPI/uvicorn (no ASGI dependency in the base image), the model is this
 package's jitted JAX pipeline instead of torch/diffusers, and there is no
 autocast/attention-slicing/VAE-offload — bf16 and 16 GB HBM make them moot
-(cf. configmap.yaml:42-45).  Generation is serialised with a lock like the
-reference's ``_LAST_LOCK`` (configmap.yaml:38-39) — one chip, one queue.
+(cf. configmap.yaml:42-45).  Device work is serialised with a lock like the
+reference's ``_LAST_LOCK`` (configmap.yaml:38-39), but concurrent requests
+with the same (steps, guidance, size) signature are **micro-batched** into
+one fused program — and, with ``SD15_DP=N``, data-parallel across the pod's
+N chips via GSPMD (the reference's only scale story was one-GPU-per-pod).
 
 Env flags (mirroring the reference's env contract, deployment.yaml:43-53):
 ``MODEL_DIR`` (diffusers safetensors snapshot; random weights if unset),
-``SD15_PRESET`` (``sd15``|``tiny``), ``PORT``, ``SD15_TOKENIZER_DIR``.
+``SD15_PRESET`` (``sd15``|``tiny``), ``PORT``, ``SD15_TOKENIZER_DIR``,
+``SD15_DP`` (dp mesh size), ``SD15_BATCH_WINDOW_MS`` (batch collection
+window, default 15), ``SD15_MAX_BATCH`` (default dp×fsdp or 1).
 """
 
 from __future__ import annotations
 
 import asyncio
 import base64
+import dataclasses
 import os
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from aiohttp import web
 from pydantic import BaseModel, ValidationError
@@ -53,13 +59,57 @@ class GenReq(BaseModel):
     negative_prompt: Optional[str] = ""
 
 
+@dataclasses.dataclass
+class _PendingReq:
+    prompt: str
+    negative: str
+    seed: Optional[int]
+    future: asyncio.Future
+
+
 class SDServer:
-    def __init__(self, pipeline=None):
+    def __init__(self, pipeline=None, mesh=None, batch_window_ms: float = None,
+                 max_batch: int = None):
         if pipeline is None:
             pipeline = self._pipeline_from_env()
         self.pipe = pipeline
+        self.mesh = mesh if mesh is not None else self._mesh_from_env()
         self._last_image: Optional[bytes] = None
         self._lock = asyncio.Lock()
+        # ---- dynamic micro-batcher (TPU-native: one fused program serves
+        # many queued requests at once; the reference serialised requests on
+        # its single GPU, configmap.yaml:38-39) ----
+        if batch_window_ms is None:
+            batch_window_ms = float(os.environ.get("SD15_BATCH_WINDOW_MS", "15"))
+        if max_batch is None:
+            max_batch = int(os.environ.get("SD15_MAX_BATCH", "0") or 0)
+        if not max_batch:
+            max_batch = self._mesh_data_size() or 1
+        # invariants that keep _padded_size ≤ max_batch (the operator's HBM
+        # cap must never be exceeded by pow2 padding): round a non-pow2 cap
+        # down, and raise it to dp×fsdp (padding reaches that regardless)
+        pow2 = 1
+        while pow2 * 2 <= max_batch:
+            pow2 *= 2
+        if pow2 != max_batch:
+            log.warning("SD15_MAX_BATCH=%d is not a power of two; using %d "
+                        "(batches pad to pow2 signatures)", max_batch, pow2)
+            max_batch = pow2
+        n_data = self._mesh_data_size()
+        if n_data and max_batch % n_data:
+            # below dp×fsdp (padding reaches that regardless) or not a
+            # multiple of it (padding would overshoot the cap): round up
+            rounded = max_batch + (-max_batch) % n_data
+            log.warning("SD15_MAX_BATCH=%d not a multiple of mesh dp×fsdp=%d;"
+                        " using %d", max_batch, n_data, rounded)
+            max_batch = rounded
+        self.batch_window_s = batch_window_ms / 1e3
+        self.max_batch = max_batch
+        # shape-key → (group id, [_PendingReq]); the id lets a window flusher
+        # detect that "its" group was already drained by a full-batch flush,
+        # so a stale timer never shrinks the NEXT group's window
+        self._pending: Dict[tuple, tuple] = {}
+        self._group_seq = 0
 
     @staticmethod
     def _pipeline_from_env():
@@ -75,6 +125,27 @@ class SDServer:
             pipe.params = load_sd15_safetensors(model_dir, cfg, pipe.params)
             log.info("Loaded weights from %s", model_dir)
         return pipe
+
+    def _mesh_data_size(self) -> int:
+        """Number of data-parallel ways on the mesh (dp×fsdp), or 0 if none."""
+        from tpustack.parallel import data_parallel_size
+
+        return data_parallel_size(self.mesh)
+
+    @staticmethod
+    def _mesh_from_env():
+        """``SD15_DP=N`` → dp mesh over the pod's N chips (v5e-8 Deployment:
+        one server process, batch requests data-parallel across all chips —
+        the reference could only scale by adding pods, SURVEY.md §2.10)."""
+        dp = int(os.environ.get("SD15_DP", "0") or 0)
+        if dp <= 1:
+            return None
+        import jax
+
+        from tpustack.parallel import build_mesh
+
+        # dp may be smaller than the pod's visible chip count — use a subset
+        return build_mesh((dp, 1, 1, 1), devices=jax.devices()[:dp])
 
     # ------------------------------------------------------------ handlers
     async def healthz(self, request: web.Request) -> web.Response:
@@ -126,25 +197,101 @@ class SDServer:
             req.seed if req.seed is not None else "auto", width, height)
 
         try:
-            async with self._lock:  # one chip — serialise like the reference
-                imgs, _ = await asyncio.get_running_loop().run_in_executor(
-                    None,
-                    lambda: self.pipe.generate(
-                        req.prompt,
-                        steps=steps,
-                        guidance_scale=guidance,
-                        seed=req.seed,
-                        width=width,
-                        height=height,
-                        negative_prompt=req.negative_prompt or ""))
+            img = await self._enqueue(
+                key=(steps, float(guidance), width, height),
+                req=_PendingReq(req.prompt, req.negative_prompt or "",
+                                req.seed, asyncio.get_running_loop().create_future()))
         except ValueError as e:  # e.g. size not a multiple of the UNet factor
             return web.json_response({"detail": str(e)}, status=400)
-        png = array_to_png(imgs[0])
+        png = array_to_png(img)
         latency = time.time() - t0
         log.info("Completed generation in %.2fs", latency)
         self._last_image = png
         return web.Response(body=png, content_type="image/png",
                             headers={"X-Gen-Time": f"{latency:.2f}s"})
+
+    # ------------------------------------------------------- micro-batcher
+    async def _enqueue(self, key: tuple, req: _PendingReq):
+        """Queue one request; concurrent requests with the same compiled
+        signature (steps, guidance, size) ride the same fused program.
+
+        The first request in a group starts a flusher task that waits
+        ``batch_window_s`` for company, then drains up to ``max_batch``
+        requests into one ``pipe.generate`` call; a group hitting
+        ``max_batch`` flushes immediately.  On a mesh the batch is padded to
+        a multiple of dp×fsdp so GSPMD can split it.
+        """
+        if key not in self._pending:
+            self._group_seq += 1
+            self._pending[key] = (self._group_seq, [])
+        gid, group = self._pending[key]
+        group.append(req)
+        if len(group) >= self.max_batch:
+            asyncio.ensure_future(self._flush(key, gid, wait=False))
+        elif len(group) == 1:
+            asyncio.ensure_future(self._flush(key, gid, wait=self.max_batch > 1))
+        return await req.future
+
+    async def _flush(self, key: tuple, gid: int, wait: bool) -> None:
+        if wait:
+            await asyncio.sleep(self.batch_window_s)  # collection window
+        async with self._lock:
+            entry = self._pending.get(key)
+            if entry is None or entry[0] != gid:
+                return  # this group was already drained; don't touch the next
+            _, group = entry
+            batch, rest = group[:self.max_batch], group[self.max_batch:]
+            if rest:
+                self._group_seq += 1
+                self._pending[key] = (self._group_seq, rest)
+                asyncio.ensure_future(self._flush(key, self._group_seq, wait=False))
+            else:
+                self._pending.pop(key, None)
+            await self._run_batch(key, batch)
+
+    def _padded_size(self, n: int) -> int:
+        """Canonical batch size: next power of two (so at most log2(max_batch)
+        compiled signatures ever exist, instead of one per concurrency level),
+        rounded up to a multiple of dp×fsdp so GSPMD can split it."""
+        size = 1
+        while size < n:
+            size *= 2
+        n_data = self._mesh_data_size()
+        if n_data:
+            size = max(size, n_data)
+            size += (-size) % n_data
+        # __init__ rounds max_batch to a pow2 multiple of dp×fsdp, so the
+        # clamp keeps both invariants: never exceed the cap, stay splittable
+        return min(size, self.max_batch)
+
+    async def _run_batch(self, key: tuple, batch: list) -> None:
+        steps, guidance, width, height = key
+        prompts = [r.prompt for r in batch]
+        negs = [r.negative for r in batch]
+        seeds = [r.seed for r in batch]
+        mesh = self.mesh
+        pad = self._padded_size(len(batch)) - len(batch)
+        prompts += prompts[-1:] * pad  # pad to a canonical compiled signature
+        negs += negs[-1:] * pad
+        seeds += [0] * pad
+        if len(batch) > 1 or pad:
+            log.info("Micro-batch: %d requests (+%d pad) in one program (dp=%s)",
+                     len(batch), pad, self._mesh_data_size() or 1)
+        try:
+            imgs, _ = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: self.pipe.generate(
+                    prompts, steps=steps, guidance_scale=guidance,
+                    seed=seeds, width=width, height=height,
+                    negative_prompt=negs, mesh=mesh))
+        except Exception as e:
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        for i, r in enumerate(batch):
+            if not r.future.done():
+                r.future.set_result(imgs[i])
 
     async def profile(self, request: web.Request) -> web.Response:
         """Capture an XLA/TPU profile (xplane) around one small generate.
@@ -218,9 +365,17 @@ def main() -> None:
     if os.environ.get("SD15_WARMUP", "1") not in ("0", "false"):
         tiny = os.environ.get("SD15_PRESET", "sd15") == "tiny"
         kw = dict(steps=2, width=64, height=64) if tiny else {}
-        log.info("Warming up (compiling %s signature)...", kw or "default 512x512x30")
-        secs = server.pipe.warmup(**kw)
-        log.info("Warmup done in %.1fs", secs)
+        # compile every canonical batch signature the micro-batcher can emit
+        # (pow2s up to max_batch; one size when a mesh pads everything to it)
+        # BEFORE readiness — a request must never stall on a cold jit
+        sizes = sorted({server._padded_size(n)
+                        for n in range(1, server.max_batch + 1)})
+        for size in sizes:
+            log.info("Warming up (compiling %s batch=%d, dp=%s)...",
+                     kw or "default 512x512x30", size,
+                     server._mesh_data_size() or 1)
+            secs = server.pipe.warmup(batch_size=size, mesh=server.mesh, **kw)
+            log.info("Warmup batch=%d done in %.1fs", size, secs)
     web.run_app(server.build_app(), port=port, access_log=None)
 
 
