@@ -68,29 +68,25 @@ def generate(prompt: str, steps: int, url: str, out_dir: Path, prefix: str,
     if height is not None:
         payload["height"] = height
 
-    if concurrency > 1:
-        # in-flight requests land in the server's micro-batch window and ride
-        # one fused program across the pod's chips (SD15_DP); the reference
-        # could only send one at a time to its single GPU
-        from concurrent.futures import ThreadPoolExecutor
+    # concurrency > 1: in-flight requests land in the server's micro-batch
+    # window and ride one fused program across the pod's chips (SD15_DP);
+    # the reference could only send one at a time to its single GPU.
+    # concurrency == 1 degrades to the reference's sequential loop (each
+    # request completes before the next is sent; --delay paces completions).
+    from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=concurrency) as pool:
-            futs = []
-            for idx in range(1, count + 1):
-                name = f"{prefix}_{idx:02d}.png"
-                print(f"[*] Generating {name} -> {out_dir / name}")
-                futs.append(pool.submit(_one_request, url, dict(payload),
-                                        out_dir / name, name))
-                if delay > 0 and idx != count:  # paces submissions only
-                    time.sleep(delay)
-            ok = sum(f.result() for f in futs)
-    else:
+    with ThreadPoolExecutor(max_workers=max(1, concurrency)) as pool:
+        futs = []
         for idx in range(1, count + 1):
             name = f"{prefix}_{idx:02d}.png"
             print(f"[*] Generating {name} -> {out_dir / name}")
-            ok += _one_request(url, dict(payload), out_dir / name, name)
+            futs.append(pool.submit(_one_request, url, dict(payload),
+                                    out_dir / name, name))
+            if concurrency == 1:
+                futs[-1].result()  # sequential: finish before the next send
             if delay > 0 and idx != count:
                 time.sleep(delay)
+        ok = sum(f.result() for f in futs)
 
     wall = time.time() - t_start
     if ok:

@@ -86,6 +86,27 @@ def test_hash_tokenizer_deterministic():
     assert not (a[0] == b[0]).all()
 
 
+def test_host_key_data_matches_prngkey():
+    """Host-built raw key data must be bit-identical to jax.random.PRNGKey
+    (the fused program wraps it with wrap_key_data — any mismatch silently
+    changes every seeded image)."""
+    from tpustack.models.sd15.pipeline import _host_key_data
+
+    seeds = (0, 1, 42, 2**31 - 1, 2**63 - 1, -1, -2**63)
+    for seed in seeds:
+        ours = _host_key_data([seed])[0]
+        theirs = np.asarray(jax.random.key_data(jax.random.PRNGKey(seed)))
+        np.testing.assert_array_equal(ours, theirs, err_msg=f"seed {seed}")
+
+    # the x64 branch too (a deployment may enable it)
+    with jax.enable_x64(True):
+        for seed in seeds:
+            ours = _host_key_data([seed])[0]
+            theirs = np.asarray(jax.random.key_data(jax.random.PRNGKey(seed)))
+            np.testing.assert_array_equal(ours, theirs,
+                                          err_msg=f"x64 seed {seed}")
+
+
 def test_pipeline_generate_dp_mesh(pipe, mesh8):
     """DP generate over the 8-device mesh matches the unsharded program."""
     kw = dict(steps=2, seed=7, width=64, height=64, batch_size=8)

@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Summarise an XLA profiler capture into an op-time table.
+
+Observability beyond the reference's wall-clock-only ``X-Gen-Time`` header
+(SURVEY.md §5: "Tracing/profiling: none") — pairs with the SD server's
+``POST /profile`` endpoint, which writes xplane captures:
+
+    curl -X POST :8000/profile -d '{"steps": 4}'   # → {"trace_dir": ...}
+    python tools/xprof_summary.py /tmp/sd15-trace/capture-0
+
+Prints the top ops by device self-time so "where did my step time go" is a
+one-command answer (MXU convs vs attention vs layout/copy overhead).
+Requires the ``xprof`` package (in the serving image; also usable with any
+tensorboard profile dir).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def find_xplanes(path: str) -> list:
+    if os.path.isfile(path):
+        return [path]
+    files = sorted(glob.glob(f"{path}/**/*.xplane.pb", recursive=True))
+    if not files:
+        raise SystemExit(f"no .xplane.pb under {path}")
+    return files
+
+
+def op_table(files: list, tool: str = "framework_op_stats") -> list:
+    """Rows of {type, operation, occurrences, avg_us, self_us, device_pct}."""
+    from xprof.convert import raw_to_tool_data as r2t
+
+    raw, _ctype = r2t.xspace_to_tool_data(files, tool, {})
+    tables = json.loads(raw if isinstance(raw, str) else raw.decode())
+    if not tables:
+        return []
+    table = tables[0]
+    cols = [c["id"] for c in table["cols"]]
+    rows = []
+    for r in table.get("rows", []):
+        vals = dict(zip(cols, [c.get("v") for c in r["c"]]))
+        rows.append(vals)
+    return rows
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("trace", help="trace dir (or a single .xplane.pb file)")
+    p.add_argument("--top", type=int, default=20, help="rows to print")
+    p.add_argument("--host", action="store_true",
+                   help="include host-side ops (default: device only)")
+    args = p.parse_args()
+
+    rows = op_table(find_xplanes(args.trace))
+    if not args.host:
+        rows = [r for r in rows if str(r.get("host_or_device", "")).lower()
+                == "device"]
+    rows.sort(key=lambda r: -(r.get("total_self_time") or 0))
+
+    total = sum(r.get("total_self_time") or 0 for r in rows)
+    print(f"{'self µs':>12} {'%':>6} {'#':>6}  {'type':<28} operation")
+    for r in rows[: args.top]:
+        self_us = r.get("total_self_time") or 0
+        pct = 100 * self_us / total if total else 0
+        name = str(r.get("operation", ""))[:70]
+        print(f"{self_us:12.0f} {pct:6.1f} {r.get('occurrences', 0):6.0f}"
+              f"  {str(r.get('type', '')):<28} {name}")
+    print(f"{total:12.0f}  total device self-time across {len(rows)} op types")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,26 @@ from tpustack.utils import get_logger
 log = get_logger("models.sd15.pipeline")
 
 
+def _host_key_data(seeds) -> np.ndarray:
+    """``[B, 2]`` uint32 threefry key data built host-side — bit-identical to
+    ``jax.random.PRNGKey(seed)`` but with zero device dispatches (each eager
+    PRNGKey/normal call is a full network round-trip on tunnelled chips).
+
+    With x64 disabled (the default) PRNGKey truncates the seed to int32, so
+    the key is ``[0, seed & 0xFFFFFFFF]``; with x64 on, the high word is the
+    upper 32 seed bits (both branches verified bit-exact in tests/test_sd15.py).
+    """
+    x64 = jax.config.read("jax_enable_x64")
+    out = np.empty((len(seeds), 2), np.uint32)
+    for i, s in enumerate(seeds):
+        if s is None:
+            s = np.random.randint(0, 2**31)
+        s &= (1 << 64) - 1 if x64 else (1 << 32) - 1  # PRNGKey's truncation
+        out[i, 0] = (s >> 32) & 0xFFFFFFFF
+        out[i, 1] = s & 0xFFFFFFFF
+    return out
+
+
 class SD15Pipeline:
     """Holds module defs + params and a cache of compiled generate programs."""
 
@@ -76,12 +96,22 @@ class SD15Pipeline:
                 "vae_encoder": vae_e}
 
     # ------------------------------------------------------------ compiled fn
-    @functools.partial(jax.jit, static_argnums=(0, 5))
-    def _generate(self, params, cond_ids, uncond_ids, noise, num_steps: int,
-                  guidance_scale):
-        """One fused program: encode → CFG denoise loop → decode → uint8."""
+    @functools.partial(jax.jit, static_argnums=(0, 5, 6, 7))
+    def _generate(self, params, cond_ids, uncond_ids, keys, num_steps: int,
+                  lat_h: int, lat_w: int, guidance_scale):
+        """One fused program: RNG → encode → CFG denoise loop → decode → uint8.
+
+        ``keys`` is ``[B, 2]`` uint32 raw PRNG key data, built on the host —
+        drawing the initial noise INSIDE the program saves two device
+        dispatches per request (PRNGKey + normal), which matters when every
+        dispatch is a network round-trip (axon-tunnelled chips).
+        """
         c = self.config
         sched: Schedule = make_schedule(num_steps)
+
+        noise = jax.vmap(lambda k: jax.random.normal(
+            jax.random.wrap_key_data(k, impl="threefry2x32"),
+            (lat_h, lat_w, c.unet.in_channels), jnp.float32))(keys)
 
         ids = jnp.concatenate([uncond_ids, cond_ids], axis=0)  # [2B, L]
         context = self.text_encoder.apply({"params": params["text_encoder"]}, ids)
@@ -125,8 +155,12 @@ class SD15Pipeline:
 
         ``prompt``/``negative_prompt``/``seed`` may each be a sequence (one
         per image) — distinct requests batch into ONE fused program (the
-        server's micro-batcher relies on this); a scalar is broadcast over
-        ``batch_size``.
+        server's micro-batcher relies on this).  A scalar prompt is broadcast
+        over ``batch_size``; a scalar seed expands to consecutive per-image
+        seeds (seed, seed+1, …) so each image's noise depends only on its own
+        seed.  The same (seed, batch shape) is exactly reproducible; across
+        DIFFERENT batch shapes the compiled programs may differ in the last
+        float bit, so images match only up to ±1 uint8 quantisation.
 
         ``mesh``: optional ``jax.sharding.Mesh`` — images are data-parallel
         over the ``dp``×``fsdp`` axes (params replicated; SD1.5 fits any
@@ -150,28 +184,25 @@ class SD15Pipeline:
                 f"prompt/negative_prompt/seed lengths differ: "
                 f"{len(prompts)}/{len(negs)}/{len(seeds)}")
         batch_size = len(prompts)
-        cond = jnp.asarray(self.tokenizer(prompts))
-        uncond = jnp.asarray(self.tokenizer(negs))
-        lat_hw = (height // c.vae_scale, width // c.vae_scale, c.unet.in_channels)
-        if isinstance(seed, (list, tuple)):  # per-image seeds → per-image draws
-            keys = [jax.random.PRNGKey(np.random.randint(0, 2**31) if s is None else s)
-                    for s in seeds]
-            noise = jnp.concatenate(
-                [jax.random.normal(k, (1,) + lat_hw, jnp.float32) for k in keys],
-                axis=0)
-        else:  # scalar seed: one draw over the whole batch (per-image variety)
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None else seed)
-            noise = jax.random.normal(key, (batch_size,) + lat_hw, jnp.float32)
+        cond = np.asarray(self.tokenizer(prompts))
+        uncond = np.asarray(self.tokenizer(negs))
+        if not isinstance(seed, (list, tuple)) and seed is not None:
+            # scalar seed over a batch: consecutive per-image seeds (each
+            # image's noise depends only on its own seed, independent of
+            # batch position; see docstring for cross-batch-shape caveat)
+            seeds = [seed + i for i in range(batch_size)]
+        keys = _host_key_data(seeds)  # [B, 2] uint32, no device dispatch
         params = self.params
         if mesh is not None:
-            params, cond, uncond, noise = self._shard_for_mesh(
-                mesh, cond, uncond, noise)
-        img = self._generate(params, cond, uncond, noise, int(steps),
+            params, cond, uncond, keys = self._shard_for_mesh(
+                mesh, cond, uncond, keys)
+        img = self._generate(params, cond, uncond, keys, int(steps),
+                             height // c.vae_scale, width // c.vae_scale,
                              jnp.float32(guidance_scale))
         img = np.asarray(img)
         return img, time.time() - t0
 
-    def _shard_for_mesh(self, mesh, cond, uncond, noise):
+    def _shard_for_mesh(self, mesh, cond, uncond, keys):
         """Replicate params on ``mesh`` (cached) and shard the batch inputs
         over dp×fsdp; the jitted ``_generate`` then compiles as one
         XLA-partitioned program across all mesh devices."""
@@ -181,9 +212,9 @@ class SD15Pipeline:
 
         data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
         n_data = data_parallel_size(mesh) or 1
-        if noise.shape[0] % max(n_data, 1):
+        if keys.shape[0] % max(n_data, 1):
             raise ValueError(
-                f"batch_size {noise.shape[0]} not divisible by mesh dp*fsdp={n_data}")
+                f"batch_size {keys.shape[0]} not divisible by mesh dp*fsdp={n_data}")
         batch_sharding = NamedSharding(mesh, PS(data_axes or None))
         cached = self._mesh_params
         # key on the source params object too: pipe.params may be reassigned
@@ -193,9 +224,9 @@ class SD15Pipeline:
             self._mesh_params = (mesh, self.params, jax.device_put(
                 self.params, jax.tree.map(lambda _: replicated, self.params)))
         params = self._mesh_params[2]
-        cond, uncond, noise = (jax.device_put(t, batch_sharding)
-                               for t in (cond, uncond, noise))
-        return params, cond, uncond, noise
+        cond, uncond, keys = (jax.device_put(t, batch_sharding)
+                               for t in (cond, uncond, keys))
+        return params, cond, uncond, keys
 
     def warmup(self, **kw) -> float:
         """Compile the generate program for the given signature; returns seconds."""

@@ -87,22 +87,39 @@ class LLMServer:
         self.model_name = model_name
         self._lock = asyncio.Lock()
 
-    async def _run_on_device(self, fn):
+    async def _run_on_device(self, fn, cancel: Optional[threading.Event] = None):
         """Run blocking ``fn`` in the executor under the generation lock, in
         a task INDEPENDENT of the calling handler: if the handler is torn
         down (client disconnect, shutdown), the lock is still held until the
-        worker thread actually exits — one generation at a time, always."""
+        worker thread actually exits — one generation at a time, always.
+
+        ``cancel`` is set when the awaiting handler dies, so (a) a request
+        still QUEUED on the lock is dropped before any device work starts,
+        and (b) a running ``fn`` that polls the event (via its on_token
+        hook) aborts at the next token instead of generating for nobody."""
         loop = asyncio.get_running_loop()
+        started = False
 
         async def locked():
+            nonlocal started
             async with self._lock:
+                if cancel is not None and cancel.is_set():
+                    raise _Cancelled()  # caller died while we were queued
+                started = True
                 return await loop.run_in_executor(None, fn)
 
         task = asyncio.ensure_future(locked())
         # if we get cancelled below, the task runs on detached; swallow its
         # result/exception so it never logs "exception was never retrieved"
         task.add_done_callback(lambda t: t.cancelled() or t.exception())
-        return await asyncio.shield(task)
+        try:
+            return await asyncio.shield(task)
+        except BaseException:
+            if cancel is not None:
+                cancel.set()
+            if not started:
+                task.cancel()  # never touched the device — safe to kill
+            raise
 
     # ------------------------------------------------------------ helpers
     def _final_payload(self, stats, stopped_eos: bool, content: str) -> dict:
@@ -126,15 +143,22 @@ class LLMServer:
         }
 
     def _complete(self, prompt: str, n_predict: int, temperature: float,
-                  top_k: int, seed: Optional[int], greedy: bool):
+                  top_k: int, seed: Optional[int], greedy: bool,
+                  cancel: Optional[threading.Event] = None):
         from tpustack.models.llm_generate import SampleConfig
+
+        on_token = None
+        if cancel is not None:
+            def on_token(_tok):
+                if cancel.is_set():
+                    raise _Cancelled()  # client died mid-generation
 
         ids = self.tok.encode(prompt)
         out_ids, stats = self.gen.generate(
             ids, max_new_tokens=n_predict,
             sample=SampleConfig(temperature=temperature, top_k=top_k,
                                 greedy=greedy or temperature <= 0),
-            seed=seed, stop_tokens=(self.tok.eos_id,))
+            seed=seed, stop_tokens=(self.tok.eos_id,), on_token=on_token)
         if out_ids and out_ids[-1] == self.tok.eos_id:
             out_ids = out_ids[:-1]
             stopped_eos = True
@@ -218,18 +242,25 @@ class LLMServer:
             text = self.tok.decode(gen_ids[prefix_off:])
             if len(text) <= len(prev):
                 return ""
-            # hold back a trailing U+FFFD (incomplete multi-byte) — unless
-            # the window has stalled so long (genuinely invalid byte stream)
-            # that holding would grow it unboundedly
-            if text.endswith("�") and len(gen_ids) - read_off <= 16:
-                return ""
+            if text.endswith("�"):
+                # hold back a trailing U+FFFD (incomplete multi-byte) —
+                # unless the window has stalled so long (genuinely invalid
+                # byte stream) that holding would grow it unboundedly
+                if len(gen_ids) - read_off <= 16:
+                    return ""
+                # forced flush: the U+FFFD is emitted, so drop the pending
+                # bytes from future windows entirely — keeping them as
+                # context would let a later token re-render them and make
+                # the next delta's prefix arithmetic drop GOOD characters
+                prefix_off = read_off = len(gen_ids)
+                return text[len(prev):]
             prefix_off = max(read_off - 4, 0)
             read_off = len(gen_ids)
             return text[len(prev):]
 
         t0 = time.time()
 
-        locked_task = asyncio.ensure_future(self._run_on_device(worker))
+        locked_task = asyncio.ensure_future(self._run_on_device(worker, cancel))
         locked_task.add_done_callback(lambda t: t.cancelled() or t.exception())
         try:
             if fmt == "openai":
@@ -321,10 +352,11 @@ class LLMServer:
                                       top_k, seed, fmt="llamacpp")
 
         t0 = time.time()
+        cancel = threading.Event()
         try:
             content, stats, stopped_eos = await self._run_on_device(
                 lambda: self._complete(prompt, n_predict, temperature,
-                                       top_k, seed, False))
+                                       top_k, seed, False, cancel), cancel)
         except ValueError as e:  # e.g. prompt longer than the context window
             return web.json_response({"error": str(e)}, status=400)
         log.info("completion: %d prompt tok, %d gen tok, %.2fs",
@@ -362,10 +394,12 @@ class LLMServer:
             return await self._stream(request, prompt, n_predict, temperature,
                                       40, body.get("seed"), fmt="openai")
 
+        cancel = threading.Event()
         try:
             content, stats, stopped_eos = await self._run_on_device(
                 lambda: self._complete(prompt, n_predict, temperature,
-                                       40, body.get("seed"), False))
+                                       40, body.get("seed"), False, cancel),
+                cancel)
         except ValueError as e:
             return web.json_response({"error": {"message": str(e)}}, status=400)
         return web.json_response({

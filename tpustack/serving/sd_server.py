@@ -226,7 +226,7 @@ class SDServer:
             self._pending[key] = (self._group_seq, [])
         gid, group = self._pending[key]
         group.append(req)
-        if len(group) >= self.max_batch:
+        if len(group) == self.max_batch:  # == not >=: one flusher per group
             asyncio.ensure_future(self._flush(key, gid, wait=False))
         elif len(group) == 1:
             asyncio.ensure_future(self._flush(key, gid, wait=self.max_batch > 1))
