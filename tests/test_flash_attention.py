@@ -95,3 +95,50 @@ def test_flash_rejects_mask():
     with pytest.raises(NotImplementedError):
         dot_product_attention(q, q, q, mask=jnp.ones((1, 1, 16, 16), bool),
                               impl="flash")
+
+
+def test_auto_dispatch_rule():
+    """Pins the empirical auto-dispatch rule (measured on v5e, see
+    tpustack/ops/attention.py): flash only on TPU, for 1k-8k sequences,
+    no custom mask, and small batch*heads (kernel grid serialises B*H)."""
+    from tpustack.ops.attention import auto_impl
+
+    # the SD1.5 level-0 block at CFG batch 2 (single image): flash
+    assert auto_impl(2, 4096, 8, 4096, False, "tpu") == "flash"
+    # same block at the serving batch of 8 (CFG 16): B*H=128 → xla
+    assert auto_impl(16, 4096, 8, 4096, False, "tpu") == "xla"
+    # boundary: B*H = 64 still flash
+    assert auto_impl(8, 4096, 8, 4096, False, "tpu") == "flash"
+    # short sequences and huge video token streams: xla
+    assert auto_impl(2, 256, 8, 256, False, "tpu") == "xla"
+    assert auto_impl(1, 16384, 8, 16384, False, "tpu") == "xla"
+    # custom masks are not supported by the kernel
+    assert auto_impl(2, 4096, 8, 4096, True, "tpu") == "xla"
+    # never flash off-TPU
+    assert auto_impl(2, 4096, 8, 4096, False, "cpu") == "xla"
+
+
+def test_auto_dispatch_uses_per_chip_batch():
+    """Under GSPMD the traced batch is global; the rule must divide by the
+    dp*fsdp shard count or multi-chip serving loses flash where it wins."""
+    from tpustack.ops.attention import auto_impl
+
+    # global CFG batch 16 over 8 chips → per-chip B*H = 16 → flash
+    assert auto_impl(16, 4096, 8, 4096, False, "tpu", data_shards=8) == "flash"
+    # same shapes on one chip → B*H = 128 → xla
+    assert auto_impl(16, 4096, 8, 4096, False, "tpu", data_shards=1) == "xla"
+
+
+def test_auto_dispatch_head_dim_scaling():
+    """Full-lane head dims (D>=128) double the batch*heads bound; below
+    that the measured crossover (D=40 and D=80 both lose by B*H=128)
+    keeps the bound at 64."""
+    from tpustack.ops.attention import auto_impl
+
+    # Wan DiT: D=128, batch 3 CFG (B=6) x 12 heads = 72 — still flash
+    assert auto_impl(6, 4096, 12, 4096, False, "tpu", d=128) == "flash"
+    # but at D=40 or D=80 the same B*H=72 exceeds the measured crossover
+    assert auto_impl(6, 4096, 12, 4096, False, "tpu", d=40) == "xla"
+    assert auto_impl(6, 4096, 12, 4096, False, "tpu", d=80) == "xla"
+    # SD1.5 level-1 at serving batch 8: D=80, B*H=128 → xla (measured)
+    assert auto_impl(16, 1024, 8, 1024, False, "tpu", d=80) == "xla"

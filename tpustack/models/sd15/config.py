@@ -49,6 +49,14 @@ class UNetConfig:
     norm_num_groups: int = 32
     time_embed_dim_mult: int = 4  # time_embed_dim = block_out[0] * 4
     transformer_layers: int = 1
+    # attention dispatch for the spatial transformers: "auto" (flash on TPU
+    # for long sequences at small per-chip batch*heads, XLA otherwise),
+    # "xla", or "flash" — a tuning knob for perf work
+    # (tools/xprof_summary.py shows the attention split)
+    attn_impl: str = "auto"
+    # dp*fsdp ways the batch is GSPMD-sharded over: traced shapes are global,
+    # so "auto" judges the per-chip batch (pipeline sets this per mesh)
+    data_shards: int = 1
 
     @property
     def up_block_has_attn(self) -> Tuple[bool, ...]:

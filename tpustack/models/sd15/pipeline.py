@@ -21,6 +21,7 @@ Differences from the torch reference, all deliberate:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 from typing import Any, Dict, Optional, Tuple
@@ -96,17 +97,24 @@ class SD15Pipeline:
                 "vae_encoder": vae_e}
 
     # ------------------------------------------------------------ compiled fn
-    @functools.partial(jax.jit, static_argnums=(0, 5, 6, 7))
+    @functools.partial(jax.jit, static_argnums=(0, 5, 6, 7, 9))
     def _generate(self, params, cond_ids, uncond_ids, keys, num_steps: int,
-                  lat_h: int, lat_w: int, guidance_scale):
+                  lat_h: int, lat_w: int, guidance_scale, n_data: int = 1):
         """One fused program: RNG → encode → CFG denoise loop → decode → uint8.
 
         ``keys`` is ``[B, 2]`` uint32 raw PRNG key data, built on the host —
         drawing the initial noise INSIDE the program saves two device
         dispatches per request (PRNGKey + normal), which matters when every
         dispatch is a network round-trip (axon-tunnelled chips).
+
+        ``n_data``: dp×fsdp ways the batch is sharded under GSPMD — traced
+        shapes are global, so the UNet's attention auto-dispatch needs it to
+        judge per-chip work (same weights, different compiled schedule).
         """
         c = self.config
+        unet = (self.unet if n_data <= 1 else UNet2DCondition(
+            dataclasses.replace(c.unet, data_shards=n_data),
+            dtype=c.compute_dtype))
         sched: Schedule = make_schedule(num_steps)
 
         noise = jax.vmap(lambda k: jax.random.normal(
@@ -118,7 +126,7 @@ class SD15Pipeline:
 
         def body(i, x):
             t = jnp.broadcast_to(sched.timesteps[i], (x.shape[0] * 2,))
-            eps = self.unet.apply(
+            eps = unet.apply(
                 {"params": params["unet"]},
                 jnp.concatenate([x, x], axis=0).astype(c.compute_dtype), t, context)
             eps_uncond, eps_cond = jnp.split(eps.astype(jnp.float32), 2, axis=0)
@@ -193,25 +201,26 @@ class SD15Pipeline:
             seeds = [seed + i for i in range(batch_size)]
         keys = _host_key_data(seeds)  # [B, 2] uint32, no device dispatch
         params = self.params
+        n_data = 1
         if mesh is not None:
+            from tpustack.parallel import data_parallel_size
+
+            n_data = data_parallel_size(mesh) or 1
             params, cond, uncond, keys = self._shard_for_mesh(
-                mesh, cond, uncond, keys)
+                mesh, cond, uncond, keys, n_data)
         img = self._generate(params, cond, uncond, keys, int(steps),
                              height // c.vae_scale, width // c.vae_scale,
-                             jnp.float32(guidance_scale))
+                             jnp.float32(guidance_scale), n_data)
         img = np.asarray(img)
         return img, time.time() - t0
 
-    def _shard_for_mesh(self, mesh, cond, uncond, keys):
+    def _shard_for_mesh(self, mesh, cond, uncond, keys, n_data: int):
         """Replicate params on ``mesh`` (cached) and shard the batch inputs
         over dp×fsdp; the jitted ``_generate`` then compiles as one
         XLA-partitioned program across all mesh devices."""
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
-        from tpustack.parallel import data_parallel_size
-
         data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-        n_data = data_parallel_size(mesh) or 1
         if keys.shape[0] % max(n_data, 1):
             raise ValueError(
                 f"batch_size {keys.shape[0]} not divisible by mesh dp*fsdp={n_data}")

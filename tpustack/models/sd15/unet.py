@@ -80,6 +80,8 @@ class CrossAttention(nn.Module):
     dim: int
     heads: int
     dtype: Any = jnp.float32
+    impl: str = "auto"
+    data_shards: int = 1  # GSPMD dp*fsdp ways; auto-dispatch uses per-chip batch
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
@@ -89,7 +91,8 @@ class CrossAttention(nn.Module):
         k = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
         v = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
         split = lambda t: t.reshape(t.shape[0], t.shape[1], self.heads, head_dim)
-        out = dot_product_attention(split(q), split(k), split(v), impl="auto")
+        out = dot_product_attention(split(q), split(k), split(v), impl=self.impl,
+                                    data_shards=self.data_shards)
         out = out.reshape(x.shape[0], x.shape[1], self.dim)
         return nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
 
@@ -98,12 +101,18 @@ class TransformerBlock(nn.Module):
     dim: int
     heads: int
     dtype: Any = jnp.float32
+    impl: str = "auto"
+    data_shards: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
         ln = lambda name: nn.LayerNorm(dtype=self.dtype, name=name)
-        x = x + CrossAttention(self.dim, self.heads, self.dtype, name="attn1")(ln("norm1")(x))
-        x = x + CrossAttention(self.dim, self.heads, self.dtype, name="attn2")(ln("norm2")(x), context)
+        x = x + CrossAttention(self.dim, self.heads, self.dtype, impl=self.impl,
+                               data_shards=self.data_shards,
+                               name="attn1")(ln("norm1")(x))
+        x = x + CrossAttention(self.dim, self.heads, self.dtype, impl=self.impl,
+                               data_shards=self.data_shards,
+                               name="attn2")(ln("norm2")(x), context)
         x = x + FeedForward(self.dim, dtype=self.dtype, name="ff")(ln("norm3")(x))
         return x
 
@@ -115,6 +124,8 @@ class Transformer2D(nn.Module):
     layers: int = 1
     groups: int = 32
     dtype: Any = jnp.float32
+    impl: str = "auto"
+    data_shards: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
@@ -124,7 +135,9 @@ class Transformer2D(nn.Module):
         x = nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_in")(x)
         x = x.reshape(b, h * w, c)
         for i in range(self.layers):
-            x = TransformerBlock(c, self.heads, self.dtype, name=f"blocks_{i}")(x, context)
+            x = TransformerBlock(c, self.heads, self.dtype, impl=self.impl,
+                                 data_shards=self.data_shards,
+                                 name=f"blocks_{i}")(x, context)
         x = x.reshape(b, h, w, c)
         x = nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_out")(x)
         return x + residual
@@ -181,7 +194,9 @@ class UNet2DCondition(nn.Module):
                                 name=f"down_{level}_res_{blk}")(h, temb)
                 if c.down_block_has_attn[level]:
                     h = Transformer2D(heads, c.transformer_layers, c.norm_num_groups,
-                                      self.dtype, name=f"down_{level}_attn_{blk}")(h, context)
+                                      self.dtype, impl=c.attn_impl,
+                                      data_shards=c.data_shards,
+                                      name=f"down_{level}_attn_{blk}")(h, context)
                 skips.append(h)
             if level < n_levels - 1:
                 h = Downsample(ch, self.dtype, name=f"down_{level}_downsample")(h)
@@ -191,7 +206,8 @@ class UNet2DCondition(nn.Module):
         mid_ch = c.block_out_channels[-1]
         h = ResnetBlock(mid_ch, c.norm_num_groups, self.dtype, name="mid_res_0")(h, temb)
         h = Transformer2D(heads, c.transformer_layers, c.norm_num_groups,
-                          self.dtype, name="mid_attn")(h, context)
+                          self.dtype, impl=c.attn_impl,
+                          data_shards=c.data_shards, name="mid_attn")(h, context)
         h = ResnetBlock(mid_ch, c.norm_num_groups, self.dtype, name="mid_res_1")(h, temb)
 
         # --- up path ---
@@ -203,7 +219,9 @@ class UNet2DCondition(nn.Module):
                                 name=f"up_{level}_res_{blk}")(h, temb)
                 if c.up_block_has_attn[i]:
                     h = Transformer2D(heads, c.transformer_layers, c.norm_num_groups,
-                                      self.dtype, name=f"up_{level}_attn_{blk}")(h, context)
+                                      self.dtype, impl=c.attn_impl,
+                                      data_shards=c.data_shards,
+                                      name=f"up_{level}_attn_{blk}")(h, context)
             if level > 0:
                 h = Upsample(ch, self.dtype, name=f"up_{level}_upsample")(h)
 

@@ -72,6 +72,9 @@ class WanDiTConfig:
     patch_size: Tuple[int, int, int] = (1, 2, 2)  # (frames, h, w)
     qk_norm: bool = True
     eps: float = 1e-6
+    # attention dispatch ("auto"|"xla"|"flash") — same tuning knob as
+    # SD15's UNetConfig.attn_impl; "auto" judges seq length and batch*heads
+    attn_impl: str = "auto"
 
 
 @dataclasses.dataclass(frozen=True)

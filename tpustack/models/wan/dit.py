@@ -86,16 +86,19 @@ class RMSNorm(nn.Module):
         return (out * scale).astype(x.dtype)
 
 
-def _attention(q, k, v, heads: int):
+def _attention(q, k, v, heads: int, impl: str = "auto"):
     """BSHD attention, fp32 accumulate; returns ``[B, S, heads*D]``.
 
     ``impl="auto"`` routes the long space-time self-attention (thousands of
-    video tokens, D=128) through the Pallas flash kernel on TPU — the same
-    dispatch that cut SD1.5's UNet step 2.4x — while the 512-token text
-    cross-attention stays on plain XLA."""
+    video tokens) through the Pallas flash kernel on TPU when the per-chip
+    batch*heads is small enough for the kernel's serialised grid — the D=128
+    heads raise that bound 3x over SD1.5's D=40 (see
+    ``tpustack.ops.attention.auto_impl``) — while the 512-token text
+    cross-attention stays on plain XLA.  ``WanDiTConfig.attn_impl`` forces
+    either implementation for tuning."""
     b, s = q.shape[0], q.shape[1]
     head_dim = q.shape[-1]
-    out = dot_product_attention(q, k, v, impl="auto")
+    out = dot_product_attention(q, k, v, impl=impl)
     return out.reshape(b, s, heads * head_dim)
 
 
@@ -131,7 +134,8 @@ class DiTBlock(nn.Module):
             q = RMSNorm(name="q_norm")(q)
             k = RMSNorm(name="k_norm")(k)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-        o = nn.Dense(c.dim, dtype=self.dtype, name="o")(_attention(q, k, v, c.num_heads))
+        o = nn.Dense(c.dim, dtype=self.dtype, name="o")(
+            _attention(q, k, v, c.num_heads, c.attn_impl))
         x = x + g_sa[:, None] * o.astype(jnp.float32)
 
         # --- cross-attention to UMT5 text (affine norm3, no RoPE, no gate)
@@ -142,7 +146,8 @@ class DiTBlock(nn.Module):
         if c.qk_norm:
             q = RMSNorm(name="xq_norm")(q)
             k = RMSNorm(name="xk_norm")(k)
-        o = nn.Dense(c.dim, dtype=self.dtype, name="xo")(_attention(q, k, v, c.num_heads))
+        o = nn.Dense(c.dim, dtype=self.dtype, name="xo")(
+            _attention(q, k, v, c.num_heads, c.attn_impl))
         x = x + o.astype(jnp.float32)
 
         # --- FFN (plain GELU-tanh, Wan style)
