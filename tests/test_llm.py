@@ -266,3 +266,46 @@ def test_stream_disconnect_cancels_worker_and_lock_outlives_handler(gen):
         assert await nxt == "next"
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_generate_fused_matches_loop_greedy(gen):
+    """The scan-based fused decoder must reproduce the per-token loop
+    exactly under greedy decoding (same split chain, same sampling)."""
+    tok = ByteTokenizer(512)
+    ids = tok.encode("fused?")
+    loop_out, loop_stats = gen.generate(
+        ids, max_new_tokens=24, sample=SampleConfig(greedy=True), seed=5)
+    fused_out, fused_stats = gen.generate_fused(
+        ids, max_new_tokens=24, sample=SampleConfig(greedy=True), seed=5,
+        chunk=8)
+    assert fused_out == loop_out
+    assert fused_stats["prompt_tokens"] == loop_stats["prompt_tokens"]
+
+    # stop-token handling at chunk granularity: truncate at first stop
+    stop = loop_out[4]
+    fused_stop, _ = gen.generate_fused(
+        ids, max_new_tokens=24, sample=SampleConfig(greedy=True), seed=5,
+        stop_tokens=(stop,), chunk=8)
+    assert fused_stop == loop_out[:5]
+
+    # sampled path: deterministic per seed, valid ids
+    s1, _ = gen.generate_fused(ids, max_new_tokens=12,
+                               sample=SampleConfig(temperature=0.9), seed=3)
+    s2, _ = gen.generate_fused(ids, max_new_tokens=12,
+                               sample=SampleConfig(temperature=0.9), seed=3)
+    assert s1 == s2 and all(0 <= t < 512 for t in s1)
+
+
+def test_generate_fused_edge_cases(gen):
+    out, stats = gen.generate_fused([1, 2, 3], max_new_tokens=0)
+    assert out == [] and stats["generated_tokens"] == 0
+    with pytest.raises(ValueError, match="chunk"):
+        gen.generate_fused([1, 2, 3], chunk=0)
+    # fixed-size chunks: an uneven max_new_tokens still only ever compiles
+    # the full-chunk signature (plus the cache-edge clamp)
+    out, _ = gen.generate_fused([1, 2, 3], max_new_tokens=11,
+                                sample=SampleConfig(greedy=True), seed=1,
+                                chunk=8)
+    ref, _ = gen.generate([1, 2, 3], max_new_tokens=11,
+                          sample=SampleConfig(greedy=True), seed=1)
+    assert out == ref

@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Wan2.1-class T2V benchmark: seconds per video at the reference shape.
+
+The reference's T2V workload is Wan2.1 1.3B bf16, 512x320, 16 frames, 25
+steps, cfg 6.0, via an out-of-band ComfyUI server
+(``/root/reference/cluster-config/apps/llm/scripts/generate_wan_t2v.py:305-349``).
+This measures the same shape on the TPU-native pipeline: one fused program
+for the 25-step CFG flow-matching denoise loop + 3D-VAE decode.
+
+The text encoder is swapped for a toy UMT5 (umt5-xxl's ~23 GB of fp32 random
+init would not fit next to the DiT on one v5e chip, and text encoding runs
+once per video — it is not the hot loop).  Real checkpoints shard it.
+
+Prints ONE JSON line: {"metric", "value", "unit", "seconds_per_video"}.
+The repo headline (driver-run) stays bench.py's SD15 number.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import dataclasses
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=25)
+    p.add_argument("--frames", type=int, default=16)
+    p.add_argument("--width", type=int, default=512)
+    p.add_argument("--height", type=int, default=320)
+    p.add_argument("--repeats", type=int, default=2)
+    p.add_argument("--small", action="store_true", help="tiny smoke shape")
+    args = p.parse_args()
+
+    import jax
+
+    from tpustack.models.wan.config import UMT5Config, WanConfig
+    from tpustack.models.wan.pipeline import WanPipeline
+
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)
+    log(f"[bench_wan] backend={jax.default_backend()}")
+
+    if args.small:
+        cfg = WanConfig.tiny()
+        args.width, args.height, args.frames = 64, 64, 5
+        args.steps = min(args.steps, 4)
+    else:
+        cfg = WanConfig.wan_1_3b()
+        # toy text tower (see docstring); the DiT's text_proj input width
+        # follows it — a negligible slice of the 1.3B DiT's compute
+        cfg = dataclasses.replace(
+            cfg,
+            text=UMT5Config(vocab_size=512, dim=64, ffn_dim=128, num_heads=4,
+                            head_dim=16, num_layers=2, max_length=512),
+            dit=dataclasses.replace(cfg.dit, text_dim=64))
+
+    t0 = time.time()
+    pipe = WanPipeline(cfg)
+    log(f"[bench_wan] init {time.time() - t0:.1f}s")
+
+    gen = lambda seed: pipe.generate(
+        "a panda riding a motorbike through a neon city",
+        steps=args.steps, frames=args.frames, width=args.width,
+        height=args.height, seed=seed)
+
+    t0 = time.time()
+    gen(0)
+    log(f"[bench_wan] compile+first {time.time() - t0:.1f}s")
+
+    times = []
+    for i in range(args.repeats):
+        _, dt = gen(i + 1)
+        times.append(dt)
+        log(f"[bench_wan] run {i + 1}/{args.repeats}: {dt:.2f}s")
+
+    sec = statistics.median(times)
+    print(json.dumps({
+        "metric": f"wan21_1.3b_{args.width}x{args.height}x{args.frames}f_"
+                  f"{args.steps}step_videos_per_hour_per_chip",
+        "value": round(3600.0 / sec, 2),
+        "unit": "videos/hour/chip",
+        "seconds_per_video": round(sec, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

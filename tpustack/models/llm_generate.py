@@ -84,16 +84,9 @@ class Generator:
             logits, (length - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
         return last, caches
 
-    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(4,))
-    def _decode_step(self, params, token, index, caches, key, temperature,
-                     top_k, greedy):
-        """One token in → caches updated in place → next token out."""
-        b = token.shape[0]
-        positions = jnp.broadcast_to(index, (b, 1))
-        mask = (jnp.arange(self.cfg.max_seq)[None, None, None, :] <= index)
-        logits, caches = self.model.apply(
-            {"params": params}, token, positions, caches, index, mask)
-        logits = logits[:, -1].astype(jnp.float32)
+    def _sample_from_logits(self, logits, key, temperature, top_k, greedy):
+        """``[B, V]`` fp32 logits → ``[B]`` int32 token (traced; shared by the
+        single-step and fused-scan decoders so they sample identically)."""
 
         def sample(logits):
             scaled = logits / jnp.maximum(temperature, 1e-4)
@@ -107,10 +100,50 @@ class Generator:
             scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
             return jax.random.categorical(key, scaled, axis=-1)
 
-        next_greedy = jnp.argmax(logits, axis=-1)
-        next_sampled = sample(logits)
-        next_tok = jnp.where(greedy, next_greedy, next_sampled)
-        return next_tok.astype(jnp.int32), caches
+        next_tok = jnp.where(greedy, jnp.argmax(logits, axis=-1), sample(logits))
+        return next_tok.astype(jnp.int32)
+
+    def _decode_logits(self, params, token, index, caches):
+        """One cached decode step: ``[B,1]`` token → (``[B,V]`` f32, caches)."""
+        b = token.shape[0]
+        positions = jnp.broadcast_to(index, (b, 1))
+        mask = (jnp.arange(self.cfg.max_seq)[None, None, None, :] <= index)
+        logits, caches = self.model.apply(
+            {"params": params}, token, positions, caches, index, mask)
+        return logits[:, -1].astype(jnp.float32), caches
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(4,))
+    def _decode_step(self, params, token, index, caches, key, temperature,
+                     top_k, greedy):
+        """One token in → caches updated in place → next token out."""
+        logits, caches = self._decode_logits(params, token, index, caches)
+        return self._sample_from_logits(logits, key, temperature, top_k,
+                                        greedy), caches
+
+    @functools.partial(jax.jit, static_argnums=(0, 9), donate_argnums=(3,))
+    def _decode_scan(self, params, first_tok, caches, start_index, key,
+                     temperature, top_k, greedy, n_steps: int):
+        """``n_steps`` decode iterations in ONE dispatch (``lax.scan``).
+
+        The per-token host loop costs one dispatch round-trip per token —
+        sub-ms on a local chip, but the whole budget on tunnelled/remote
+        setups; this is the throughput path (``generate_fused``).  The key is
+        split per step exactly like the host loop, so greedy fused output
+        matches the loop path token-for-token.
+        """
+
+        def step(carry, i):
+            tok, caches, key = carry
+            logits, caches = self._decode_logits(
+                params, tok, start_index + i, caches)
+            step_key, key = jax.random.split(key)
+            nxt = self._sample_from_logits(logits, step_key, temperature,
+                                           top_k, greedy)
+            return (nxt[:, None], caches, key), nxt
+
+        (_, caches, key_out), toks = jax.lax.scan(
+            step, (first_tok, caches, key), jnp.arange(n_steps))
+        return toks.T, caches, key_out  # [B, n_steps], advanced key
 
     # ---------------------------------------------------------------- public
     def _bucket(self, n: int) -> int:
@@ -119,22 +152,11 @@ class Generator:
             p *= 2
         return min(p, self.cfg.max_seq)
 
-    def generate(
-        self,
-        prompt_tokens: List[int],
-        max_new_tokens: int = 128,
-        sample: SampleConfig = SampleConfig(),
-        seed: Optional[int] = None,
-        stop_tokens: Tuple[int, ...] = (),
-        on_token=None,
-    ) -> Tuple[List[int], Dict[str, float]]:
-        """Returns (generated token ids, timing stats).
-
-        ``on_token(tok_id)`` — optional per-token callback, invoked as soon as
-        each token id is known (including any stop token) — the hook the SSE
-        streaming endpoints use.  The decode step for token i+1 is already in
-        flight on device when the callback for token i runs, so streaming
-        costs no TPU idle time.
+    def _start_generation(self, prompt_tokens: List[int], max_new_tokens: int,
+                          sample: SampleConfig, seed: Optional[int]):
+        """Shared prologue of both decoders: validate, prefill, sample the
+        first token from prefill logits on the host, seed the split chain.
+        Returns (first_tok, caches, key, n_prompt, max_new_tokens, t_prefill).
         """
         c = self.cfg
         n_prompt = len(prompt_tokens)
@@ -156,12 +178,32 @@ class Generator:
 
         # first sampled token comes from prefill logits: reuse decode's sampling
         # by treating it as a temperature/top-k draw on the host side once.
-        t_prefill = time.time() - t0
+        first = self._sample_host(logits, sample, key)
+        key = jax.random.fold_in(key, 0)
+        return first, caches, key, n_prompt, max_new_tokens, time.time() - t0
+
+    def generate(
+        self,
+        prompt_tokens: List[int],
+        max_new_tokens: int = 128,
+        sample: SampleConfig = SampleConfig(),
+        seed: Optional[int] = None,
+        stop_tokens: Tuple[int, ...] = (),
+        on_token=None,
+    ) -> Tuple[List[int], Dict[str, float]]:
+        """Returns (generated token ids, timing stats).
+
+        ``on_token(tok_id)`` — optional per-token callback, invoked as soon as
+        each token id is known (including any stop token) — the hook the SSE
+        streaming endpoints use.  The decode step for token i+1 is already in
+        flight on device when the callback for token i runs, so streaming
+        costs no TPU idle time.
+        """
+        next_tok, caches, key, n_prompt, max_new_tokens, t_prefill = (
+            self._start_generation(prompt_tokens, max_new_tokens, sample, seed))
         t0 = time.time()
 
         out: List[int] = []
-        next_tok = self._sample_host(logits, sample, key)
-        key = jax.random.fold_in(key, 0)
         for i in range(max_new_tokens):
             tok = int(next_tok)
             out.append(tok)
@@ -176,9 +218,77 @@ class Generator:
                 jnp.float32(sample.temperature), jnp.int32(sample.top_k),
                 jnp.bool_(sample.greedy))
             next_tok = np.asarray(next_tok_arr)[0]
+        return out, self._finish_stats(out, n_prompt, t_prefill, t0)
+
+    def generate_fused(
+        self,
+        prompt_tokens: List[int],
+        max_new_tokens: int = 128,
+        sample: SampleConfig = SampleConfig(),
+        seed: Optional[int] = None,
+        stop_tokens: Tuple[int, ...] = (),
+        chunk: int = 32,
+        cancel_check=None,
+    ) -> Tuple[List[int], Dict[str, float]]:
+        """Like ``generate`` but decodes ``chunk`` tokens per device dispatch
+        (``lax.scan``) instead of one — the throughput path when no per-token
+        streaming callback is needed.  Stop tokens are honoured at chunk
+        granularity: the host truncates at the first stop token and at most
+        ``chunk - 1`` speculative tokens are discarded.  With ``greedy`` the
+        output matches ``generate`` token-for-token (same split chain).
+
+        ``cancel_check()`` — optional; polled between chunks, return True to
+        abandon generation (coarser than ``generate``'s per-token hook by at
+        most one chunk of device work).
+        """
+        if chunk <= 0:
+            raise ValueError(f"chunk must be positive, got {chunk}")
+        first, caches, key, n_prompt, max_new_tokens, t_prefill = (
+            self._start_generation(prompt_tokens, max_new_tokens, sample, seed))
+        t0 = time.time()
+        out: List[int] = [] if max_new_tokens == 0 else [first]
+        tok = first
+        while len(out) and len(out) < max_new_tokens and not (
+                stop_tokens and tok in stop_tokens):
+            if cancel_check is not None and cancel_check():
+                break
+            start = n_prompt + len(out) - 1
+            if self.cfg.max_seq - start < chunk:
+                # cache tail shorter than a chunk: finish on the already-
+                # compiled per-token step instead of compiling a new scan
+                # signature for this exact tail length
+                while (len(out) < max_new_tokens
+                       and not (stop_tokens and tok in stop_tokens)):
+                    step_key, key = jax.random.split(key)
+                    nxt, caches = self._decode_step(
+                        self.params, jnp.asarray([[tok]], jnp.int32),
+                        jnp.asarray(n_prompt + len(out) - 1, jnp.int32),
+                        caches, step_key, jnp.float32(sample.temperature),
+                        jnp.int32(sample.top_k), jnp.bool_(sample.greedy))
+                    tok = int(np.asarray(nxt)[0])
+                    out.append(tok)
+                break
+            # always scan a FULL chunk — one compiled signature; surplus
+            # tokens are discarded on the host
+            toks, caches, key = self._decode_scan(
+                self.params, jnp.asarray([[tok]], jnp.int32), caches,
+                jnp.asarray(start, jnp.int32), key,
+                jnp.float32(sample.temperature), jnp.int32(sample.top_k),
+                jnp.bool_(sample.greedy), chunk)
+            block = [int(t) for t in np.asarray(toks)[0]]
+            for t in block:
+                out.append(t)
+                if (stop_tokens and t in stop_tokens) or \
+                        len(out) >= max_new_tokens:
+                    break
+            tok = out[-1]
+        return out, self._finish_stats(out, n_prompt, t_prefill, t0)
+
+    def _finish_stats(self, out: List[int], n_prompt: int, t_prefill: float,
+                      t0: float) -> Dict[str, float]:
         t_decode = time.time() - t0
         n_gen = len(out)
-        return out, {
+        return {
             "prompt_tokens": n_prompt,
             "generated_tokens": n_gen,
             "prefill_s": t_prefill,

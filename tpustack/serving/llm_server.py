@@ -145,20 +145,19 @@ class LLMServer:
     def _complete(self, prompt: str, n_predict: int, temperature: float,
                   top_k: int, seed: Optional[int], greedy: bool,
                   cancel: Optional[threading.Event] = None):
+        """Non-streaming path: fused scan decode (chunk of tokens per device
+        dispatch — the throughput path; a dead client is noticed between
+        chunks).  Output matches the streaming per-token path token-for-token
+        (same split chain, tested)."""
         from tpustack.models.llm_generate import SampleConfig
 
-        on_token = None
-        if cancel is not None:
-            def on_token(_tok):
-                if cancel.is_set():
-                    raise _Cancelled()  # client died mid-generation
-
         ids = self.tok.encode(prompt)
-        out_ids, stats = self.gen.generate(
+        out_ids, stats = self.gen.generate_fused(
             ids, max_new_tokens=n_predict,
             sample=SampleConfig(temperature=temperature, top_k=top_k,
                                 greedy=greedy or temperature <= 0),
-            seed=seed, stop_tokens=(self.tok.eos_id,), on_token=on_token)
+            seed=seed, stop_tokens=(self.tok.eos_id,),
+            cancel_check=None if cancel is None else cancel.is_set)
         if out_ids and out_ids[-1] == self.tok.eos_id:
             out_ids = out_ids[:-1]
             stopped_eos = True
