@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpustack.ops.attention import dot_product_attention
 
@@ -95,6 +96,18 @@ KVCache = Dict[str, jax.Array]
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.bfloat16
+    ring_mesh: Any = None  # Mesh → train-path attention rings K/V over "sp"
+
+    def _ring_shapes_ok(self, b: int, s: int) -> bool:
+        """Ring shard_map needs batch/seq/heads divisible by their mesh axes
+        (init's tiny dummy input, for one, is not) — else dense fallback,
+        which computes the same thing with generic GSPMD collectives."""
+        m = self.ring_mesh
+        n_data = int(np.prod([m.shape[a] for a in ("dp", "fsdp")
+                              if a in m.axis_names]) or 1)
+        tp = m.shape.get("tp", 1) if "tp" in m.axis_names else 1
+        return (s % m.shape["sp"] == 0 and b % n_data == 0
+                and self.cfg.n_heads % tp == 0)
 
     @nn.compact
     def __call__(self, x, positions, kv_cache: Optional[KVCache], cache_index,
@@ -118,12 +131,31 @@ class LlamaAttention(nn.Module):
                 kv_cache["v"], v.astype(kv_cache["v"].dtype), (0, cache_index, 0, 0))
             new_cache = {"k": k_all, "v": v_all}
             out = dot_product_attention(q, k_all, v_all, mask=attn_mask)
+        elif (self.ring_mesh is not None and attn_mask is None
+                and "sp" in self.ring_mesh.axis_names
+                and self.ring_mesh.shape["sp"] > 1
+                and not self.is_initializing()
+                and self._ring_shapes_ok(b, s)):
+            # Sequence-parallel training: the sequence dim is GSPMD-sharded
+            # over "sp"; ring attention keeps each chip's scores at
+            # (S/sp)², rotating K/V shards over nearest-neighbor ICI with a
+            # streaming-softmax merge (differentiable — lax.scan + ppermute)
+            from tpustack.parallel.ring_attention import ring_attention
+
+            new_cache = None
+            if c.n_kv_heads != c.n_heads:  # ring expects matched heads
+                rep = c.n_heads // c.n_kv_heads
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = ring_attention(q, k, v, mesh=self.ring_mesh, axis="sp",
+                                 causal=True)
         else:
             new_cache = None
             # Deliberately impl="xla": this no-cache path is also the training
-            # path, and the Pallas flash kernel has no VJP.  Serving prefill
-            # goes through the masked KV-cache branch above, so flash cannot
-            # apply there either (kernel supports causal, not arbitrary masks).
+            # path, and the Pallas flash kernel has no VJP (ring attention
+            # above covers sp-sharded training).  Serving prefill goes through
+            # the masked KV-cache branch, so flash cannot apply there either
+            # (kernel supports causal, not arbitrary masks).
             out = dot_product_attention(q, k, v, causal=True, mask=attn_mask)
         out = out.reshape(b, s, c.n_heads * hd)
         return dense(c.dim, "o_proj", False)(out), new_cache
@@ -145,11 +177,13 @@ class LlamaMLP(nn.Module):
 class LlamaBlock(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.bfloat16
+    ring_mesh: Any = None
 
     @nn.compact
     def __call__(self, x, positions, kv_cache, cache_index, attn_mask):
         c = self.cfg
-        h, new_cache = LlamaAttention(c, self.dtype, name="self_attn")(
+        h, new_cache = LlamaAttention(c, self.dtype, self.ring_mesh,
+                                      name="self_attn")(
             RMSNorm(c.rms_eps, self.dtype, name="input_layernorm")(x),
             positions, kv_cache, cache_index, attn_mask)
         x = x + h
@@ -159,10 +193,16 @@ class LlamaBlock(nn.Module):
 
 
 class LlamaModel(nn.Module):
-    """``tokens [B,S] → logits [B,S,V]`` with optional per-layer KV caches."""
+    """``tokens [B,S] → logits [B,S,V]`` with optional per-layer KV caches.
+
+    ``ring_mesh``: a ``jax.sharding.Mesh`` with an ``sp`` axis > 1 switches
+    the (cache-less) training attention to ring sequence parallelism —
+    params are unchanged, so the same checkpoint serves/rings freely.
+    """
 
     cfg: LlamaConfig
     dtype: Any = jnp.bfloat16
+    ring_mesh: Any = None
 
     @nn.compact
     def __call__(self, tokens, positions=None, kv_caches=None, cache_index=0,
@@ -176,7 +216,7 @@ class LlamaModel(nn.Module):
         new_caches = [] if kv_caches is not None else None
         for i in range(c.n_layers):
             cache_i = kv_caches[i] if kv_caches is not None else None
-            x, nc = LlamaBlock(c, self.dtype, name=f"layers_{i}")(
+            x, nc = LlamaBlock(c, self.dtype, self.ring_mesh, name=f"layers_{i}")(
                 x, positions, cache_i, cache_index, attn_mask)
             if new_caches is not None:
                 new_caches.append(nc)

@@ -246,7 +246,7 @@ class Generator:
         first, caches, key, n_prompt, max_new_tokens, t_prefill = (
             self._start_generation(prompt_tokens, max_new_tokens, sample, seed))
         t0 = time.time()
-        out: List[int] = [] if max_new_tokens == 0 else [first]
+        out: List[int] = [] if max_new_tokens <= 0 else [first]
         tok = first
         while len(out) and len(out) < max_new_tokens and not (
                 stop_tokens and tok in stop_tokens):

@@ -36,6 +36,11 @@ def auto_impl(b: int, sq: int, h: int, sk: int, has_mask: bool,
     each chip only runs ``b / data_shards`` of it — the crossover must be
     judged on the per-chip batch or DP serving would lose flash exactly
     where it wins.
+
+    (Negative result, measured: unrolling multiple heads per kernel grid
+    step to chase XLA at large B*H does not help — head_block=2 matched
+    plain XLA and >=4 overflows the 16 MB VMEM scoped stack with full K/V
+    panels per head.  Dispatching to XLA above the bound is the answer.)
     """
     per_chip_b = max(1, b // max(1, data_shards))
     bound = 128 if d >= 128 else 64

@@ -60,25 +60,36 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = False,
     scale: Optional[float] = None,
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
 ) -> jax.Array:
     """Exact BSHD attention with the sequence dim sharded over ``axis``.
 
-    q/k/v: ``[B, S, H, D]`` global arrays (sharded ``PS(None, axis)`` on S).
-    Returns ``[B, S, H, D]`` with the same sharding.  kv heads must equal q
-    heads (repeat GQA heads before sharding).
+    q/k/v: ``[B, S, H, D]`` global arrays.  Returns ``[B, S, H, D]`` with the
+    same sharding.  kv heads must equal q heads (repeat GQA heads first).
+
+    ``batch_axes``/``head_axis`` describe how batch and heads are already
+    sharded by the surrounding jit (megatron layout: batch over dp×fsdp,
+    heads over tp) so the shard_map doesn't force a resharding gather; axes
+    absent from ``mesh`` are dropped.  The ring loop is a ``lax.scan``, so
+    the whole op is reverse-mode differentiable — this is the TRAINING path
+    for sequence parallelism (ppermute has a transpose rule; the backward
+    pass rotates gradients around the same ring).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n_shards = mesh.shape[axis]
-    seq_spec = PS(None, axis, None, None)
+    b_axes = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    h_axis = head_axis if head_axis in mesh.axis_names else None
+    seq_spec = PS(b_axes, axis, h_axis, None)
 
     def local_fn(q_loc, k_loc, v_loc):
-        # q_loc: [B, S/sp, H, D] on every member of the ring
+        # q_loc: [B/dp·fsdp, S/sp, H/tp, D] on every member of the ring
         idx = jax.lax.axis_index(axis)
         s_loc = q_loc.shape[1]
         q_start = idx * s_loc
 
-        def body(i, carry):
+        def body(carry, i):
             k_cur, v_cur, acc, m_run, s_run = carry
             # K/V shard currently held started life on ring position idx - i
             src = jax.lax.rem(idx - i + n_shards, n_shards)
@@ -95,14 +106,14 @@ def ring_attention(
             perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return k_nxt, v_nxt, acc, m_new, s_run
+            return (k_nxt, v_nxt, acc, m_new, s_run), None
 
         b, sq, h, d = q_loc.shape
         acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
         m0 = jnp.full((b, h, sq), NEG_INF / 2, jnp.float32)
         s0 = jnp.zeros((b, h, sq), jnp.float32)
-        _, _, acc, _, s_run = jax.lax.fori_loop(
-            0, n_shards, body, (k_loc, v_loc, acc0, m0, s0))
+        (_, _, acc, _, s_run), _ = jax.lax.scan(
+            body, (k_loc, v_loc, acc0, m0, s0), jnp.arange(n_shards))
         denom = jnp.maximum(s_run, 1e-30).transpose(0, 2, 1)[..., None]
         return (acc / denom).astype(q_loc.dtype)
 
@@ -113,9 +124,12 @@ def ring_attention(
 
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, **kw):
-    """Convenience: place BSHD inputs with S over sp, run, return global."""
+    """Convenience: place BSHD inputs with S over sp (batch/heads
+    replicated — standalone use), run, return global."""
     from jax.sharding import NamedSharding
 
     spec = PS(None, "sp", None, None)
     place = lambda t: jax.device_put(t, NamedSharding(mesh, spec))
+    kw.setdefault("batch_axes", ())
+    kw.setdefault("head_axis", None)
     return ring_attention(place(q), place(k), place(v), mesh=mesh, **kw)

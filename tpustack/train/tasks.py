@@ -176,13 +176,18 @@ def _generic_lm_task(args, kind: str) -> None:
         from tpustack.models.llama import LlamaConfig, LlamaModel, causal_lm_loss
 
         cfg = LlamaConfig.tiny() if args.tiny else LlamaConfig.llama2_7b()
-        model = LlamaModel(cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
         seq = args.seq or min(cfg.max_seq, 2048)
         rules = LLAMA_RULES
         tp = args.tp or 1
-        fsdp = args.fsdp or (n_dev // tp)
-        dp = n_dev // (tp * fsdp)
-        mesh = build_mesh((dp, fsdp, tp, 1))
+        sp = args.sp or 1
+        if n_dev % (tp * sp) or n_dev < tp * sp:
+            raise SystemExit(
+                f"--tp={tp} x --sp={sp} must divide the {n_dev} devices")
+        fsdp = args.fsdp or (n_dev // (tp * sp))
+        dp = n_dev // (tp * sp * fsdp)
+        mesh = build_mesh((dp, fsdp, tp, sp))
+        model = LlamaModel(cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+                           ring_mesh=mesh if sp > 1 else None)
 
         def make_batch(rng):
             return jnp.asarray(rng.randint(0, cfg.vocab_size, (args.batch, seq)))
@@ -230,6 +235,9 @@ def main(argv=None) -> int:
     p.add_argument("--dp", type=int, default=0)
     p.add_argument("--fsdp", type=int, default=0)
     p.add_argument("--tp", type=int, default=0)
+    p.add_argument("--sp", type=int, default=0,
+                   help="sequence-parallel ways (llama2): >1 rings K/V over "
+                        "the sp axis for long-context training")
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--bf16", action="store_true", default=True)
