@@ -36,6 +36,9 @@ def main() -> int:
     p.add_argument("--prompt-tokens", type=int, default=512)
     p.add_argument("--new-tokens", type=int, default=128)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--quant", choices=["int8"], default=None,
+                   help="weight-only quantised serving (the reference serves "
+                        "Q4_K_M; int8 halves decode HBM traffic)")
     args = p.parse_args()
 
     import jax
@@ -48,28 +51,35 @@ def main() -> int:
     log(f"[bench_llm] backend={jax.default_backend()}")
 
     if args.preset == "tiny":
-        cfg = LlamaConfig.tiny(max_seq=min(args.ctx, 128))
+        cfg = dataclasses.replace(LlamaConfig.tiny(max_seq=min(args.ctx, 128)),
+                                  quant=args.quant)
         dtype = jnp.float32
         args.prompt_tokens = min(args.prompt_tokens, 32)
         args.new_tokens = min(args.new_tokens, 16)
     else:
         base = (LlamaConfig.llama2_7b() if args.preset == "llama2_7b"
                 else LlamaConfig.qwen25_7b())
-        cfg = dataclasses.replace(base, max_seq=args.ctx)
+        cfg = dataclasses.replace(base, max_seq=args.ctx, quant=args.quant)
         dtype = jnp.bfloat16
 
     t0 = time.time()
     if args.preset == "tiny":
         gen = Generator(cfg, dtype=dtype)
     else:
-        # 7B f32 random init (27 GB) would OOM a 16 GB chip; zero bf16
-        # params time identically on the MXU (no sparsity shortcuts)
+        # 7B f32 random init (27 GB) would OOM a 16 GB chip; zero params
+        # (bf16, or int8+scales under --quant) time identically on the MXU
+        # (no sparsity shortcuts).  Float template leaves are f32 (flax
+        # param_dtype default) — materialise them as the serving dtype, not
+        # t.dtype, or the zero tree itself is the 27 GB OOM.
         from tpustack.models.llama import LlamaModel
 
         model = LlamaModel(cfg, dtype=dtype)
         tmpl = jax.eval_shape(lambda: model.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))["params"]
-        params = jax.tree.map(lambda t: jnp.zeros(t.shape, dtype), tmpl)
+        params = jax.tree.map(
+            lambda t: jnp.zeros(t.shape,
+                                t.dtype if t.dtype == jnp.int8 else dtype),
+            tmpl)
         gen = Generator(cfg, params=params, dtype=dtype)
     log(f"[bench_llm] init {time.time() - t0:.1f}s")
 
@@ -98,7 +108,8 @@ def main() -> int:
             f"per-token loop {dec_loop[-1]:.1f} tok/s")
 
     print(json.dumps({
-        "metric": f"{args.preset}_bf16_ctx{args.ctx}_decode_tokens_per_sec",
+        "metric": f"{args.preset}_{args.quant or 'bf16'}_ctx{args.ctx}"
+                  "_decode_tokens_per_sec",
         "value": round(statistics.median(dec), 2),
         "unit": "tokens/s/chip",
         "prefill_tokens_per_sec": round(statistics.median(pre), 1),

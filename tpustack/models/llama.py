@@ -15,6 +15,10 @@ quantisation or layer offload — and is designed around XLA:
 - No data-dependent shapes: the cache is ``max_seq`` long; masking handles the
   valid prefix.  Sharding is applied externally via
   ``tpustack.parallel.sharding`` partition rules (megatron TP + FSDP).
+- ``quant="int8"`` swaps every projection for weight-only int8
+  (``tpustack.ops.quant``) — the TPU answer to the reference's Q4_K_M GGUF:
+  decode streams half the weight bytes per token, so the HBM-bound decode
+  nearly doubles.  Serving-only; training always runs bf16.
 """
 
 from __future__ import annotations
@@ -43,6 +47,7 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     qkv_bias: bool = False       # True for Qwen2
     tie_embeddings: bool = False
+    quant: Optional[str] = None  # None (bf16) | "int8" weight-only serving
 
     @property
     def head_dim(self) -> int:
@@ -112,10 +117,12 @@ class LlamaAttention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, kv_cache: Optional[KVCache], cache_index,
                  attn_mask) -> Tuple[jax.Array, Optional[KVCache]]:
+        from tpustack.ops.quant import make_dense
+
         c = self.cfg
         hd = c.head_dim
-        dense = lambda feats, name, bias: nn.Dense(
-            feats, use_bias=bias, dtype=self.dtype, name=name)
+        dense = lambda feats, name, bias: make_dense(
+            c.quant, feats, use_bias=bias, dtype=self.dtype, name=name)
         b, s, _ = x.shape
         q = dense(c.n_heads * hd, "q_proj", c.qkv_bias)(x).reshape(b, s, c.n_heads, hd)
         k = dense(c.n_kv_heads * hd, "k_proj", c.qkv_bias)(x).reshape(b, s, c.n_kv_heads, hd)
@@ -130,7 +137,21 @@ class LlamaAttention(nn.Module):
             v_all = jax.lax.dynamic_update_slice(
                 kv_cache["v"], v.astype(kv_cache["v"].dtype), (0, cache_index, 0, 0))
             new_cache = {"k": k_all, "v": v_all}
-            out = dot_product_attention(q, k_all, v_all, mask=attn_mask)
+            from_zero = isinstance(cache_index, int) and cache_index == 0
+            if s > 1 and from_zero and attn_mask is None:
+                # Prefill from position 0: attend IN-BUCKET, not over the
+                # whole cache — scores are [P, P] instead of [P, max_seq]
+                # (ctx/P× less attention work at serving shapes) and causal-
+                # only, so the Pallas flash kernel applies to long prompts.
+                # Padded tail positions only feed garbage to other padded
+                # rows (causal) and to cache slots that decode masks/
+                # overwrites; the engine reads logits at length-1 < P.
+                # Chunked prefill (cache_index > 0 / traced, or an explicit
+                # mask) must see the earlier cache, so it takes the masked
+                # full-cache path below.
+                out = dot_product_attention(q, k, v, causal=True, impl="auto")
+            else:
+                out = dot_product_attention(q, k_all, v_all, mask=attn_mask)
         elif (self.ring_mesh is not None and attn_mask is None
                 and "sp" in self.ring_mesh.axis_names
                 and self.ring_mesh.shape["sp"] > 1
@@ -167,11 +188,14 @@ class LlamaMLP(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from tpustack.ops.quant import make_dense
+
         c = self.cfg
-        gate = nn.Dense(c.ffn_dim, use_bias=False, dtype=self.dtype, name="gate_proj")(x)
-        up = nn.Dense(c.ffn_dim, use_bias=False, dtype=self.dtype, name="up_proj")(x)
-        return nn.Dense(c.dim, use_bias=False, dtype=self.dtype, name="down_proj")(
-            nn.silu(gate) * up)
+        dense = lambda feats, name: make_dense(
+            c.quant, feats, use_bias=False, dtype=self.dtype, name=name)
+        gate = dense(c.ffn_dim, "gate_proj")(x)
+        up = dense(c.ffn_dim, "up_proj")(x)
+        return dense(c.dim, "down_proj")(nn.silu(gate) * up)
 
 
 class LlamaBlock(nn.Module):
@@ -224,8 +248,14 @@ class LlamaModel(nn.Module):
         if c.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
-            logits = nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
-                              name="lm_head")(x.astype(jnp.float32))
+            from tpustack.ops.quant import make_dense
+
+            # int8 lm_head still matmuls in bf16 (x is bf16) but scales/
+            # accumulates logits in f32, matching the bf16 path's out dtype
+            logits = make_dense(c.quant, c.vocab_size, use_bias=False,
+                                dtype=self.dtype, name="lm_head",
+                                out_dtype=jnp.float32)(
+                x if c.quant else x.astype(jnp.float32))
         return logits, new_caches
 
 

@@ -51,35 +51,62 @@ class Generator:
         if params is None:
             log.warning("Initialising %s-layer LLM with RANDOM weights", config.n_layers)
             tokens = jnp.zeros((1, 8), jnp.int32)
-            params = jax.jit(self.model.init)(jax.random.PRNGKey(seed), tokens)["params"]
+            if config.quant:
+                # random-init the bf16 twin, then quantise — int8 kernels
+                # init to zeros, which would make a degenerate perf model
+                bf16 = LlamaModel(dataclasses.replace(config, quant=None),
+                                  dtype=dtype)
+                params = jax.jit(bf16.init)(
+                    jax.random.PRNGKey(seed), tokens)["params"]
+                params = self._quantize(params)
+            else:
+                params = jax.jit(self.model.init)(
+                    jax.random.PRNGKey(seed), tokens)["params"]
         self.params = params
+
+    @staticmethod
+    def _quantize(params: Dict) -> Dict:
+        from tpustack.ops.quant import quantize_params
+
+        t0 = time.time()
+        params = quantize_params(params)  # consumes the bf16 tree (HBM peak)
+        log.info("Quantised weights to int8 in %.1fs", time.time() - t0)
+        return params
 
     @classmethod
     def from_checkpoint(cls, config: LlamaConfig, model_dir: str,
                         dtype=jnp.bfloat16) -> "Generator":
         """Load HF safetensors without materialising a random template first
-        (jax.eval_shape gives the converter shapes at zero device cost)."""
+        (jax.eval_shape gives the converter shapes at zero device cost).
+        With ``config.quant`` the bf16 checkpoint is quantised in one jitted
+        pass at load time — the online analog of the reference's offline
+        GGUF conversion step."""
         from tpustack.models.llama_weights import load_llama_safetensors
 
-        model = LlamaModel(config, dtype=dtype)
+        bf16_cfg = dataclasses.replace(config, quant=None)
+        model = LlamaModel(bf16_cfg, dtype=dtype)
         tmpl = jax.eval_shape(
             lambda: model.init(jax.random.PRNGKey(0),
                                jnp.zeros((1, 8), jnp.int32)))["params"]
         params = load_llama_safetensors(model_dir, config, tmpl, dtype=dtype)
+        if config.quant:
+            params = cls._quantize(params)
         return cls(config, params=params, dtype=dtype)
 
     # -------------------------------------------------------------- compiled
     @functools.partial(jax.jit, static_argnums=(0,))
     def _prefill(self, params, tokens, length, caches):
-        """tokens [1, P] padded; valid prefix ``length``. Returns (logits_at_last, caches)."""
+        """tokens [1, P] padded; valid prefix ``length``. Returns (logits_at_last, caches).
+
+        No mask: prefill attention is in-bucket causal (see LlamaAttention) —
+        rows past ``length`` are garbage the ``length - 1`` gather never
+        reads, and the cache slots they write are masked/overwritten by
+        decode before they can be attended.
+        """
         b, p = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(p), (b, p))
-        # rows: query positions; cols: cache slots. Causal + only valid prefix.
-        q_pos = jnp.arange(p)[None, None, :, None]
-        k_pos = jnp.arange(self.cfg.max_seq)[None, None, None, :]
-        mask = (k_pos <= q_pos) & (q_pos < length) & (k_pos < length)
         logits, caches = self.model.apply(
-            {"params": params}, tokens, positions, caches, 0, mask)
+            {"params": params}, tokens, positions, caches, 0, None)
         last = jnp.take_along_axis(
             logits, (length - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
         return last, caches

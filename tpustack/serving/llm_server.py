@@ -19,6 +19,8 @@ whole-model on-chip (no GGUF quantisation, no ``--n-gpu-layers`` CPU split —
 v5e HBM holds 7B), ctx 4096 parity via ``LLM_CTX`` env.
 
 Env: ``LLM_PRESET`` (``qwen25_7b``|``llama2_7b``|``tiny``), ``LLM_CTX``,
+``LLM_QUANT`` (``int8`` → weight-only quantised serving, the analog of the
+reference's Q4_K_M GGUF but ~2x decode from halved HBM traffic),
 ``MODEL_DIR`` (HF safetensors), ``LLM_TOKENIZER_DIR``, ``PORT`` (8080).
 """
 
@@ -68,6 +70,11 @@ def _build_generator():
     else:
         cfg = dataclasses.replace(LlamaConfig.qwen25_7b(), max_seq=ctx)
         dtype = jnp.bfloat16
+
+    quant = os.environ.get("LLM_QUANT", "").lower() or None
+    if quant not in (None, "int8"):
+        raise ValueError(f"LLM_QUANT={quant!r} unsupported (want int8)")
+    cfg = dataclasses.replace(cfg, quant=quant)
 
     model_dir = os.environ.get("MODEL_DIR", "")
     if model_dir:

@@ -184,6 +184,10 @@ def _generic_lm_task(args, kind: str) -> None:
             raise SystemExit(
                 f"--tp={tp} x --sp={sp} must divide the {n_dev} devices")
         fsdp = args.fsdp or (n_dev // (tp * sp))
+        if n_dev % (tp * sp * fsdp):
+            raise SystemExit(
+                f"--tp={tp} x --sp={sp} x --fsdp={fsdp} must divide the "
+                f"{n_dev} devices")
         dp = n_dev // (tp * sp * fsdp)
         mesh = build_mesh((dp, fsdp, tp, sp))
         model = LlamaModel(cfg, dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
