@@ -39,6 +39,9 @@ def main() -> int:
     p.add_argument("--quant", choices=["int8"], default=None,
                    help="weight-only quantised serving (the reference serves "
                         "Q4_K_M; int8 halves decode HBM traffic)")
+    p.add_argument("--batch", type=int, default=1,
+                   help=">1: slot-parallel batched decode (generate_batch) — "
+                        "aggregate tokens/s across the batch")
     args = p.parse_args()
 
     import jax
@@ -85,35 +88,47 @@ def main() -> int:
 
     prompt = list(range(5, 5 + args.prompt_tokens))
     sample = SampleConfig(greedy=True)
-    fused = lambda seed: gen.generate_fused(
-        prompt, max_new_tokens=args.new_tokens, sample=sample, seed=seed,
-        chunk=min(32, args.new_tokens))
-    loop = lambda seed: gen.generate(
-        prompt, max_new_tokens=args.new_tokens, sample=sample, seed=seed)
+    if args.batch > 1:
+        fused = lambda seed: gen.generate_batch(
+            [prompt] * args.batch, args.new_tokens,
+            [sample] * args.batch, seed=seed,
+            chunk=min(32, args.new_tokens))
+        loop = None  # per-token host loop has no batched variant
+    else:
+        fused = lambda seed: gen.generate_fused(
+            prompt, max_new_tokens=args.new_tokens, sample=sample, seed=seed,
+            chunk=min(32, args.new_tokens))
+        loop = lambda seed: gen.generate(
+            prompt, max_new_tokens=args.new_tokens, sample=sample, seed=seed)
 
     t0 = time.time()
     fused(0)
     log(f"[bench_llm] compile+first {time.time() - t0:.1f}s")
-    loop(0)
+    if loop is not None:
+        loop(0)
 
     pre, dec, dec_loop = [], [], []
     for i in range(args.repeats):
         _, stats = fused(i + 1)
-        pre.append(args.prompt_tokens / stats["prefill_s"])
+        pre.append(args.batch * args.prompt_tokens / stats["prefill_s"])
         dec.append(stats["tokens_per_s"])
-        _, lstats = loop(i + 1)
-        dec_loop.append(lstats["tokens_per_s"])
+        extra = ""
+        if loop is not None:
+            _, lstats = loop(i + 1)
+            dec_loop.append(lstats["tokens_per_s"])
+            extra = f", per-token loop {dec_loop[-1]:.1f} tok/s"
         log(f"[bench_llm] run {i + 1}: prefill {pre[-1]:.0f} tok/s, "
-            f"fused decode {dec[-1]:.1f} tok/s, "
-            f"per-token loop {dec_loop[-1]:.1f} tok/s")
+            f"fused decode {dec[-1]:.1f} tok/s{extra}")
 
+    batch_tag = f"_batch{args.batch}" if args.batch > 1 else ""
     print(json.dumps({
         "metric": f"{args.preset}_{args.quant or 'bf16'}_ctx{args.ctx}"
-                  "_decode_tokens_per_sec",
+                  f"{batch_tag}_decode_tokens_per_sec",
         "value": round(statistics.median(dec), 2),
         "unit": "tokens/s/chip",
         "prefill_tokens_per_sec": round(statistics.median(pre), 1),
-        "per_token_loop_tokens_per_sec": round(statistics.median(dec_loop), 2),
+        "per_token_loop_tokens_per_sec": (round(statistics.median(dec_loop), 2)
+                                          if dec_loop else None),
         "prompt_tokens": args.prompt_tokens,
         "new_tokens": args.new_tokens,
     }))

@@ -113,21 +113,28 @@ class Generator:
 
     def _sample_from_logits(self, logits, key, temperature, top_k, greedy):
         """``[B, V]`` fp32 logits → ``[B]`` int32 token (traced; shared by the
-        single-step and fused-scan decoders so they sample identically)."""
+        single-step and fused-scan decoders so they sample identically).
 
-        def sample(logits):
-            scaled = logits / jnp.maximum(temperature, 1e-4)
-            # top-k with a traced k: take a static top-64 slate (descending),
-            # threshold at the clamp(top_k)-th value; top_k<=0 disables.
-            slate = min(64, self.cfg.vocab_size)
-            topv = jax.lax.top_k(scaled, k=slate)[0]  # [B, slate] descending
-            idx = jnp.clip(top_k - 1, 0, slate - 1)
-            kth = jnp.take_along_axis(topv, jnp.broadcast_to(idx, (topv.shape[0], 1)), axis=1)
-            thresh = jnp.where(top_k > 0, kth, -jnp.inf)
-            scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
-            return jax.random.categorical(key, scaled, axis=-1)
+        ``temperature``/``top_k``/``greedy`` may be scalars or per-row
+        ``[B]`` arrays — batched serving mixes requests with different
+        sampling settings in one device step."""
+        b = logits.shape[0]
+        col = lambda x: jnp.broadcast_to(
+            jnp.atleast_1d(jnp.asarray(x)), (b,))[:, None]  # [B, 1]
+        temp, tk, gr = col(temperature), col(top_k), col(greedy)
 
-        next_tok = jnp.where(greedy, jnp.argmax(logits, axis=-1), sample(logits))
+        scaled = logits / jnp.maximum(temp, 1e-4)
+        # top-k with a traced k: take a static top-64 slate (descending),
+        # threshold at the clamp(top_k)-th value per row; top_k<=0 disables.
+        slate = min(64, self.cfg.vocab_size)
+        topv = jax.lax.top_k(scaled, k=slate)[0]  # [B, slate] descending
+        idx = jnp.clip(tk - 1, 0, slate - 1).astype(jnp.int32)
+        kth = jnp.take_along_axis(topv, idx, axis=1)
+        thresh = jnp.where(tk > 0, kth, -jnp.inf)
+        scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+
+        next_tok = jnp.where(gr[:, 0], jnp.argmax(logits, axis=-1), sampled)
         return next_tok.astype(jnp.int32)
 
     def _decode_logits(self, params, token, index, caches):
@@ -171,6 +178,184 @@ class Generator:
         (_, caches, key_out), toks = jax.lax.scan(
             step, (first_tok, caches, key), jnp.arange(n_steps))
         return toks.T, caches, key_out  # [B, n_steps], advanced key
+
+    # ------------------------------------------------------- batched decode
+    #
+    # Deliberately a SEPARATE stack from the solo decoders above, not their
+    # generalisation: solo decode writes contiguously at n_prompt + i (full
+    # ``max_seq - n_prompt`` token budget, the streaming path's layout) while
+    # batched decode writes at ``bucket + t`` with a masked gap (uniform
+    # write slot across rows, budget ``max_seq - bucket``).  B=1 parity
+    # between the stacks is pinned by test_llm_batch.py.
+    #
+    # B requests with different prompt lengths decode as ONE device program:
+    # every row writes its cache at the same slot (``bucket + t`` — uniform,
+    # so one dynamic_update_slice serves all rows) while attending with its
+    # TRUE rotary position (``lengths[i] + t``, passed through to RoPE) and a
+    # per-row mask that sees [0, lengths[i]) ∪ [bucket, bucket + t].  The gap
+    # [lengths[i], bucket) holds prefill padding garbage and is never
+    # attended.  Decode streams the weights once per step regardless of B, so
+    # aggregate tokens/s scales ~linearly until the KV-cache reads catch up —
+    # the slot-parallel analog of the reference server's ``--parallel`` and
+    # of the SD server's micro-batching.
+
+    def _decode_logits_batch(self, params, token, step, lengths, bucket,
+                             caches):
+        """``token [B,1]`` → (``[B,V]`` f32, caches); write slot bucket+step."""
+        index = bucket + step
+        positions = (lengths + step)[:, None]  # true per-row RoPE position
+        ar = jnp.arange(self.cfg.max_seq)[None, :]
+        valid = (ar < lengths[:, None]) | ((ar >= bucket) & (ar <= index))
+        logits, caches = self.model.apply(
+            {"params": params}, token, positions, caches, index,
+            valid[:, None, None, :])
+        return logits[:, -1].astype(jnp.float32), caches
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(6,))
+    def _decode_step_batch(self, params, token, step, lengths, bucket, caches,
+                           key, temperature, top_k, greedy):
+        logits, caches = self._decode_logits_batch(
+            params, token, step, lengths, bucket, caches)
+        return self._sample_from_logits(logits, key, temperature, top_k,
+                                        greedy), caches
+
+    @functools.partial(jax.jit, static_argnums=(0, 11), donate_argnums=(6,))
+    def _decode_scan_batch(self, params, first_tok, step0, lengths, bucket,
+                           caches, key, temperature, top_k, greedy,
+                           n_steps: int):
+        """``n_steps`` batched decode iterations in ONE dispatch."""
+
+        def step(carry, i):
+            tok, caches, key = carry
+            logits, caches = self._decode_logits_batch(
+                params, tok, step0 + i, lengths, bucket, caches)
+            step_key, key = jax.random.split(key)
+            nxt = self._sample_from_logits(logits, step_key, temperature,
+                                           top_k, greedy)
+            return (nxt[:, None], caches, key), nxt
+
+        (_, caches, key_out), toks = jax.lax.scan(
+            step, (first_tok, caches, key), jnp.arange(n_steps))
+        return toks.T, caches, key_out  # [B, n_steps]
+
+    def generate_batch(
+        self,
+        prompts: List[List[int]],
+        max_new_tokens,
+        sample: List[SampleConfig],
+        seed: Optional[int] = None,
+        stop_tokens: Tuple[int, ...] = (),
+        chunk: int = 16,
+        on_chunk=None,
+        cancel_check=None,
+    ) -> Tuple[List[List[int]], Dict[str, float]]:
+        """Decode B prompts concurrently; returns (per-row token ids, stats).
+
+        ``max_new_tokens``: int or per-row list.  ``sample``: one
+        SampleConfig per row (mixed temperatures/top_k/greedy batch fine).
+        ``on_chunk(step_toks)``: called with the ``[B, <=chunk]`` numpy block
+        after each fused dispatch — the batched streaming hook (chunk
+        granularity).  ``cancel_check()`` polled between chunks.
+
+        Row capacity is uniform: every row may generate up to
+        ``max_seq - bucket`` tokens, where ``bucket`` is the padded length of
+        the LONGEST prompt in the batch (batch peers share the cache layout).
+        """
+        c = self.cfg
+        b = len(prompts)
+        if b == 0:
+            raise ValueError("empty batch")
+        if len(sample) != b:
+            raise ValueError(f"need {b} SampleConfigs, got {len(sample)}")
+        lens = [len(p) for p in prompts]
+        if min(lens) == 0:
+            raise ValueError("empty prompt in batch")
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * b
+        bucket = self._bucket(max(lens))
+        capacity = c.max_seq - bucket
+        if capacity <= 0:
+            raise ValueError(f"longest prompt ({max(lens)}) exceeds ctx budget "
+                             f"{c.max_seq}")
+        max_new = [min(m, capacity) for m in max_new_tokens]
+
+        t0 = time.time()
+        tokens = np.zeros((b, bucket), np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, :len(p)] = p
+        caches = init_kv_caches(c, b, dtype=self.cache_dtype)
+        lengths = jnp.asarray(lens, jnp.int32)
+        logits, caches = self._prefill(self.params, jnp.asarray(tokens),
+                                       lengths, caches)
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31)
+                                 if seed is None else seed)
+        temperature = jnp.asarray([s.temperature for s in sample], jnp.float32)
+        top_k = jnp.asarray([s.top_k for s in sample], jnp.int32)
+        greedy = jnp.asarray([s.greedy for s in sample], jnp.bool_)
+
+        first_key, key = jax.random.split(key)
+        first = np.asarray(self._sample_from_logits(
+            logits, first_key, temperature, top_k, greedy))
+        t_prefill = time.time() - t0
+
+        t0 = time.time()
+        out: List[List[int]] = [[int(first[i])] if max_new[i] > 0 else []
+                                for i in range(b)]
+        done = [max_new[i] <= 1 or out[i][0] in stop_tokens for i in range(b)]
+        tok = first[:, None].astype(np.int32)
+        step = 0  # decode steps already scanned past the first token
+        bucket_arr = jnp.asarray(bucket, jnp.int32)
+        while not all(done) and step < max(max_new) - 1:
+            if cancel_check is not None and cancel_check():
+                break
+            tail = capacity - 1 - step
+            if tail <= 0:
+                break
+            if tail >= chunk:
+                # always scan a FULL chunk — one compiled signature per
+                # (B, chunk); surplus tokens are discarded on the host
+                toks, caches, key = self._decode_scan_batch(
+                    self.params, jnp.asarray(tok),
+                    jnp.asarray(step, jnp.int32), lengths, bucket_arr,
+                    caches, key, temperature, top_k, greedy, chunk)
+                block = np.asarray(toks)  # [B, chunk]
+            else:
+                # cache tail shorter than a chunk: finish on the single-step
+                # batched decoder instead of compiling a scan signature for
+                # this exact tail length
+                cols = []
+                for j in range(tail):
+                    step_key, key = jax.random.split(key)
+                    nxt, caches = self._decode_step_batch(
+                        self.params, jnp.asarray(tok),
+                        jnp.asarray(step + j, jnp.int32), lengths,
+                        bucket_arr, caches, step_key, temperature, top_k,
+                        greedy)
+                    tok = np.asarray(nxt)[:, None].astype(np.int32)
+                    cols.append(tok[:, 0])
+                block = np.stack(cols, axis=1)  # [B, tail]
+            for i in range(b):
+                if done[i]:
+                    continue
+                for t in block[i]:
+                    out[i].append(int(t))
+                    if int(t) in stop_tokens or len(out[i]) >= max_new[i]:
+                        done[i] = True
+                        break
+            if on_chunk is not None:
+                on_chunk(block)
+            tok = block[:, -1:].astype(np.int32)
+            step += block.shape[1]
+        t_decode = time.time() - t0
+        n_gen = sum(len(o) for o in out)
+        return out, {
+            "batch": b,
+            "prompt_tokens": sum(lens),
+            "generated_tokens": n_gen,
+            "prefill_s": t_prefill,
+            "decode_s": t_decode,
+            "tokens_per_s": n_gen / t_decode if t_decode > 0 else 0.0,
+        }
 
     # ---------------------------------------------------------------- public
     def _bucket(self, n: int) -> int:
