@@ -68,6 +68,21 @@ def test_batch_mixed_sampling_configs(gen):
     assert all(0 <= t < gen.cfg.vocab_size for t in outs[1])
 
 
+def test_batch_on_row_done_fires_early(gen):
+    """A short row's completion callback fires before the long row's, with
+    that row's final tokens — the server unblocks short requests without
+    waiting for the slowest batch peer."""
+    order = []
+    outs, _ = gen.generate_batch(
+        [[5, 6], [7, 8]], [2, 20], [GREEDY] * 2, seed=0, chunk=4,
+        on_row_done=lambda i, toks, st: order.append((i, toks, st)))
+    assert [i for i, _, _ in order] == [0, 1]  # short row first
+    by_row = {i: toks for i, toks, _ in order}
+    assert by_row[0] == outs[0] and by_row[1] == outs[1]
+    stats0 = order[0][2]
+    assert stats0["generated_tokens"] == 2 and stats0["batch"] == 2
+
+
 def test_batch_on_chunk_streaming_hook(gen):
     blocks = []
     outs, _ = gen.generate_batch([[5, 6], [7, 8]], 7, [GREEDY] * 2, seed=0,
@@ -188,6 +203,32 @@ def test_server_seeded_sampling_stays_solo(gen):
     finally:
         gen.generate_batch = real_batch
     assert j["tokens_predicted"] <= 4
+
+
+def test_chunked_prefill_matches_single_shot():
+    """Long prompts prefill in PREFILL_CHUNK windows attending the cache
+    prefix (streaming flash kernel, traced offset).  Forcing a tiny chunk on
+    the tiny model must reproduce the single-shot path token-for-token."""
+    g = Generator(LlamaConfig.tiny(max_seq=64), dtype=jnp.float32, seed=3)
+    prompt = list(range(5, 45))  # bucket 64
+    ref, _ = g.generate(prompt, max_new_tokens=6, sample=GREEDY, seed=0)
+    g.PREFILL_CHUNK = 16  # instance override → 4 chunks of 16
+    out, _ = g.generate(prompt, max_new_tokens=6, sample=GREEDY, seed=0)
+    assert out == ref
+
+
+def test_chunked_prefill_batch_short_row_peaks_early():
+    """In a chunked batch, a row much shorter than the bucket takes its
+    first-token logits from an EARLY chunk, not the last one."""
+    g = Generator(LlamaConfig.tiny(max_seq=128), dtype=jnp.float32, seed=3)
+    long_p = list(range(5, 45))   # drives bucket to 64
+    short_p = [7, 8, 9]           # last token in chunk 0
+    ref_long, _ = g.generate_batch([long_p], 5, [GREEDY], seed=0)
+    ref_short, _ = g.generate(short_p, max_new_tokens=5, sample=GREEDY, seed=0)
+    g.PREFILL_CHUNK = 16
+    outs, _ = g.generate_batch([long_p, short_p], 5, [GREEDY] * 2, seed=0)
+    assert outs[0] == ref_long[0]
+    assert outs[1] == ref_short[:len(outs[1])] and len(outs[1]) == 5
 
 
 def test_batch_quantized_generator():

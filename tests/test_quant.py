@@ -81,8 +81,11 @@ def test_quantize_params_consumes_and_skips_non_target():
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
     emb_before = params["embed_tokens"]["embedding"]
     qtree = quantize_params(params)
-    # embed untouched (gather, not matmul); norms untouched
-    assert qtree["embed_tokens"]["embedding"] is emb_before
+    # embed table quantised too (int8 gather — pure HBM capacity win);
+    # scales are per vocab ROW, not per feature (outlier-token robustness)
+    assert qtree["embed_tokens"]["embedding"].dtype == jnp.int8
+    assert qtree["embed_tokens"]["scale"].shape == (cfg.vocab_size,)
+    # norms untouched
     assert "scale" in qtree["norm"] and qtree["norm"]["scale"].dtype != jnp.int8
     # every projection quantised
     attn = qtree["layers_0"]["self_attn"]
@@ -91,6 +94,16 @@ def test_quantize_params_consumes_and_skips_non_target():
         assert attn[name]["scale"].dtype == jnp.float32
     # bf16 kernels were popped out of the input tree (freed for HBM headroom)
     assert "kernel" not in params["lm_head"]
+
+    # tied-embedding configs keep the bf16 table (embed.attend path)
+    tied_cfg = dataclasses.replace(LlamaConfig.tiny(max_seq=32),
+                                   tie_embeddings=True)
+    tied = LlamaModel(tied_cfg, dtype=jnp.float32)
+    tparams = tied.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    emb = tparams["embed_tokens"]["embedding"]
+    ttree = quantize_params(tparams, quantize_embed=False)
+    assert ttree["embed_tokens"]["embedding"] is emb
 
 
 def test_generator_end_to_end_int8():

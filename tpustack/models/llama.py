@@ -147,9 +147,21 @@ class LlamaAttention(nn.Module):
                 # rows (causal) and to cache slots that decode masks/
                 # overwrites; the engine reads logits at length-1 < P.
                 # Chunked prefill (cache_index > 0 / traced, or an explicit
-                # mask) must see the earlier cache, so it takes the masked
-                # full-cache path below.
+                # mask) must see the earlier cache, so it takes a full-cache
+                # path below.
                 out = dot_product_attention(q, k, v, causal=True, impl="auto")
+            elif s > 1 and attn_mask is None:
+                # Chunked long-context prefill: this chunk's rows sit at
+                # global positions cache_index + i and attend the whole
+                # cache prefix causally via the k-streaming flash kernel
+                # (traced offset/length — one compiled program serves every
+                # chunk; GQA K/V stay unexpanded inside the kernel).  XLA
+                # would need [s, max_seq] scores per head here.
+                from tpustack.ops.pallas.flash_attention import flash_attention
+
+                out = flash_attention(q, k_all, v_all, causal=True,
+                                      q_offset=cache_index,
+                                      kv_len=cache_index + s)
             else:
                 out = dot_product_attention(q, k_all, v_all, mask=attn_mask)
         elif (self.ring_mesh is not None and attn_mask is None
@@ -230,12 +242,26 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, kv_caches=None, cache_index=0,
-                 attn_mask=None):
+                 attn_mask=None, logits_at=None):
+        """``logits_at``: optional ``[B]`` positions — compute logits ONLY at
+        those sequence positions.  Long-context prefill must use this: full
+        ``[B, S, vocab]`` f32 logits at 16k × Qwen's 152k vocab are ~10 GB,
+        more than the lm_head needs to produce one next token."""
         c = self.cfg
         b, s = tokens.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-        embed = nn.Embed(c.vocab_size, c.dim, dtype=self.dtype, name="embed_tokens")
+        if c.quant and not c.tie_embeddings:
+            # int8 table frees ~0.5 GB of HBM on 150k-vocab models (gather +
+            # rescale, no matmul); tied-embedding models keep bf16 so
+            # ``embed.attend`` stays exact
+            from tpustack.ops.quant import Int8Embed
+
+            embed = Int8Embed(c.vocab_size, c.dim, dtype=self.dtype,
+                              name="embed_tokens")
+        else:
+            embed = nn.Embed(c.vocab_size, c.dim, dtype=self.dtype,
+                             name="embed_tokens")
         x = embed(tokens)
         new_caches = [] if kv_caches is not None else None
         for i in range(c.n_layers):
@@ -245,6 +271,9 @@ class LlamaModel(nn.Module):
             if new_caches is not None:
                 new_caches.append(nc)
         x = RMSNorm(c.rms_eps, self.dtype, name="norm")(x)
+        if logits_at is not None:
+            x = jnp.take_along_axis(
+                x, logits_at[:, None, None].astype(jnp.int32), axis=1)  # [B,1,D]
         if c.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:

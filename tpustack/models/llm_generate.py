@@ -58,18 +58,21 @@ class Generator:
                                   dtype=dtype)
                 params = jax.jit(bf16.init)(
                     jax.random.PRNGKey(seed), tokens)["params"]
-                params = self._quantize(params)
+                params = self._quantize(config, params)
             else:
                 params = jax.jit(self.model.init)(
                     jax.random.PRNGKey(seed), tokens)["params"]
         self.params = params
 
     @staticmethod
-    def _quantize(params: Dict) -> Dict:
+    def _quantize(cfg: LlamaConfig, params: Dict) -> Dict:
         from tpustack.ops.quant import quantize_params
 
         t0 = time.time()
-        params = quantize_params(params)  # consumes the bf16 tree (HBM peak)
+        # consumes the bf16 tree (HBM peak); tied-embedding models keep the
+        # bf16 table — the model uses embed.attend for logits
+        params = quantize_params(params,
+                                 quantize_embed=not cfg.tie_embeddings)
         log.info("Quantised weights to int8 in %.1fs", time.time() - t0)
         return params
 
@@ -90,26 +93,68 @@ class Generator:
                                jnp.zeros((1, 8), jnp.int32)))["params"]
         params = load_llama_safetensors(model_dir, config, tmpl, dtype=dtype)
         if config.quant:
-            params = cls._quantize(params)
+            params = cls._quantize(config, params)
         return cls(config, params=params, dtype=dtype)
 
     # -------------------------------------------------------------- compiled
-    @functools.partial(jax.jit, static_argnums=(0,))
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(4,))
     def _prefill(self, params, tokens, length, caches):
-        """tokens [1, P] padded; valid prefix ``length``. Returns (logits_at_last, caches).
+        """tokens [B, P] padded; valid prefix ``length [B]``. Returns
+        (logits at each row's last real token ``[B, V]``, caches).
 
         No mask: prefill attention is in-bucket causal (see LlamaAttention) —
         rows past ``length`` are garbage the ``length - 1`` gather never
         reads, and the cache slots they write are masked/overwritten by
-        decode before they can be attended.
+        decode before they can be attended.  The hidden-state gather happens
+        BEFORE the lm_head (``logits_at``): full [B, P, vocab] f32 logits at
+        long context would dwarf the model itself (~10 GB at 16k for Qwen).
+        Caches are donated — prefill writes them in place.
         """
         b, p = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(p), (b, p))
         logits, caches = self.model.apply(
-            {"params": params}, tokens, positions, caches, 0, None)
-        last = jnp.take_along_axis(
-            logits, (length - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-        return last, caches
+            {"params": params}, tokens, positions, caches, 0, None,
+            length - 1)
+        return logits[:, 0], caches
+
+    #: chunk size for long prompts — one 8k chunk's activations (~1.3 GB of
+    #: gate/up transients at 7B) bound prefill memory however long the
+    #: prompt; a single-shot 32k-bucket program would need ~23 GB
+    PREFILL_CHUNK = 8192
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(5,))
+    def _prefill_chunk(self, params, tokens, offset, length, caches):
+        """One chunk of a long prompt: rows at global positions offset + i,
+        attending the whole cache prefix (flash, traced offset — every chunk
+        reuses ONE compiled program).  Returns logits at ``length - 1``
+        clipped into this chunk (garbage except on the final chunk, where
+        the clip is a no-op)."""
+        b, s = tokens.shape
+        positions = offset + jnp.broadcast_to(jnp.arange(s), (b, s))
+        local_last = jnp.clip(length - 1 - offset, 0, s - 1)
+        logits, caches = self.model.apply(
+            {"params": params}, tokens, positions, caches, offset, None,
+            local_last)
+        return logits[:, 0], caches
+
+    def _prefill_long(self, tokens: np.ndarray, length, caches):
+        """Chunked prefill driver: ``tokens [B, bucket]`` with bucket a
+        multiple of PREFILL_CHUNK (buckets are powers of two above it).
+        Each row's logits are taken from the chunk containing its last real
+        token — rows shorter than the bucket peak in an early chunk."""
+        b, bucket = tokens.shape
+        chunk = self.PREFILL_CHUNK
+        out = None
+        lo = 0
+        while lo < bucket:  # final segment may be shorter (bucket capped at
+            n = min(chunk, bucket - lo)  # a non-multiple max_seq): its own
+            seg = jnp.asarray(tokens[:, lo:lo + n])  # (one) jit signature
+            logits, caches = self._prefill_chunk(
+                self.params, seg, jnp.asarray(lo, jnp.int32), length, caches)
+            hit = (length - 1 >= lo) & (length - 1 < lo + n)  # [B]
+            out = logits if out is None else jnp.where(hit[:, None], logits, out)
+            lo += n
+        return out, caches
 
     def _sample_from_logits(self, logits, key, temperature, top_k, greedy):
         """``[B, V]`` fp32 logits → ``[B]`` int32 token (traced; shared by the
@@ -247,6 +292,7 @@ class Generator:
         stop_tokens: Tuple[int, ...] = (),
         chunk: int = 16,
         on_chunk=None,
+        on_row_done=None,
         cancel_check=None,
     ) -> Tuple[List[List[int]], Dict[str, float]]:
         """Decode B prompts concurrently; returns (per-row token ids, stats).
@@ -255,7 +301,11 @@ class Generator:
         SampleConfig per row (mixed temperatures/top_k/greedy batch fine).
         ``on_chunk(step_toks)``: called with the ``[B, <=chunk]`` numpy block
         after each fused dispatch — the batched streaming hook (chunk
-        granularity).  ``cancel_check()`` polled between chunks.
+        granularity).  ``on_row_done(i, tokens, row_stats)``: called the
+        moment row ``i`` stops (EOS / its own budget) — a short request in a
+        batch is answered immediately instead of waiting for the slowest
+        peer (every row is notified exactly once; stragglers at return).
+        ``cancel_check()`` polled between chunks.
 
         Row capacity is uniform: every row may generate up to
         ``max_seq - bucket`` tokens, where ``bucket`` is the padded length of
@@ -285,8 +335,11 @@ class Generator:
             tokens[i, :len(p)] = p
         caches = init_kv_caches(c, b, dtype=self.cache_dtype)
         lengths = jnp.asarray(lens, jnp.int32)
-        logits, caches = self._prefill(self.params, jnp.asarray(tokens),
-                                       lengths, caches)
+        if bucket > self.PREFILL_CHUNK:
+            logits, caches = self._prefill_long(tokens, lengths, caches)
+        else:
+            logits, caches = self._prefill(self.params, jnp.asarray(tokens),
+                                           lengths, caches)
         key = jax.random.PRNGKey(np.random.randint(0, 2**31)
                                  if seed is None else seed)
         temperature = jnp.asarray([s.temperature for s in sample], jnp.float32)
@@ -302,6 +355,26 @@ class Generator:
         out: List[List[int]] = [[int(first[i])] if max_new[i] > 0 else []
                                 for i in range(b)]
         done = [max_new[i] <= 1 or out[i][0] in stop_tokens for i in range(b)]
+
+        notified = [False] * b
+
+        def notify(i):
+            if on_row_done is None or notified[i]:
+                return
+            notified[i] = True
+            dt = time.time() - t0
+            on_row_done(i, list(out[i]), {
+                "batch": b,
+                "prompt_tokens": lens[i],
+                "generated_tokens": len(out[i]),
+                "prefill_s": t_prefill,
+                "decode_s": dt,
+                "tokens_per_s": len(out[i]) / dt if dt > 0 else 0.0,
+            })
+
+        for i in range(b):
+            if done[i]:
+                notify(i)
         tok = first[:, None].astype(np.int32)
         step = 0  # decode steps already scanned past the first token
         bucket_arr = jnp.asarray(bucket, jnp.int32)
@@ -341,11 +414,14 @@ class Generator:
                     out[i].append(int(t))
                     if int(t) in stop_tokens or len(out[i]) >= max_new[i]:
                         done[i] = True
+                        notify(i)
                         break
             if on_chunk is not None:
                 on_chunk(block)
             tok = block[:, -1:].astype(np.int32)
             step += block.shape[1]
+        for i in range(b):  # stragglers: budget/cancel exits without done[i]
+            notify(i)
         t_decode = time.time() - t0
         n_gen = sum(len(o) for o in out)
         return out, {
@@ -385,7 +461,11 @@ class Generator:
         tokens[0, :n_prompt] = prompt_tokens
         caches = init_kv_caches(c, 1, dtype=self.cache_dtype)
         length = jnp.asarray([n_prompt], jnp.int32)
-        logits, caches = self._prefill(self.params, jnp.asarray(tokens), length, caches)
+        if bucket > self.PREFILL_CHUNK:
+            logits, caches = self._prefill_long(tokens, length, caches)
+        else:
+            logits, caches = self._prefill(self.params, jnp.asarray(tokens),
+                                           length, caches)
         key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None else seed)
 
         # first sampled token comes from prefill logits: reuse decode's sampling

@@ -45,8 +45,13 @@ def auto_impl(b: int, sq: int, h: int, sk: int, has_mask: bool,
     per_chip_b = max(1, b // max(1, data_shards))
     bound = 128 if d >= 128 else 64
     in_range = 1024 <= sq <= 8192 and 1024 <= sk <= 8192
-    return ("flash" if in_range and not has_mask and per_chip_b * h <= bound
-            and backend == "tpu" else "xla")
+    # Beyond the 8k panel ceiling XLA would materialise [Sq, Sk] scores
+    # (tens of GB at 32k) — the k-streaming flash kernel is the only viable
+    # path, whatever batch*heads is.
+    long_ctx = sk > 8192
+    return ("flash" if not has_mask and backend == "tpu"
+            and (long_ctx or (in_range and per_chip_b * h <= bound))
+            else "xla")
 
 
 def dot_product_attention(
@@ -89,9 +94,7 @@ def dot_product_attention(
             raise NotImplementedError("flash impl supports causal=, not arbitrary mask=")
         from tpustack.ops.pallas.flash_attention import flash_attention
 
-        if hkv != h:  # the kernel wants matched heads
-            k = jnp.repeat(k, h // hkv, axis=2)
-            v = jnp.repeat(v, h // hkv, axis=2)
+        # GQA is native in the kernel (K/V BlockSpec maps bh // group)
         return flash_attention(q, k, v, causal=causal, scale=scale)
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")

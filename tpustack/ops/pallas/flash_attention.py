@@ -27,6 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -66,6 +67,81 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
     o_ref[0] = out.astype(o_ref.dtype)
 
 
+def _attn_kernel_stream(q_ref, k_ref, v_ref, off_ref, len_ref, o_ref,
+                        m_ref, l_ref, acc_ref, *, scale: float, causal: bool,
+                        block_q: int, block_k: int, n_k: int):
+    """One (batch*head, q-block, k-block) grid step with a running-softmax
+    carry — the long-context kernel.  Unlike ``_attn_kernel`` the K/V panel
+    never sits whole in VMEM: blocks of ``block_k`` stream through while
+    fp32 scratch carries the online-softmax state (max ``m``, denominator
+    ``l``, unnormalised accumulator ``acc``) across the innermost grid dim.
+    TPU grid steps run sequentially per core, so the scratch persists from
+    one k-block to the next; it is reset at ``ki == 0`` and the normalised
+    output is written at the last k-block.  Sequence length is bounded by
+    HBM, not VMEM.
+
+    ``off_ref``/``len_ref`` are SMEM scalars: the q rows' global position
+    offset (chunked prefill: a chunk at cache offset ``off`` attends the
+    whole cache prefix) and the number of valid K tokens.  K-blocks past
+    ``len`` or fully above the (offset) diagonal skip their compute (their
+    DMA is still scheduled — see the wrapper docstring).
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    off = off_ref[0]
+    kv_len = len_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    col0 = ki * block_k
+    # skip k-blocks past the valid length; causal: also those fully above
+    # this q-block's diagonal
+    needed = col0 < kv_len
+    if causal:
+        needed = needed & (col0 <= off + qi * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]                            # [block_q, D]
+        k = k_ref[0]                            # [block_k, D]
+        v = v_ref[0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
+
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + col0
+        valid = col < kv_len
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            valid = valid & (col <= row + off + qi * block_q)
+        logits = jnp.where(valid, logits, NEG_INF)
+
+        m_prev = m_ref[:, :1]                   # [block_q, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)         # rescale of prior state
+        p = jnp.exp(logits - m_cur)
+        l_ref[...] = jnp.broadcast_to(l_prev * alpha +
+                                      jnp.sum(p, axis=-1, keepdims=True),
+                                      l_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        # l == 0 only for q rows whose every k column is masked (q padding
+        # rows, or causal rows past kv_len) — their output is garbage the
+        # wrapper slices off; avoid 0/0
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     size = x.shape[axis]
     pad = (-size) % multiple
@@ -76,8 +152,15 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     return jnp.pad(x, widths)
 
 
+# beyond this many K tokens the full per-head K/V panel stops fitting VMEM
+# comfortably (2 panels × 8k × 128 × 2B = 4 MB plus scores/accumulators) and
+# the k-streaming kernel takes over; below it the panel kernel measures
+# slightly faster (no carry rescale traffic)
+PANEL_MAX_KV = 8192
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "interpret"))
+                                             "block_k", "interpret"))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -86,45 +169,107 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     block_q: int = 128,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
+    q_offset=None,
+    kv_len=None,
 ) -> jax.Array:
-    """``[B, S, H, D]`` flash attention (kv heads must already match q heads).
+    """``[B, S, H, D]`` flash attention; K/V may carry fewer (GQA) heads.
 
-    ``interpret`` defaults to True off-TPU so CPU tests exercise the same
-    kernel code path the chip runs.
+    K up to ``PANEL_MAX_KV`` runs the panel kernel (whole K/V per head in
+    VMEM); longer sequences stream K/V blocks with an online-softmax carry
+    (``_attn_kernel_stream``) — long-context length is then bounded by HBM
+    only.  ``interpret`` defaults to True off-TPU so CPU tests exercise the
+    same kernel code path the chip runs.
+
+    ``q_offset``/``kv_len`` (ints or traced scalars) select the chunked-
+    prefill mode: q rows sit at global positions ``q_offset + i`` (causal is
+    judged against those) and only the first ``kv_len`` K tokens are valid —
+    K is typically the FULL cache while q is one chunk of it.  Blocks past
+    ``kv_len`` skip their MXU work (``pl.when``), but their K/V DMA into
+    VMEM still runs — the pipeline's copies are scheduled by static block
+    index, not the predicate — so early chunks of a long cache save compute
+    but still pay full-cache K/V bandwidth.  (Trimming the grid per chunk
+    would need one compiled program per chunk position; measured overhead
+    at 30k/8k-chunks is ~15-40% of prefill, an accepted trade.)  Forces the
+    streaming kernel.
+
+    GQA (``Hkv`` dividing ``H``) is native: the kernel grid walks q heads
+    while the K/V BlockSpec index maps ``bh → bh // (H/Hkv)``, so shared
+    K/V panels are DMA'd per kv-head without ever materialising the
+    repeated tensor (at 32k ctx the repeat would be ~0.5 GB per layer).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    if k.shape[2] != h:
-        raise ValueError("flash_attention expects pre-repeated kv heads")
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    g = h // hkv
     if scale is None:
         scale = d ** -0.5
+    dynamic = q_offset is not None or kv_len is not None
 
     bq = min(block_q, max(8, sq))
-    # fold heads into batch; [BH, S, D]
-    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
+    # fold heads into batch; [B*H(q) / B*Hkv(kv), S, D]
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(
+        b * t.shape[2], t.shape[1], d)
     qf, kf, vf = fold(q), fold(k), fold(v)
     qf = _pad_to(qf, 1, bq)
-    kf = _pad_to(kf, 1, 128)
-    vf = _pad_to(vf, 1, 128)
-    sq_pad, sk_pad = qf.shape[1], kf.shape[1]
+    sq_pad = qf.shape[1]
+    # grid index bh = bi*h + hi → its K/V panel row is bh // g
+    # = bi*hkv + hi//g, matching jnp.repeat(kv, g, axis=2) head expansion
 
-    grid = (b * h, sq_pad // bq)
-    out = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale, causal=causal,
-                          kv_len=sk, block_q=bq),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
-        interpret=interpret,
-    )(qf, kf, vf)
+    if sk <= PANEL_MAX_KV and not dynamic:
+        kf = _pad_to(kf, 1, 128)
+        vf = _pad_to(vf, 1, 128)
+        sk_pad = kf.shape[1]
+        grid = (b * h, sq_pad // bq)
+        out = pl.pallas_call(
+            functools.partial(_attn_kernel, scale=scale, causal=causal,
+                              kv_len=sk, block_q=bq),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+                pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh // g, 0, 0)),
+                pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh // g, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
+            interpret=interpret,
+        )(qf, kf, vf)
+    else:
+        bk = min(block_k, PANEL_MAX_KV)
+        kf = _pad_to(kf, 1, bk)
+        vf = _pad_to(vf, 1, bk)
+        sk_pad = kf.shape[1]
+        n_k = sk_pad // bk
+        off = jnp.asarray(0 if q_offset is None else q_offset,
+                          jnp.int32).reshape(1)
+        klen = jnp.asarray(sk if kv_len is None else kv_len,
+                           jnp.int32).reshape(1)
+        grid = (b * h, sq_pad // bq, n_k)  # k innermost: carry is per (bh, qi)
+        out = pl.pallas_call(
+            functools.partial(_attn_kernel_stream, scale=scale, causal=causal,
+                              block_q=bq, block_k=bk, n_k=n_k),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh // g, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh // g, j, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),   # running max m
+                pltpu.VMEM((bq, 128), jnp.float32),   # running denom l
+                pltpu.VMEM((bq, d), jnp.float32),     # unnormalised acc
+            ],
+            interpret=interpret,
+        )(qf, kf, vf, off, klen)
 
     out = out[:, :sq]                                  # drop q padding
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -215,20 +215,36 @@ class LLMServer:
                 continue
 
             def work(batch=batch):
+                def row_done(i, tokens, row_stats):
+                    # from the worker thread, the moment row i stops: a
+                    # 1-token request doesn't wait for a 128-token peer
+                    r = batch[i]
+                    loop.call_soon_threadsafe(
+                        lambda: r.future.done()
+                        or r.future.set_result((tokens, row_stats)))
+
                 return self.gen.generate_batch(
                     [r.ids for r in batch],
                     [r.n_predict for r in batch],
                     [r.sample for r in batch],
                     stop_tokens=(self.tok.eos_id,),
+                    on_row_done=row_done,
                     cancel_check=lambda: all(r.cancel.is_set() for r in batch))
 
             try:
                 outs, stats = await self._run_on_device(work)
-            except BaseException as e:  # noqa: BLE001 — fan the error out
+            except asyncio.CancelledError:
+                # server shutdown: fail the waiters, then let the
+                # cancellation propagate so this task actually exits
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(
-                            e if isinstance(e, Exception) else RuntimeError(str(e)))
+                            RuntimeError("server shutting down"))
+                raise
+            except Exception as e:  # fan the error out to every waiter
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
                 continue
             log.info("batched completion: %d slots, %d gen tok, %.1f tok/s",
                      stats["batch"], stats["generated_tokens"],
