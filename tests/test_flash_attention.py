@@ -118,6 +118,19 @@ def test_flash_streaming_long_causal_prefill_shape():
                                np.asarray(ref[0, -64:]), atol=3e-5)
 
 
+def test_flash_causal_cross_length_matches_xla_alignment():
+    """causal with sq != sk is bottom-right aligned in the XLA path (every
+    q row sees its full K prefix); the flash route must shift q positions by
+    the length difference, not top-align — else most of K is silently
+    masked out."""
+    q = _rand((1, 24, 2, 16), 30)
+    k = _rand((1, 96, 2, 16), 31)
+    v = _rand((1, 96, 2, 16), 32)
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+    out = dot_product_attention(q, k, v, causal=True, impl="flash")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_auto_dispatch_long_context_always_flash():
     """Beyond the 8k panel ceiling XLA would materialise [S,S] scores (OOM
     at 32k); the rule must pick flash regardless of batch*heads."""

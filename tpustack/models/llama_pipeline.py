@@ -1,0 +1,132 @@
+"""Pipeline-parallel Llama training forward (stacked layers + GPipe).
+
+Training-ladder extension (SURVEY.md §2.10: the reference has no tensor- or
+pipeline-level parallelism; its llama.cpp ``--n-gpu-layers`` split is a
+capacity workaround, reference ``cluster-config/apps/llm/deployment.yaml:
+69-83``).  Design:
+
+- Layer parameters are STACKED ``[L, ...]`` (one pytree, layer-major) and
+  sharded over the ``pp`` mesh axis; embedding / final norm / lm_head are
+  small, replicated, and run on every rank.
+- The transformer trunk runs through ``parallel.pipeline.pipeline_apply``
+  (shard_map + ppermute GPipe; reverse-mode AD gives the backward pipeline).
+- Per-stage layers run under ``lax.scan`` — one traced block serves every
+  layer, so trace/compile time is O(1) in depth instead of O(L).
+- Parameter names match ``LlamaModel`` exactly (``self_attn/q_proj`` …), so
+  ``stack_named_layers``/``unstack_layers`` round-trip a per-layer
+  checkpoint into the pipelined layout and back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tpustack.models.llama import (LlamaBlock, LlamaConfig, RMSNorm,
+                                   causal_lm_loss)
+from tpustack.parallel.pipeline import pipeline_apply, stack_stages
+
+
+def stack_named_layers(params: Dict[str, Any], n_layers: int) -> Dict[str, Any]:
+    """``{layers_0: …, layers_1: …}`` (LlamaModel) → ``{layers: [L, …]}``."""
+    layers = [params[f"layers_{i}"] for i in range(n_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    rest = {k: v for k, v in params.items() if not k.startswith("layers_")}
+    return {**rest, "layers": stacked}
+
+
+def unstack_layers(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`stack_named_layers` (for saving back to the
+    per-layer serving layout)."""
+    stacked = params["layers"]
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    out = {k: v for k, v in params.items() if k != "layers"}
+    for i in range(n):
+        out[f"layers_{i}"] = jax.tree.map(lambda t: t[i], stacked)
+    return out
+
+
+@dataclasses.dataclass
+class PipelinedLlamaLM:
+    """Functional container: ``init(key) → params``; ``loss(params, tokens)``.
+
+    ``mesh`` must carry a ``pp`` axis (≥2); ``dp``/``fsdp`` axes shard the
+    batch.  Tensor/sequence parallelism are deliberately 1 inside the
+    pipeline (shard_map is manual mode — see parallel/pipeline.py).
+    """
+
+    cfg: LlamaConfig
+    mesh: Mesh
+    microbatches: int = 4
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    def __post_init__(self):
+        c = self.cfg
+        if c.quant:
+            raise ValueError("pipelined training is bf16/f32 only")
+        pp = self.mesh.shape["pp"]
+        if c.n_layers % pp:
+            raise ValueError(f"{c.n_layers} layers not divisible by pp={pp}")
+        self._block = LlamaBlock(c, self.dtype)
+        self._embed = nn.Embed(c.vocab_size, c.dim, dtype=self.dtype,
+                               name="embed_tokens")
+        self._norm = RMSNorm(c.rms_eps, self.dtype, name="norm")
+        self._lm_head = nn.Dense(c.vocab_size, use_bias=False,
+                                 dtype=jnp.float32, name="lm_head")
+
+    # ------------------------------------------------------------------ init
+    def init(self, key: jax.Array, seq: int = 8) -> Dict[str, Any]:
+        c = self.cfg
+        k_emb, k_blk, k_norm, k_head = jax.random.split(key, 4)
+        dummy_ids = jnp.zeros((1, seq), jnp.int32)
+        dummy_x = jnp.zeros((1, seq, c.dim), self.dtype)
+        dummy_pos = jnp.zeros((1, seq), jnp.int32)
+        layer_keys = jax.random.split(k_blk, c.n_layers)
+        layers = jax.vmap(
+            lambda k: self._block.init(k, dummy_x, dummy_pos, None, 0,
+                                       None)["params"])(layer_keys)
+        params = {
+            "embed_tokens": self._embed.init(k_emb, dummy_ids)["params"],
+            "layers": layers,
+            "norm": self._norm.init(k_norm, dummy_x)["params"],
+        }
+        if not c.tie_embeddings:
+            params["lm_head"] = self._lm_head.init(
+                k_head, jnp.zeros((1, seq, c.dim), jnp.float32))["params"]
+        return params
+
+    # --------------------------------------------------------------- forward
+    def apply(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+        """``tokens [B, S] → logits [B, S, V]`` (training path, no cache)."""
+        c = self.cfg
+        pp = self.mesh.shape["pp"]
+        x = self._embed.apply({"params": params["embed_tokens"]}, tokens)
+
+        def one_layer(h, lp):
+            pos = jnp.broadcast_to(jnp.arange(h.shape[1]), h.shape[:2])
+            out, _ = self._block.apply({"params": lp}, h, pos, None, 0, None)
+            return out, None
+
+        body = jax.checkpoint(one_layer) if self.remat else one_layer
+
+        def stage_fn(stage_params, h):
+            h, _ = jax.lax.scan(body, h, stage_params)
+            return h
+
+        x = pipeline_apply(stage_fn, stack_stages(params["layers"], pp), x,
+                           self.mesh, microbatches=self.microbatches)
+        x = self._norm.apply({"params": params["norm"]}, x)
+        if c.tie_embeddings:
+            emb = params["embed_tokens"]["embedding"]
+            return x.astype(jnp.float32) @ emb.astype(jnp.float32).T
+        return self._lm_head.apply({"params": params["lm_head"]},
+                                   x.astype(jnp.float32))
+
+    def loss(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+        return causal_lm_loss(self.apply(params, tokens), tokens)

@@ -42,13 +42,15 @@ def auto_impl(b: int, sq: int, h: int, sk: int, has_mask: bool,
     plain XLA and >=4 overflows the 16 MB VMEM scoped stack with full K/V
     panels per head.  Dispatching to XLA above the bound is the answer.)
     """
+    from tpustack.ops.pallas.flash_attention import PANEL_MAX_KV
+
     per_chip_b = max(1, b // max(1, data_shards))
     bound = 128 if d >= 128 else 64
-    in_range = 1024 <= sq <= 8192 and 1024 <= sk <= 8192
-    # Beyond the 8k panel ceiling XLA would materialise [Sq, Sk] scores
+    in_range = 1024 <= sq <= PANEL_MAX_KV and 1024 <= sk <= PANEL_MAX_KV
+    # Beyond the panel ceiling XLA would materialise [Sq, Sk] scores
     # (tens of GB at 32k) — the k-streaming flash kernel is the only viable
     # path, whatever batch*heads is.
-    long_ctx = sk > 8192
+    long_ctx = sk > PANEL_MAX_KV
     return ("flash" if not has_mask and backend == "tpu"
             and (long_ctx or (in_range and per_chip_b * h <= bound))
             else "xla")
@@ -94,8 +96,15 @@ def dot_product_attention(
             raise NotImplementedError("flash impl supports causal=, not arbitrary mask=")
         from tpustack.ops.pallas.flash_attention import flash_attention
 
-        # GQA is native in the kernel (K/V BlockSpec maps bh // group)
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        # GQA is native in the kernel (K/V BlockSpec maps bh // group).
+        # causal with sq != sk is BOTTOM-RIGHT aligned in the XLA path
+        # (jnp.tril k=sk-sq: every q row sees its full K prefix); the kernel
+        # judges causality against global q positions, so shift them by the
+        # length difference to match (q_offset also routes to the streaming
+        # kernel, the only one that takes an offset).
+        q_off = k.shape[1] - sq if causal and k.shape[1] != sq else None
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               q_offset=q_off)
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")
 

@@ -39,6 +39,15 @@ LLAMA_RULES: Rules = (
     (r".*", PS()),
 )
 
+# Pipelined Llama (models.llama_pipeline): layer params are stacked [L, ...]
+# and cut over the pp axis (contiguous stage blocks); everything outside the
+# trunk (embed/norm/lm_head) is small and replicated — tp/sp are 1 inside a
+# pipeline stage (shard_map is manual mode, see parallel/pipeline.py).
+LLAMA_PP_RULES: Rules = (
+    (r"^layers/", PS("pp")),
+    (r".*", PS()),
+)
+
 # SD1.5 UNet/VAE/CLIP: conv-heavy; at serving batch sizes the win is DP over
 # images + replicated params (a 1GB bf16 UNet fits any chip), with TP on the
 # big transformer Dense layers when a mesh is used.

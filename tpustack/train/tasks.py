@@ -172,6 +172,57 @@ def _generic_lm_task(args, kind: str) -> None:
         init_batch = make_batch(np.random.RandomState(0))
         params = jax.jit(model.init)(jax.random.PRNGKey(0), init_batch["ids"],
                                      init_batch["mask"])["params"]
+    elif kind == "llama2" and args.pp > 1:
+        # pipeline-parallel variant: layers cut over a pp mesh axis (GPipe,
+        # parallel/pipeline.py); dp shards the batch; tp/sp stay 1 inside
+        # the pipeline (manual-mode shard_map)
+        from tpustack.models.llama import LlamaConfig
+        from tpustack.models.llama_pipeline import PipelinedLlamaLM
+        from tpustack.parallel.sharding import LLAMA_PP_RULES
+
+        cfg = LlamaConfig.tiny() if args.tiny else LlamaConfig.llama2_7b()
+        seq = args.seq or min(cfg.max_seq, 2048)
+        pp = args.pp
+        if args.tp > 1 or args.sp > 1 or args.fsdp > 1:
+            raise SystemExit("--pp composes with --dp only (tp/sp/fsdp are 1 "
+                             "inside a pipeline stage — shard_map is manual "
+                             "mode)")
+        if n_dev % pp:
+            raise SystemExit(f"--pp={pp} must divide the {n_dev} devices")
+        dp = args.dp or (n_dev // pp)
+        if dp * pp != n_dev:
+            raise SystemExit(f"--dp={dp} x --pp={pp} != {n_dev} devices")
+        mesh = build_mesh((dp, 1, 1, 1, pp),
+                          axis_names=("dp", "fsdp", "tp", "sp", "pp"))
+        rules = LLAMA_PP_RULES
+        # default microbatches: 2*pp (bubble fraction (pp-1)/(M+pp-1)),
+        # shrunk until each microbatch still divides over the dp shards; an
+        # EXPLICIT --microbatches is honoured or rejected, never adjusted
+        microbatches = args.microbatches or max(2, 2 * pp)
+        if not args.microbatches:
+            while (microbatches > 2
+                   and (args.batch % microbatches
+                        or (args.batch // microbatches) % dp)):
+                microbatches -= 1
+        if args.batch % microbatches or (args.batch // microbatches) % dp:
+            raise SystemExit(
+                f"--batch={args.batch} cannot be cut into {microbatches} "
+                f"microbatches of a multiple of dp={dp} rows")
+        pl = PipelinedLlamaLM(cfg, mesh, microbatches=microbatches,
+                              dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+                              remat=args.remat)
+        # per-layer remat inside the pipeline already bounds activations;
+        # also wrapping the whole loss would re-run the full GPipe forward
+        # (all ICI hops) a second time in backward
+        args.remat = False
+
+        def make_batch(rng):
+            return jnp.asarray(rng.randint(0, cfg.vocab_size, (args.batch, seq)))
+
+        def loss_fn(params, batch, rng):
+            return pl.loss(params, batch)
+
+        params = pl.init(jax.random.PRNGKey(0))
     else:  # llama2
         from tpustack.models.llama import LlamaConfig, LlamaModel, causal_lm_loss
 
@@ -242,6 +293,12 @@ def main(argv=None) -> int:
     p.add_argument("--sp", type=int, default=0,
                    help="sequence-parallel ways (llama2): >1 rings K/V over "
                         "the sp axis for long-context training")
+    p.add_argument("--pp", type=int, default=0,
+                   help="pipeline-parallel stages (llama2): layers cut over "
+                        "a pp mesh axis, GPipe microbatch schedule")
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="pipeline microbatches (default 2*pp; batch must "
+                        "divide)")
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--bf16", action="store_true", default=True)
