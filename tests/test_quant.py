@@ -121,6 +121,40 @@ def test_generator_end_to_end_int8():
     assert out_f == out
 
 
+def test_umt5_quantisation_close_to_float():
+    """The Wan text tower quantises with the same machinery: tiny UMT5
+    int8 output stays close to the float encoder's."""
+    from tpustack.models.wan.config import UMT5Config
+    from tpustack.models.wan.umt5 import UMT5Encoder
+
+    cfg = UMT5Config(vocab_size=512, dim=32, ffn_dim=64, num_heads=2,
+                     head_dim=16, num_layers=2, max_length=16)
+    enc = UMT5Encoder(cfg, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 512)
+    params = enc.init(jax.random.PRNGKey(1), ids)["params"]
+    ref = enc.apply({"params": params}, ids)
+
+    from tpustack.ops.quant import UMT5_QUANTIZABLE
+
+    qcfg = dataclasses.replace(cfg, quant="int8")
+    qenc = UMT5Encoder(qcfg, dtype=jnp.float32)
+    qparams = quantize_params(params, names=UMT5_QUANTIZABLE,
+                              embed_keys=frozenset({"embed"}))
+    # quantised tree must drop straight into the quantised module
+    tmpl = jax.eval_shape(
+        lambda: qenc.init(jax.random.PRNGKey(1), ids))["params"]
+    for (pa, la), (pb, lb) in zip(
+            jax.tree_util.tree_flatten_with_path(qparams)[0],
+            jax.tree_util.tree_flatten_with_path(tmpl)[0]):
+        assert pa == pb and la.shape == lb.shape and la.dtype == lb.dtype
+    got = qenc.apply({"params": qparams}, ids)
+
+    a = np.asarray(ref, np.float32).ravel()
+    b = np.asarray(got, np.float32).ravel()
+    cos = float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+    assert cos > 0.99, f"UMT5 int8 diverged: cosine {cos}"
+
+
 def test_qkv_bias_carried_through_quantisation():
     cfg = dataclasses.replace(LlamaConfig.tiny(max_seq=32), qkv_bias=True)
     model = LlamaModel(cfg, dtype=jnp.float32)

@@ -75,15 +75,17 @@ class UMT5SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask, bias):
+        from tpustack.ops.quant import make_dense
+
         c = self.cfg
         inner = c.num_heads * c.head_dim
-        dense = lambda name: nn.Dense(inner, use_bias=False, dtype=self.dtype,
-                                      name=name)
+        dense = lambda feats, name: make_dense(
+            c.quant, feats, use_bias=False, dtype=self.dtype, name=name)
         b, s, _ = x.shape
         shape = (b, s, c.num_heads, c.head_dim)
-        q = dense("q")(x).reshape(shape)
-        k = dense("k")(x).reshape(shape)
-        v = dense("v")(x).reshape(shape)
+        q = dense(inner, "q")(x).reshape(shape)
+        k = dense(inner, "k")(x).reshape(shape)
+        v = dense(inner, "v")(x).reshape(shape)
         # T5 does not scale by 1/sqrt(d); the rel-pos bias is added to logits.
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                             preferred_element_type=jnp.float32)
@@ -91,8 +93,7 @@ class UMT5SelfAttention(nn.Module):
         logits = jnp.where(mask[:, None, None, :], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, inner)
-        return nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype,
-                        name="o")(out)
+        return dense(x.shape[-1], "o")(out)
 
 
 class UMT5Block(nn.Module):
@@ -108,10 +109,14 @@ class UMT5Block(nn.Module):
         x = x + UMT5SelfAttention(c, dtype=self.dtype, name="attn")(h, mask, bias)
         h = T5LayerNorm(dtype=self.dtype, name="norm_ffn")(x)
         # gated-GELU FFN (wi_0 ⊙ gelu, wi_1 linear)
-        g = nn.Dense(c.ffn_dim, use_bias=False, dtype=self.dtype, name="wi_0")(h)
-        u = nn.Dense(c.ffn_dim, use_bias=False, dtype=self.dtype, name="wi_1")(h)
+        from tpustack.ops.quant import make_dense
+
+        dense = lambda feats, name: make_dense(
+            c.quant, feats, use_bias=False, dtype=self.dtype, name=name)
+        g = dense(c.ffn_dim, "wi_0")(h)
+        u = dense(c.ffn_dim, "wi_1")(h)
         h = nn.gelu(g, approximate=True) * u
-        return x + nn.Dense(c.dim, use_bias=False, dtype=self.dtype, name="wo")(h)
+        return x + dense(c.dim, "wo")(h)
 
 
 class UMT5Encoder(nn.Module):
@@ -125,7 +130,15 @@ class UMT5Encoder(nn.Module):
         c = self.cfg
         if mask is None:
             mask = jnp.ones_like(ids, dtype=bool)
-        x = nn.Embed(c.vocab_size, c.dim, dtype=self.dtype, name="embed")(ids)
+        if c.quant:
+            from tpustack.ops.quant import Int8Embed
+
+            embed = Int8Embed(c.vocab_size, c.dim, dtype=self.dtype,
+                              name="embed")
+        else:
+            embed = nn.Embed(c.vocab_size, c.dim, dtype=self.dtype,
+                             name="embed")
+        x = embed(ids)
         for i in range(c.num_layers):
             x = UMT5Block(c, dtype=self.dtype, name=f"block_{i}")(x, mask)
         x = T5LayerNorm(dtype=self.dtype, name="final_norm")(x)

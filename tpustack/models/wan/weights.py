@@ -148,11 +148,13 @@ def convert_state_dict(template: Tree, state: Dict[str, Any], key_fn) -> Tree:
             missing.append(key)
             continue
         w = transform(jnp.asarray(state[key]))
-        if tuple(w.shape) != tuple(np.shape(tmpl)):
+        # template leaves may be jax.eval_shape structs (zero-cost templates)
+        # — .shape/.dtype are common to those and concrete arrays
+        if tuple(w.shape) != tuple(tmpl.shape):
             bad.append(f"{key}: checkpoint {tuple(w.shape)} vs ours "
-                       f"{tuple(np.shape(tmpl))}")
+                       f"{tuple(tmpl.shape)}")
             continue
-        out[path] = w.astype(jnp.asarray(tmpl).dtype)
+        out[path] = w.astype(tmpl.dtype)
     if missing or bad:
         raise WanWeightsError(
             f"checkpoint mismatch — {len(missing)} missing keys "
@@ -174,8 +176,10 @@ def load_wan_safetensors(models_dir: str, config: WanConfig,
     from safetensors import safe_open
 
     def read(path):
+        # host-side (numpy) read: tensors reach HBM one at a time inside
+        # convert_state_dict, not as a whole second copy of the checkpoint
         state = {}
-        with safe_open(path, framework="flax") as f:
+        with safe_open(path, framework="np") as f:
             for k in f.keys():
                 state[k] = f.get_tensor(k)
         return state
@@ -187,12 +191,36 @@ def load_wan_safetensors(models_dir: str, config: WanConfig,
         if not os.path.exists(path):
             raise FileNotFoundError(f"{label} weights not found at {path}")
 
+    # UMT5 loads FIRST: quantising umt5-xxl transiently needs the bf16
+    # encoder (~11.4 GB) on the chip, which only fits while nothing else is
+    # resident; after the destructive quantise it shrinks to ~5.7 GB and the
+    # DiT/VAE load into the freed space
+    if config.text.quant:
+        import dataclasses as _dc
+
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from tpustack.models.wan.umt5 import UMT5Encoder
+        from tpustack.ops.quant import UMT5_QUANTIZABLE, quantize_params
+
+        bf16_enc = UMT5Encoder(_dc.replace(config.text, quant=None),
+                               dtype=config.compute_dtype)
+        bf16_tmpl = _jax.eval_shape(
+            lambda: bf16_enc.init(_jax.random.PRNGKey(0),
+                                  _jnp.zeros((1, 8), _jnp.int32)))["params"]
+        loaded = convert_state_dict(bf16_tmpl, read(clip_path), umt5_key)
+        params["text_encoder"] = quantize_params(
+            loaded, names=UMT5_QUANTIZABLE, embed_keys=frozenset({"embed"}))
+        log.info("Loaded + int8-quantised UMT5 weights from %s", clip_path)
+    else:
+        params["text_encoder"] = convert_state_dict(
+            template_params["text_encoder"], read(clip_path), umt5_key)
+        log.info("Loaded UMT5 weights from %s", clip_path)
+
     params["dit"] = convert_state_dict(template_params["dit"], read(unet_path),
                                        dit_key)
     log.info("Loaded Wan DiT weights from %s", unet_path)
-    params["text_encoder"] = convert_state_dict(
-        template_params["text_encoder"], read(clip_path), umt5_key)
-    log.info("Loaded UMT5 weights from %s", clip_path)
 
     vae_dir = os.path.join(models_dir, "vae")
     if os.path.isdir(vae_dir) and os.listdir(vae_dir):

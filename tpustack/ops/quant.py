@@ -31,12 +31,22 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-# Dense submodules of the LLM that carry ~all weight bytes; embed stays bf16
-# (gather, not matmul) and norms/biases are negligible.
+# Dense submodules that carry ~all weight bytes; norms/biases are negligible.
+# Llama/Qwen projections (the default set — callers pass their own for other
+# families; the Wan DiT/VAE also name modules "q"/"k"/"v"/"o", so the bare
+# T5 names must NOT live in the default or a whole-pipeline quantise call
+# would silently quantise attention projections never validated for int8).
 QUANTIZABLE = frozenset({
     "q_proj", "k_proj", "v_proj", "o_proj",
     "gate_proj", "up_proj", "down_proj", "lm_head",
 })
+
+# UMT5 encoder (Wan text tower): q/k/v/o attention + gated-GELU FFN
+UMT5_QUANTIZABLE = frozenset({"q", "k", "v", "o", "wi_0", "wi_1", "wo"})
+
+# default embedding-table dict key (quantised per ROW via quantize_rows);
+# UMT5 callers pass {"embed"}
+EMBED_KEYS = frozenset({"embed_tokens"})
 
 
 class Int8Embed(nn.Module):
@@ -137,7 +147,8 @@ def quantize_rows(table: jax.Array) -> Dict[str, jax.Array]:
 
 
 def quantize_params(params: Dict, names: frozenset = QUANTIZABLE,
-                    quantize_embed: bool = True) -> Dict:
+                    quantize_embed: bool = True,
+                    embed_keys: frozenset = EMBED_KEYS) -> Dict:
     """bf16 LLM param tree → int8 serving tree (module names in ``names``).
 
     The output matches what ``LlamaModel(cfg with quant='int8')`` initialises,
@@ -161,7 +172,7 @@ def quantize_params(params: Dict, names: frozenset = QUANTIZABLE,
                 del kern  # refcount → bf16 kernel freed before the next one
                 q.update(v)  # carry bias etc. through
                 out[k] = q
-            elif (isinstance(v, dict) and k == "embed_tokens"
+            elif (isinstance(v, dict) and k in embed_keys
                     and quantize_embed
                     and getattr(v.get("embedding"), "ndim", 0) == 2):
                 emb = v.pop("embedding")
