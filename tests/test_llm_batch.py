@@ -88,11 +88,12 @@ def test_batch_on_chunk_streaming_hook(gen):
     outs, _ = gen.generate_batch([[5, 6], [7, 8]], 7, [GREEDY] * 2, seed=0,
                                  chunk=3, on_chunk=lambda b: blocks.append(b))
     assert blocks and all(b.shape[0] == 2 for b in blocks)
-    # the hook sees every decoded step token for each row (rows may contain
+    assert blocks[0].shape == (2, 1)  # first call: the prefill-sampled tokens
+    # the hook sees EVERY token of each row, first included (rows may carry
     # post-stop garbage the host discarded; prefix must match)
     streamed = np.concatenate(blocks, axis=1)
     for i in range(2):
-        assert list(streamed[i][:len(outs[i]) - 1]) == outs[i][1:]
+        assert list(streamed[i][:len(outs[i])]) == outs[i]
 
 
 def test_batch_decodes_to_full_capacity_via_tail_steps():
@@ -168,6 +169,76 @@ def test_server_micro_batches_concurrent_completions(gen):
         if solo and solo[-1] == tok.eos_id:
             solo = solo[:-1]
         assert r["content"] == tok.decode(solo)
+
+
+def test_server_batched_streaming_coalesces(gen):
+    """Two concurrent SSE streams (greedy, unseeded) ride ONE batched decode
+    and each stream reproduces its solo content."""
+    import asyncio
+    import json as _json
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    tok = ByteTokenizer(512)
+    server = LLMServer(generator=gen, tokenizer=tok, model_name="tiny-test",
+                       max_batch=4, batch_window_ms=200)
+    calls = {"batch": 0, "solo": 0}
+    real_batch, real_solo = gen.generate_batch, gen.generate
+
+    def spy_batch(*a, **kw):
+        calls["batch"] += 1
+        return real_batch(*a, **kw)
+
+    def spy_solo(*a, **kw):
+        calls["solo"] += 1
+        return real_solo(*a, **kw)
+
+    gen.generate_batch, gen.generate = spy_batch, spy_solo
+    prompts = ["stream one", "stream two!"]
+
+    async def read_stream(client, prompt):
+        r = await client.post("/completion", json={
+            "prompt": prompt, "n_predict": 6, "temperature": 0,
+            "stream": True})
+        assert r.status == 200
+        text, final = "", None
+        async for line in r.content:
+            line = line.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = _json.loads(line[6:])
+            if payload.get("stop"):
+                final = payload
+            else:
+                text += payload.get("content", "")
+        return text, final
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            return await asyncio.gather(
+                *(read_stream(client, p) for p in prompts))
+        finally:
+            await client.close()
+
+    try:
+        results = asyncio.new_event_loop().run_until_complete(scenario())
+    finally:
+        gen.generate_batch, gen.generate = real_batch, real_solo
+
+    assert calls["batch"] == 1 and calls["solo"] == 0, calls
+    for p, (text, final) in zip(prompts, results):
+        solo, _ = gen.generate_fused(
+            tok.encode(p), max_new_tokens=6, sample=SampleConfig(greedy=True),
+            seed=0, stop_tokens=(tok.eos_id,))
+        if solo and solo[-1] == tok.eos_id:
+            solo = solo[:-1]
+        assert text == tok.decode(solo), (p, text)
+        assert final is not None and final["tokens_predicted"] <= 6
 
 
 def test_server_seeded_sampling_stays_solo(gen):

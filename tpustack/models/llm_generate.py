@@ -301,7 +301,9 @@ class Generator:
         SampleConfig per row (mixed temperatures/top_k/greedy batch fine).
         ``on_chunk(step_toks)``: called with the ``[B, <=chunk]`` numpy block
         after each fused dispatch — the batched streaming hook (chunk
-        granularity).  ``on_row_done(i, tokens, row_stats)``: called the
+        granularity).  The first call is the ``[B, 1]`` prefill-sampled
+        tokens, so a consumer sees every token of every row; rows may carry
+        post-stop garbage the host discarded (track stops consumer-side).  ``on_row_done(i, tokens, row_stats)``: called the
         moment row ``i`` stops (EOS / its own budget) — a short request in a
         batch is answered immediately instead of waiting for the slowest
         peer (every row is notified exactly once; stragglers at return).
@@ -372,10 +374,12 @@ class Generator:
                 "tokens_per_s": len(out[i]) / dt if dt > 0 else 0.0,
             })
 
+        tok = first[:, None].astype(np.int32)
+        if on_chunk is not None:  # before notify: tokens precede sentinels
+            on_chunk(tok.copy())
         for i in range(b):
             if done[i]:
                 notify(i)
-        tok = first[:, None].astype(np.int32)
         step = 0  # decode steps already scanned past the first token
         bucket_arr = jnp.asarray(bucket, jnp.int32)
         while not all(done) and step < max(max_new) - 1:
@@ -407,6 +411,8 @@ class Generator:
                     tok = np.asarray(nxt)[:, None].astype(np.int32)
                     cols.append(tok[:, 0])
                 block = np.stack(cols, axis=1)  # [B, tail]
+            if on_chunk is not None:  # before notify: tokens precede sentinels
+                on_chunk(block)
             for i in range(b):
                 if done[i]:
                     continue
@@ -416,8 +422,6 @@ class Generator:
                         done[i] = True
                         notify(i)
                         break
-            if on_chunk is not None:
-                on_chunk(block)
             tok = block[:, -1:].astype(np.int32)
             step += block.shape[1]
         for i in range(b):  # stragglers: budget/cancel exits without done[i]

@@ -88,16 +88,22 @@ def _build_generator():
 
 
 class _PendingCompletion:
-    """One non-streaming request parked in the micro-batch queue."""
+    """One request parked in the micro-batch queue.
 
-    __slots__ = ("ids", "n_predict", "sample", "future", "cancel")
+    ``stream_put``: optional callable — set for streaming requests; the
+    batch loop feeds it each of the row's tokens as chunks complete (and
+    ``None`` once the row is done), chunk-granular SSE."""
 
-    def __init__(self, ids, n_predict, sample, future):
+    __slots__ = ("ids", "n_predict", "sample", "future", "cancel",
+                 "stream_put")
+
+    def __init__(self, ids, n_predict, sample, future, stream_put=None):
         self.ids = ids
         self.n_predict = n_predict
         self.sample = sample
         self.future = future
         self.cancel = threading.Event()
+        self.stream_put = stream_put
 
 
 class LLMServer:
@@ -180,14 +186,17 @@ class LLMServer:
             return False
         return self.gen._bucket(len(ids)) <= self.gen.cfg.max_seq // 2
 
-    async def _enqueue_completion(self, ids, n_predict, sample):
-        loop = asyncio.get_running_loop()
+    async def _enqueue_raw(self, req: _PendingCompletion) -> None:
         if self._pending is None:
             self._pending = asyncio.Queue()
         if self._batch_task is None or self._batch_task.done():
             self._batch_task = asyncio.create_task(self._batch_loop())
-        req = _PendingCompletion(ids, n_predict, sample, loop.create_future())
         await self._pending.put(req)
+
+    async def _enqueue_completion(self, ids, n_predict, sample):
+        loop = asyncio.get_running_loop()
+        req = _PendingCompletion(ids, n_predict, sample, loop.create_future())
+        await self._enqueue_raw(req)
         try:
             return await req.future
         except asyncio.CancelledError:
@@ -215,6 +224,34 @@ class LLMServer:
                 continue
 
             def work(batch=batch):
+                eos = self.tok.eos_id
+                # mirror the engine's per-row budget (n_predict clamped to
+                # the shared capacity) so streamed emission stops exactly
+                # where the engine's own bookkeeping does
+                bucket = self.gen._bucket(max(len(r.ids) for r in batch))
+                capacity = self.gen.cfg.max_seq - bucket
+                budget = [min(r.n_predict, capacity) for r in batch]
+                emitted = [0] * len(batch)
+                # budget<=0 rows emit nothing (the engine returns [] for
+                # them — n_predict=0 must not stream a spurious token)
+                stream_done = [r.stream_put is None or budget[i] <= 0
+                               for i, r in enumerate(batch)]
+
+                def on_chunk(block):
+                    # worker thread → event loop; tokens flow to streaming
+                    # rows as each fused dispatch lands (chunk granularity)
+                    for i, r in enumerate(batch):
+                        if stream_done[i]:
+                            continue
+                        for t in block[i]:
+                            t = int(t)
+                            emitted[i] += 1
+                            if t != eos:
+                                r.stream_put(t)
+                            if t == eos or emitted[i] >= budget[i]:
+                                stream_done[i] = True
+                                break
+
                 def row_done(i, tokens, row_stats):
                     # from the worker thread, the moment row i stops: a
                     # 1-token request doesn't wait for a 128-token peer
@@ -222,29 +259,35 @@ class LLMServer:
                     loop.call_soon_threadsafe(
                         lambda: r.future.done()
                         or r.future.set_result((tokens, row_stats)))
+                    if r.stream_put is not None:
+                        r.stream_put(None)  # end-of-stream sentinel
 
                 return self.gen.generate_batch(
                     [r.ids for r in batch],
                     [r.n_predict for r in batch],
                     [r.sample for r in batch],
                     stop_tokens=(self.tok.eos_id,),
+                    on_chunk=on_chunk if any(
+                        r.stream_put is not None for r in batch) else None,
                     on_row_done=row_done,
                     cancel_check=lambda: all(r.cancel.is_set() for r in batch))
+
+            def fail(exc):
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(exc)
+                    if r.stream_put is not None:
+                        r.stream_put(None)  # unblock SSE handlers (q.get)
 
             try:
                 outs, stats = await self._run_on_device(work)
             except asyncio.CancelledError:
                 # server shutdown: fail the waiters, then let the
                 # cancellation propagate so this task actually exits
-                for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(
-                            RuntimeError("server shutting down"))
+                fail(RuntimeError("server shutting down"))
                 raise
             except Exception as e:  # fan the error out to every waiter
-                for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+                fail(e)
                 continue
             log.info("batched completion: %d slots, %d gen tok, %.1f tok/s",
                      stats["batch"], stats["generated_tokens"],
@@ -364,25 +407,40 @@ class LLMServer:
 
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
-        cancel = threading.Event()
 
-        def on_token(t):
-            loop.call_soon_threadsafe(q.put_nowait, t)
-            if cancel.is_set():
-                raise _Cancelled()  # aborts generate inside the worker thread
+        batched = self._batchable(ids, temperature, seed)
+        if batched:
+            # concurrent streams coalesce into ONE batched decode; tokens
+            # arrive per fused chunk (coarser cadence than the solo path's
+            # per-token hook, but N streams share each weight pass)
+            req = _PendingCompletion(
+                ids, n_predict,
+                SampleConfig(temperature=temperature, top_k=top_k,
+                             greedy=temperature <= 0),
+                loop.create_future(),
+                stream_put=lambda t: loop.call_soon_threadsafe(q.put_nowait, t))
+            cancel = req.cancel
+        else:
+            cancel = threading.Event()
 
-        def worker():
-            try:
-                if cancel.is_set():  # client died while we were queued:
-                    raise _Cancelled()  # skip the whole prefill
-                return self.gen.generate(
-                    ids, max_new_tokens=n_predict,
-                    sample=SampleConfig(temperature=temperature, top_k=top_k,
-                                        greedy=temperature <= 0),
-                    seed=seed, stop_tokens=(self.tok.eos_id,),
-                    on_token=on_token)
-            finally:
-                loop.call_soon_threadsafe(q.put_nowait, None)  # end-of-stream
+            def on_token(t):
+                loop.call_soon_threadsafe(q.put_nowait, t)
+                if cancel.is_set():
+                    raise _Cancelled()  # aborts generate in the worker thread
+
+            def worker():
+                try:
+                    if cancel.is_set():  # client died while we were queued:
+                        raise _Cancelled()  # skip the whole prefill
+                    return self.gen.generate(
+                        ids, max_new_tokens=n_predict,
+                        sample=SampleConfig(temperature=temperature,
+                                            top_k=top_k,
+                                            greedy=temperature <= 0),
+                        seed=seed, stop_tokens=(self.tok.eos_id,),
+                        on_token=on_token)
+                finally:
+                    loop.call_soon_threadsafe(q.put_nowait, None)  # EOS
 
         chat_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
         created = int(time.time())
@@ -424,8 +482,19 @@ class LLMServer:
 
         t0 = time.time()
 
-        locked_task = asyncio.ensure_future(self._run_on_device(worker, cancel))
-        locked_task.add_done_callback(lambda t: t.cancelled() or t.exception())
+        if batched:
+            await self._enqueue_raw(req)
+            locked_task = req.future
+            # mirror the solo task's guard: if the handler dies before
+            # awaiting (client disconnect) a later batch failure must not
+            # log "exception was never retrieved"
+            locked_task.add_done_callback(
+                lambda f: f.cancelled() or f.exception())
+        else:
+            locked_task = asyncio.ensure_future(
+                self._run_on_device(worker, cancel))
+            locked_task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
         try:
             if fmt == "openai":
                 await send(chat_chunk({"role": "assistant", "content": ""}))
