@@ -70,6 +70,36 @@ def test_tpu_resource_requests_present():
     assert tpu_requests >= 6, f"expected >=6 TPU workloads, found {tpu_requests}"
 
 
+def test_device_plugin_schedules_on_any_chip_count():
+    """The installer labels nodes with the *actual* chip count
+    (install-k8s-tpu.yaml), so the plugin must match label existence —
+    an exact-value selector would never schedule on the 1-chip dev box."""
+    ds = _load_all(CLUSTER / "apps" / "tpu-stack" /
+                   "device-plugin-daemonset.yaml")[0]
+    spec = ds["spec"]["template"]["spec"]
+    assert "tpu.tpustack.dev/chips" not in spec.get("nodeSelector", {}), \
+        "exact-value chips nodeSelector excludes non-8-chip nodes"
+    terms = (spec["affinity"]["nodeAffinity"]
+             ["requiredDuringSchedulingIgnoredDuringExecution"]
+             ["nodeSelectorTerms"])
+    exprs = [e for t in terms for e in t["matchExpressions"]]
+    assert any(e["key"] == "tpu.tpustack.dev/chips" and
+               e["operator"] == "Exists" for e in exprs)
+
+    # simulate scheduling against both node shapes
+    for labels in ({"tpu.tpustack.dev/chips": "1"},
+                   {"tpu.tpustack.dev/chips": "8"}):
+        ok = any(all(
+            (e["operator"] == "Exists" and e["key"] in labels) or
+            (e["operator"] == "In" and labels.get(e["key"]) in e["values"])
+            for e in t["matchExpressions"]) for t in terms)
+        assert ok, f"device plugin would not schedule on node {labels}"
+
+    image = spec["containers"][0]["image"]
+    assert ":latest" not in image and ":" in image.split("/")[-1], \
+        f"device-plugin image must be version-pinned, got {image}"
+
+
 def test_flux_fanout_dependencies():
     """Workload apps must depend on tpu-stack, like the reference's llm
     depended on nvidia (apps-kustomization.yaml:50-53)."""
@@ -156,11 +186,13 @@ def test_renovate_markers_match_config_regex():
     import re
 
     conf = json.loads((REPO / "renovate.json").read_text())
-    mgr = conf["customManagers"][0]
-    patterns = [re.compile(p) for p in mgr["managerFilePatterns"]]
-    # renovate matchStrings are ECMAScript regexes: (?<name>…) → (?P<name>…)
-    regexes = [re.compile(re.sub(r"\(\?<([A-Za-z]+)>", r"(?P<\1>", s))
-               for s in mgr["matchStrings"]]
+    managers = []
+    for mgr in conf["customManagers"]:
+        patterns = [re.compile(p) for p in mgr["managerFilePatterns"]]
+        # renovate matchStrings are ECMAScript regexes: (?<name>…) → (?P<name>…)
+        regexes = [re.compile(re.sub(r"\(\?<([A-Za-z]+)>", r"(?P<\1>", s))
+                   for s in mgr["matchStrings"]]
+        managers.append((patterns, regexes))
 
     marked = []
     for p in all_yaml_files():
@@ -168,13 +200,21 @@ def test_renovate_markers_match_config_regex():
         if "# renovate:" not in text:
             continue
         rel = str(p.relative_to(REPO))
-        assert any(pat.search(rel) for pat in patterns), (
-            f"{rel} has renovate markers but is not in managerFilePatterns")
-        hits = [m for rx in regexes for m in rx.finditer(text)]
+        applicable = [rx for pats, rxs in managers
+                      if any(pat.search(rel) for pat in pats) for rx in rxs]
+        assert applicable, (
+            f"{rel} has renovate markers but matches no manager's file patterns")
+        hits = [m for rx in applicable for m in rx.finditer(text)]
         assert len(hits) == text.count("# renovate:"), (
-            f"{rel}: marker(s) present that the matchStrings regex misses")
+            f"{rel}: marker(s) present that the matchStrings regexes miss "
+            f"(or double-match): {len(hits)} hits vs "
+            f"{text.count('# renovate:')} markers")
         marked.extend(m.group("depName") for m in hits)
-    assert {"kubernetes/kubernetes", "kubernetes-sigs/jobset", "libtpu"} <= set(marked)
+    assert {"kubernetes/kubernetes", "kubernetes-sigs/jobset", "libtpu",
+            "gcr.io/gke-release/tpu-device-plugin"} <= set(marked)
+    # digest pinning is on for container images, so the tag pin above gets a
+    # digest lock on renovate's first online run
+    assert any(r.get("pinDigests") for r in conf.get("packageRules", []))
 
 
 def test_ansible_playbook_shapes():
