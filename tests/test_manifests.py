@@ -70,6 +70,32 @@ def test_tpu_resource_requests_present():
     assert tpu_requests >= 6, f"expected >=6 TPU workloads, found {tpu_requests}"
 
 
+def test_flux_toolkit_is_complete():
+    """`kubectl apply -k cluster-config/cluster/flux-system/` must install a
+    RECONCILING cluster: the vendored gotk-components.yaml (upstream
+    `flux install --export` output, like the reference vendors) has to carry
+    the four controllers and their CRDs, not just the namespace."""
+    docs = _load_all(CLUSTER / "cluster" / "flux-system" /
+                     "gotk-components.yaml")
+    kinds = {}
+    for d in docs:
+        kinds.setdefault(d["kind"], []).append(d["metadata"]["name"])
+    deployments = set(kinds.get("Deployment", []))
+    assert {"source-controller", "kustomize-controller", "helm-controller",
+            "notification-controller"} <= deployments, deployments
+    crds = set(kinds.get("CustomResourceDefinition", []))
+    for crd in ("gitrepositories.source.toolkit.fluxcd.io",
+                "kustomizations.kustomize.toolkit.fluxcd.io",
+                "helmreleases.helm.toolkit.fluxcd.io",
+                "helmrepositories.source.toolkit.fluxcd.io"):
+        assert crd in crds, f"missing CRD {crd}"
+    assert "Namespace" in kinds
+    # the kustomization actually includes it
+    kust = _load_all(CLUSTER / "cluster" / "flux-system" /
+                     "kustomization.yaml")[0]
+    assert "gotk-components.yaml" in kust["resources"]
+
+
 def test_device_plugin_schedules_on_any_chip_count():
     """The installer labels nodes with the *actual* chip count
     (install-k8s-tpu.yaml), so the plugin must match label existence —
@@ -186,13 +212,40 @@ def test_renovate_markers_match_config_regex():
     import re
 
     conf = json.loads((REPO / "renovate.json").read_text())
+
+    def compile_file_pattern(p):
+        """Renovate ≥40 managerFilePatterns: `/…/` wrapping marks a regex
+        (optionally `!`-negated); bare strings are minimatch globs, which
+        this repo avoids — enforce the unambiguous regex form."""
+        negate = p.startswith("!")
+        body = p[1:] if negate else p
+        assert body.startswith("/") and body.endswith("/"), (
+            f"renovate pattern {p!r} must be slash-wrapped regex form")
+        return negate, re.compile(body[1:-1])
+
+    def file_matches(rel, pats):
+        compiled = [compile_file_pattern(p) for p in pats]
+        pos = [rx for neg, rx in compiled if not neg]
+        negs = [rx for neg, rx in compiled if neg]
+        return (any(rx.search(rel) for rx in pos)
+                and not any(rx.search(rel) for rx in negs))
+
     managers = []
     for mgr in conf["customManagers"]:
-        patterns = [re.compile(p) for p in mgr["managerFilePatterns"]]
         # renovate matchStrings are ECMAScript regexes: (?<name>…) → (?P<name>…)
         regexes = [re.compile(re.sub(r"\(\?<([A-Za-z]+)>", r"(?P<\1>", s))
                    for s in mgr["matchStrings"]]
-        managers.append((patterns, regexes))
+        managers.append((mgr["managerFilePatterns"], regexes))
+    # kubernetes-manager patterns must be well-formed too, and must exclude
+    # the files a custom manager owns plus the vendored flux toolkit
+    k8s_pats = conf["kubernetes"]["managerFilePatterns"]
+    for p in k8s_pats:
+        compile_file_pattern(p)
+    assert not file_matches(
+        "cluster-config/apps/tpu-stack/device-plugin-daemonset.yaml", k8s_pats)
+    assert not file_matches(
+        "cluster-config/cluster/flux-system/gotk-components.yaml", k8s_pats)
+    assert file_matches("cluster-config/apps/llm/deployment.yaml", k8s_pats)
 
     marked = []
     for p in all_yaml_files():
@@ -201,7 +254,7 @@ def test_renovate_markers_match_config_regex():
             continue
         rel = str(p.relative_to(REPO))
         applicable = [rx for pats, rxs in managers
-                      if any(pat.search(rel) for pat in pats) for rx in rxs]
+                      if file_matches(rel, pats) for rx in rxs]
         assert applicable, (
             f"{rel} has renovate markers but matches no manager's file patterns")
         hits = [m for rx in applicable for m in rx.finditer(text)]
