@@ -207,6 +207,56 @@ def test_flash_rejects_mask():
                               impl="flash")
 
 
+def test_flash_rejects_causal_sq_gt_sk_but_auto_falls_back(monkeypatch):
+    """Causal q longer than k has no bottom-right alignment: the flash route
+    must reject it explicitly, and impl='auto' must route it to XLA instead
+    of raising after selecting flash (ADVICE r1; review r2)."""
+    import jax as _jax
+
+    q = _rand((1, 2048, 1, 8), 20)
+    k = _rand((1, 1024, 1, 8), 21)
+    with pytest.raises(ValueError, match="sq"):
+        dot_product_attention(q, k, k, causal=True, impl="flash")
+    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    from tpustack.ops.attention import auto_impl
+    assert auto_impl(1, 2048, 1, 1024, False, "tpu", 1, 8) == "flash"
+    out = dot_product_attention(q, k, k, causal=True, impl="auto")  # no raise
+    assert out.shape == q.shape
+
+
+def test_attention_rejects_ambiguous_3d_mask():
+    """[B, Sq, Sk] vs [H, Sq, Sk] is undecidable — require 2D or 4D."""
+    q = _rand((2, 16, 4, 8), 22)
+    with pytest.raises(ValueError, match="ambiguous"):
+        dot_product_attention(q, q, q, mask=jnp.ones((2, 16, 16), bool))
+    # 2D and 4D still fine
+    dot_product_attention(q, q, q, mask=jnp.ones((16, 16), bool))
+    dot_product_attention(q, q, q, mask=jnp.ones((2, 4, 16, 16), bool))
+
+
+def test_panel_max_kv_participates_in_dispatch_per_call(monkeypatch):
+    """Monkeypatching PANEL_MAX_KV must affect the NEXT call even for an
+    already-compiled shape (the ceiling is resolved outside the jit
+    boundary and joins the cache key — ADVICE r1)."""
+    import tpustack.ops.pallas.flash_attention as fa
+
+    q = _rand((1, 256, 1, 8), 23)
+    out_panel = fa.flash_attention(q, q, q)          # panel kernel (256 ≤ 8192)
+    called = []
+    orig = fa._attn_kernel_stream
+
+    def spy(*a, **kw):
+        called.append(True)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fa, "_attn_kernel_stream", spy)
+    monkeypatch.setattr(fa, "PANEL_MAX_KV", 128)
+    out_stream = fa.flash_attention(q, q, q)         # must re-dispatch: stream
+    assert called, "PANEL_MAX_KV change did not reach an already-jitted shape"
+    np.testing.assert_allclose(np.asarray(out_panel), np.asarray(out_stream),
+                               atol=2e-5)
+
+
 def test_auto_dispatch_rule():
     """Pins the empirical auto-dispatch rule (measured on v5e, see
     tpustack/ops/attention.py): flash only on TPU, for 1k-8k sequences,

@@ -73,7 +73,8 @@ def dot_product_attention(
       q: ``[B, Sq, H, D]``.
       k/v: ``[B, Sk, Hkv, D]`` — ``Hkv`` may divide ``H`` (GQA/MQA); kv heads
         are repeated to match.
-      mask: optional boolean mask broadcastable to ``[B, H, Sq, Sk]``; True
+      mask: optional boolean mask, ``[Sq, Sk]`` or ``[B|1, H|Hkv|1, Sq, Sk]``
+        (3D is rejected as ambiguous between batch and head axes); True
         means *attend*.
       causal: apply a causal mask (decoder LMs).
       scale: defaults to ``1/sqrt(D)``.
@@ -86,10 +87,22 @@ def dot_product_attention(
     hkv = k.shape[2]
     if h % hkv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        # 3D masks are ambiguous ([B, Sq, Sk] vs [H, Sq, Sk]): broadcasting
+        # against [B, H, Sq, Sk] would silently align the leading axis with
+        # heads, so require the caller to disambiguate
+        if mask.ndim not in (2, 4):
+            raise ValueError(
+                f"mask must be [Sq, Sk] or [B|1, H|Hkv|1, Sq, Sk]; a "
+                f"{mask.ndim}D mask (shape {mask.shape}) is ambiguous — "
+                "add explicit batch/head axes")
 
     if impl == "auto":
         impl = auto_impl(b, sq, h, k.shape[1], mask is not None,
                          jax.default_backend(), data_shards, d)
+        if impl == "flash" and causal and sq > k.shape[1]:
+            impl = "xla"  # flash rejects this shape (below); auto must not
 
     if impl == "flash":
         if mask is not None:
@@ -102,6 +115,14 @@ def dot_product_attention(
         # judges causality against global q positions, so shift them by the
         # length difference to match (q_offset also routes to the streaming
         # kernel, the only one that takes an offset).
+        if causal and sq > k.shape[1]:
+            # bottom-right alignment has no meaning here (negative offset
+            # would leave some q rows with zero valid keys, and the online
+            # softmax would average garbage over K padding); the XLA path
+            # keeps its degenerate-but-deterministic semantics instead
+            raise ValueError(
+                f"flash impl: causal with sq ({sq}) > sk ({k.shape[1]}) is "
+                "not supported; use impl='xla'")
         q_off = k.shape[1] - sq if causal and k.shape[1] != sq else None
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                q_offset=q_off)
@@ -136,14 +157,18 @@ def dot_product_attention(
                         preferred_element_type=jnp.float32)
     logits = logits * jnp.asarray(scale, logits.dtype)
     if mask is not None:
-        mask = jnp.asarray(mask)
-        if mask.ndim > 2 and mask.shape[-3] not in (1, hkv):
+        # mask.ndim is 2 or 4 (validated above), so the head axis is exact
+        if mask.ndim == 4 and mask.shape[-3] == h:
             # mask carries a full H heads axis → split it into (Hkv, G)
             mask = jnp.broadcast_to(mask, (b, h, sq, sk)).reshape(
                 b, hkv, g, sq, sk)
-        else:
+        elif mask.ndim == 4:
+            if mask.shape[-3] not in (1, hkv):
+                raise ValueError(
+                    f"mask head axis {mask.shape[-3]} matches neither "
+                    f"H={h} nor Hkv={hkv} (nor 1)")
             # headless / per-kv-head masks broadcast over the group axis
-            mask = mask[..., None, :, :] if mask.ndim > 2 else mask
+            mask = mask[..., None, :, :]
         logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)

@@ -159,8 +159,6 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 PANEL_MAX_KV = 8192
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -173,6 +171,7 @@ def flash_attention(
     interpret: Optional[bool] = None,
     q_offset=None,
     kv_len=None,
+    panel_max_kv: Optional[int] = None,
 ) -> jax.Array:
     """``[B, S, H, D]`` flash attention; K/V may carry fewer (GQA) heads.
 
@@ -199,8 +198,25 @@ def flash_attention(
     K/V panels are DMA'd per kv-head without ever materialising the
     repeated tensor (at 32k ctx the repeat would be ~0.5 GB per layer).
     """
+    # Resolve the trace-time choices OUTSIDE the jit boundary so they join
+    # the jit cache key: the module global PANEL_MAX_KV is read here at every
+    # call, not baked into a previously compiled signature (tests monkeypatch
+    # it to force the streaming kernel at small shapes).
+    if panel_max_kv is None:
+        panel_max_kv = PANEL_MAX_KV
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    return _flash_attention(q, k, v, causal=causal, scale=scale,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret, q_offset=q_offset,
+                            kv_len=kv_len, panel_max_kv=panel_max_kv)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret",
+                                             "panel_max_kv"))
+def _flash_attention(q, k, v, *, causal, scale, block_q, block_k, interpret,
+                     q_offset, kv_len, panel_max_kv):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     hkv = k.shape[2]
@@ -221,7 +237,7 @@ def flash_attention(
     # grid index bh = bi*h + hi → its K/V panel row is bh // g
     # = bi*hkv + hi//g, matching jnp.repeat(kv, g, axis=2) head expansion
 
-    if sk <= PANEL_MAX_KV and not dynamic:
+    if sk <= panel_max_kv and not dynamic:
         kf = _pad_to(kf, 1, 128)
         vf = _pad_to(vf, 1, 128)
         sk_pad = kf.shape[1]
@@ -240,7 +256,7 @@ def flash_attention(
             interpret=interpret,
         )(qf, kf, vf)
     else:
-        bk = min(block_k, PANEL_MAX_KV)
+        bk = min(block_k, panel_max_kv)
         kf = _pad_to(kf, 1, bk)
         vf = _pad_to(vf, 1, bk)
         sk_pad = kf.shape[1]

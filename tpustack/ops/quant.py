@@ -73,8 +73,13 @@ class Int8Embed(nn.Module):
                            (self.num_embeddings, self.features), jnp.int8)
         scale = self.param("scale", nn.initializers.ones,
                            (self.num_embeddings,), jnp.float32)
-        rows = jnp.take(table, ids, axis=0).astype(self.dtype)
-        return rows * jnp.take(scale, ids, axis=0)[..., None].astype(self.dtype)
+        # int8→f32, scale at full f32 precision, THEN cast to the compute
+        # dtype — casting the scale to bf16 first would throw away half its
+        # mantissa for no memory or compute saving (same single-rounding
+        # policy as Int8Dense's f32-accumulate + f32-scale epilogue)
+        rows = jnp.take(table, ids, axis=0).astype(jnp.float32)
+        out = rows * jnp.take(scale, ids, axis=0)[..., None]
+        return out.astype(self.dtype)
 
 
 class Int8Dense(nn.Module):
@@ -97,16 +102,17 @@ class Int8Dense(nn.Module):
         scale = self.param("scale", nn.initializers.ones,
                            (self.features,), jnp.float32)
         out_dtype = self.out_dtype or self.dtype
-        # preferred_element_type so the f32-out case (lm_head) accumulates in
-        # f32 on the MXU instead of rounding through bf16 before the scale
+        # Accumulate in f32 on the MXU, apply the f32 scale (and bias) at
+        # full precision, and round ONCE at the output cast — the epilogue
+        # fuses into the matmul, so the f32 intermediate never hits HBM.
         y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype),
-                    preferred_element_type=out_dtype)
-        y = y * scale.astype(out_dtype)
+                    preferred_element_type=jnp.float32)
+        y = y * scale
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros,
                               (self.features,), jnp.float32)
-            y = y + bias.astype(out_dtype)
-        return y
+            y = y + bias
+        return y.astype(out_dtype)
 
 
 def make_dense(quant: Optional[str], features: int, *, use_bias: bool,
