@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Train a CLIP-format byte-level BPE vocabulary from a text corpus.
+
+Produces ``vocab.json`` + ``merges.txt`` loadable BOTH by
+``tpustack.models.clip_bpe.ClipBPE`` and by ``transformers.CLIPTokenizer``
+(same file contract as OpenAI's released CLIP vocab): vocab rows are the 256
+byte symbols, their 256 ``</w>`` word-final forms, the merge products in
+merge order, then ``<|startoftext|>`` / ``<|endoftext|>``.
+
+The vendored vocab at ``tpustack/models/sd15/vocab/`` was built with:
+
+    python tools/train_bpe.py --out tpustack/models/sd15/vocab \
+        --merges 6000 --corpus <english text files>
+
+(zero-egress environment: the corpus is English documentation text available
+in the build image; the REAL OpenAI vocab drops in via the same two files
+whenever a checkpoint's tokenizer is mounted — see SD15_TOKENIZER_DIR).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpustack.models.clip_bpe import (_CLIP_PAT, BOS_TOKEN, EOS_TOKEN,
+                                      byte_alphabet, normalize)
+
+
+def word_frequencies(texts) -> collections.Counter:
+    counts: collections.Counter = collections.Counter()
+    enc, _ = byte_alphabet()
+    for text in texts:
+        for tok in _CLIP_PAT.findall(normalize(text)):
+            counts[("".join(enc[b] for b in tok.encode("utf-8")))] += 1
+    return counts
+
+
+def train(word_freq: collections.Counter, n_merges: int, log=print):
+    """Greedy BPE: repeatedly merge the most frequent adjacent symbol pair.
+
+    Incremental bookkeeping (pair counts + pair→word index) keeps each merge
+    proportional to the words it touches, not the whole corpus.
+    """
+    words = []   # [symbols list, freq]
+    for w, f in word_freq.items():
+        words.append([list(w[:-1]) + [w[-1] + "</w>"], f])
+
+    pair_counts: collections.Counter = collections.Counter()
+    pair_words: dict = collections.defaultdict(set)
+    for idx, (syms, f) in enumerate(words):
+        for a, b in zip(syms, syms[1:]):
+            pair_counts[(a, b)] += f
+            pair_words[(a, b)].add(idx)
+
+    merges = []
+    for step in range(n_merges):
+        if not pair_counts:
+            break
+        best, best_count = pair_counts.most_common(1)[0]
+        if best_count < 2:  # merging hapaxes just memorises the corpus
+            break
+        merges.append(best)
+        new_sym = best[0] + best[1]
+        for idx in list(pair_words[best]):
+            syms, f = words[idx]
+            # remove this word's old pair contributions
+            for a, b in zip(syms, syms[1:]):
+                pair_counts[(a, b)] -= f
+                if pair_counts[(a, b)] <= 0:
+                    del pair_counts[(a, b)]
+                pair_words[(a, b)].discard(idx)
+            # apply the merge left-to-right
+            merged, i = [], 0
+            while i < len(syms):
+                if i < len(syms) - 1 and (syms[i], syms[i + 1]) == best:
+                    merged.append(new_sym)
+                    i += 2
+                else:
+                    merged.append(syms[i])
+                    i += 1
+            words[idx][0] = merged
+            for a, b in zip(merged, merged[1:]):
+                pair_counts[(a, b)] += f
+                pair_words[(a, b)].add(idx)
+        if (step + 1) % 500 == 0:
+            log(f"[train_bpe] merge {step + 1}/{n_merges} "
+                f"({best[0]!r}+{best[1]!r} x{best_count})")
+    return merges
+
+
+def build_vocab(merges) -> dict:
+    enc, _ = byte_alphabet()
+    tokens = [enc[b] for b in range(256)]
+    tokens += [t + "</w>" for t in tokens]
+    tokens += [a + b for a, b in merges]
+    tokens += [BOS_TOKEN, EOS_TOKEN]
+    return {t: i for i, t in enumerate(tokens)}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--corpus", nargs="+", required=True,
+                   help="text files to train on")
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--merges", type=int, default=6000)
+    p.add_argument("--max-bytes", type=int, default=8 << 20,
+                   help="cap total corpus bytes (keeps training minutes-fast)")
+    args = p.parse_args()
+
+    texts, total = [], 0
+    for path in args.corpus:
+        try:
+            data = open(path, "rb").read()
+        except OSError:
+            continue
+        total += len(data)
+        texts.append(data.decode("utf-8", errors="ignore"))
+        if total >= args.max_bytes:
+            break
+    print(f"[train_bpe] corpus: {len(texts)} files, {total / 1e6:.1f} MB")
+
+    freqs = word_frequencies(texts)
+    print(f"[train_bpe] {sum(freqs.values())} words, {len(freqs)} unique")
+    merges = train(freqs, args.merges)
+    vocab = build_vocab(merges)
+    print(f"[train_bpe] {len(merges)} merges → vocab of {len(vocab)}")
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "vocab.json"), "w", encoding="utf-8") as f:
+        json.dump(vocab, f, ensure_ascii=False)
+    with open(os.path.join(args.out, "merges.txt"), "w", encoding="utf-8") as f:
+        f.write("#version: 0.2 (tpustack train_bpe)\n")
+        f.writelines(f"{a} {b}\n" for a, b in merges)
+    # minimal sidecars so transformers.CLIPTokenizer.from_pretrained() works
+    with open(os.path.join(args.out, "tokenizer_config.json"), "w") as f:
+        json.dump({"tokenizer_class": "CLIPTokenizer",
+                   "bos_token": BOS_TOKEN, "eos_token": EOS_TOKEN,
+                   "unk_token": EOS_TOKEN, "pad_token": EOS_TOKEN,
+                   "model_max_length": 77}, f, indent=1)
+    with open(os.path.join(args.out, "special_tokens_map.json"), "w") as f:
+        json.dump({"bos_token": BOS_TOKEN, "eos_token": EOS_TOKEN,
+                   "unk_token": EOS_TOKEN, "pad_token": EOS_TOKEN}, f, indent=1)
+    print(f"[train_bpe] wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,38 +51,51 @@ class HashTokenizer:
         return out
 
 
-class CLIPTokenizerWrapper:
-    """Real CLIP BPE via transformers, same call contract as HashTokenizer."""
+VENDORED_VOCAB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "vocab")
 
-    def __init__(self, tokenizer, max_length: int):
-        self._tok = tokenizer
+
+class ClipBPEWrapper:
+    """``tpustack.models.clip_bpe.ClipBPE`` with the pipeline call contract."""
+
+    def __init__(self, bpe, max_length: int):
+        self._bpe = bpe
         self.max_length = max_length
+        self.vocab_size = bpe.vocab_size
 
     def __call__(self, prompts: Sequence[str]) -> np.ndarray:
-        enc = self._tok(
-            list(prompts),
-            padding="max_length",
-            truncation=True,
-            max_length=self.max_length,
-            return_tensors="np",
-        )
-        return enc["input_ids"].astype(np.int32)
+        return self._bpe(list(prompts), max_length=self.max_length)
 
 
 def load_tokenizer(vocab_size: int, max_length: int):
-    """Prefer real CLIP tokenizer files; fall back to the hash tokenizer."""
-    tok_dir = os.environ.get("SD15_TOKENIZER_DIR", "")
-    if tok_dir and os.path.isdir(tok_dir):
-        try:
-            from transformers import CLIPTokenizer
+    """Real CLIP-format BPE by default; the hash tokenizer only survives as
+    a last-resort fallback.
 
-            tok = CLIPTokenizer.from_pretrained(tok_dir)
-            log.info("Loaded CLIP tokenizer from %s", tok_dir)
-            return CLIPTokenizerWrapper(tok, max_length)
+    Priority: ``SD15_TOKENIZER_DIR`` (a real checkpoint's vocab — with the
+    OpenAI CLIP files mounted, ids are byte-identical to the reference's
+    diffusers pipeline; verified against transformers.CLIPTokenizer in
+    ``tests/test_clip_bpe.py``) → the vendored in-repo vocab (same format,
+    trained offline by ``tools/train_bpe.py``) → hash.
+    """
+    for which, tok_dir in (("SD15_TOKENIZER_DIR",
+                            os.environ.get("SD15_TOKENIZER_DIR", "")),
+                           ("vendored", VENDORED_VOCAB_DIR)):
+        if not (tok_dir and os.path.isdir(tok_dir)):
+            continue
+        try:
+            from tpustack.models.clip_bpe import ClipBPE
+
+            bpe = ClipBPE.load(tok_dir)
+            if bpe.vocab_size > vocab_size:
+                raise ValueError(
+                    f"vocab {bpe.vocab_size} exceeds text-tower embedding "
+                    f"table {vocab_size}")
+            log.info("Loaded CLIP BPE tokenizer (%s: %s, vocab %d)",
+                     which, tok_dir, bpe.vocab_size)
+            return ClipBPEWrapper(bpe, max_length)
         except Exception as e:  # corrupt/partial files → keep serving
-            log.warning("CLIP tokenizer load failed (%s); using hash tokenizer", e)
+            log.warning("CLIP BPE load from %s failed (%s)", tok_dir, e)
     log.warning(
-        "No CLIP tokenizer files (SD15_TOKENIZER_DIR unset/missing); using "
-        "deterministic hash tokenizer — fine for perf/demo, not for real prompts"
-    )
+        "No usable CLIP vocab files; using deterministic hash tokenizer — "
+        "fine for perf/demo, not for real prompts")
     return HashTokenizer(vocab_size, max_length)

@@ -59,6 +59,26 @@ class HFTokenizer:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
 
+class BpeTextTokenizer:
+    """Real subword BPE on the vendored CLIP-format vocab (the offline
+    default): proper merges, ~4 chars/token on English instead of the byte
+    fallback's 1 — prefill/decode lengths now resemble real-tokenizer runs.
+    Keeps the llama-style encode/decode contract of this module."""
+
+    def __init__(self, bpe):
+        self._bpe = bpe
+        self.vocab_size = bpe.vocab_size
+        self.bos_id = bpe.bos_id
+        self.eos_id = bpe.eos_id
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._bpe.encode(text)
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._bpe.decode(list(ids))
+
+
 def load_text_tokenizer(vocab_size: int):
     tok_dir = os.environ.get("LLM_TOKENIZER_DIR", "")
     if tok_dir and os.path.isdir(tok_dir):
@@ -70,5 +90,19 @@ def load_text_tokenizer(vocab_size: int):
             return HFTokenizer(tok)
         except Exception as e:
             log.warning("HF tokenizer load failed (%s); using byte tokenizer", e)
-    log.warning("Using byte-level tokenizer (LLM_TOKENIZER_DIR unset/missing)")
+    try:
+        from tpustack.models.clip_bpe import ClipBPE
+        from tpustack.models.sd15.tokenizer import VENDORED_VOCAB_DIR
+
+        bpe = ClipBPE.load(VENDORED_VOCAB_DIR)
+        if bpe.vocab_size <= vocab_size:
+            log.info("Using vendored BPE tokenizer (vocab %d; set "
+                     "LLM_TOKENIZER_DIR for a checkpoint's own vocab)",
+                     bpe.vocab_size)
+            return BpeTextTokenizer(bpe)
+        log.warning("Vendored BPE vocab %d exceeds model vocab %d",
+                    bpe.vocab_size, vocab_size)
+    except Exception as e:
+        log.warning("Vendored BPE load failed (%s)", e)
+    log.warning("Using byte-level tokenizer (last-resort fallback)")
     return ByteTokenizer(vocab_size)
