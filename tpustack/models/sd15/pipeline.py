@@ -200,19 +200,27 @@ class SD15Pipeline:
             # batch position; see docstring for cross-batch-shape caveat)
             seeds = [seed + i for i in range(batch_size)]
         keys = _host_key_data(seeds)  # [B, 2] uint32, no device dispatch
-        params = self.params
-        n_data = 1
+        gen_args = self._prep_generate_args(cond, uncond, keys, steps, width,
+                                            height, guidance_scale, mesh)
+        img = np.asarray(self._generate(*gen_args))
+        return img, time.time() - t0
+
+    def _prep_generate_args(self, cond, uncond, keys, steps, width, height,
+                            guidance_scale, mesh):
+        """The exact ``_generate`` argument tuple — single source for both
+        the dispatch path (``generate``) and the AOT path
+        (``compiled_generate``), so they can never drift apart."""
+        c = self.config
+        params, n_data = self.params, 1
         if mesh is not None:
             from tpustack.parallel import data_parallel_size
 
             n_data = data_parallel_size(mesh) or 1
             params, cond, uncond, keys = self._shard_for_mesh(
                 mesh, cond, uncond, keys, n_data)
-        img = self._generate(params, cond, uncond, keys, int(steps),
-                             height // c.vae_scale, width // c.vae_scale,
-                             jnp.float32(guidance_scale), n_data)
-        img = np.asarray(img)
-        return img, time.time() - t0
+        return (params, cond, uncond, keys, int(steps),
+                height // c.vae_scale, width // c.vae_scale,
+                jnp.float32(guidance_scale), n_data)
 
     def _shard_for_mesh(self, mesh, cond, uncond, keys, n_data: int):
         """Replicate params on ``mesh`` (cached) and shard the batch inputs
@@ -242,3 +250,21 @@ class SD15Pipeline:
         t0 = time.time()
         self.generate("warmup", seed=0, **kw)
         return time.time() - t0
+
+    def compiled_generate(self, *, steps: int = 30, width: int = 512,
+                          height: int = 512, guidance_scale: float = 7.5,
+                          batch_size: int = 1, mesh=None):
+        """AOT handle to the same fused program ``generate`` dispatches:
+        lower + compile (served from the jit/persistent cache when already
+        built) and return the ``jax.stages.Compiled`` — for
+        ``cost_analysis()`` (bench MFU), ``memory_analysis()``, or HLO dumps.
+        """
+        c = self.config
+        cond = np.zeros((batch_size, c.text.max_length), np.int32)
+        uncond = np.zeros_like(cond)
+        keys = np.zeros((batch_size, 2), np.uint32)
+        gen_args = self._prep_generate_args(cond, uncond, keys, steps, width,
+                                            height, guidance_scale, mesh)
+        # .lower on the descriptor-bound jit does NOT prepend self — go
+        # through the class attribute with self explicit (it's static arg 0)
+        return type(self)._generate.lower(self, *gen_args).compile()
