@@ -9,6 +9,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # module fixture compiles a full (tiny) pipeline+server
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PNG_MAGIC = b"\x89PNG\r\n\x1a\n"
 
@@ -123,14 +125,16 @@ def test_micro_batching_coalesces_requests(server, mesh8):
     batched = SDServer(pipeline=server.pipe, mesh=mesh8,
                        batch_window_ms=500, max_batch=4)
     calls = []
-    real_generate = batched.pipe.generate
-
-    def counting_generate(*a, **kw):
-        calls.append(kw.get("seed"))
-        return real_generate(*a, **kw)
-
     batched.pipe = type(server.pipe)(server.pipe.config, params=server.pipe.params)
-    batched.pipe.generate = counting_generate
+    real_generate_async = batched.pipe.generate_async
+
+    def counting_generate_async(*a, **kw):
+        # the micro-batcher dispatches via generate_async (transfer overlaps
+        # the next batch's compute) — spy there
+        calls.append(kw.get("seed"))
+        return real_generate_async(*a, **kw)
+
+    batched.pipe.generate_async = counting_generate_async
 
     async def scenario():
         client = TestClient(TestServer(batched.build_app()))

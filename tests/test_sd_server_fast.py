@@ -1,0 +1,154 @@
+"""Fast-tier coverage of the micro-batcher's pipelined dispatch/fetch path.
+
+``tests/test_sd_server.py`` drives the REAL compiled pipeline (slow tier);
+this file swaps in a stub pipeline so the server's async machinery —
+coalescing, lock scoping, in-flight tracking, generate_async/np.asarray
+split, error propagation — runs in milliseconds on every default
+``pytest tests/ -x -q``.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+
+PNG_MAGIC = b"\x89PNG\r\n\x1a\n"
+
+
+class _StubDeviceArray:
+    """Mimics a JAX device array mid-flight: np.asarray blocks until the
+    'compute' deadline, like blocking on an async-dispatched result."""
+
+    def __init__(self, value: np.ndarray, ready_at: float):
+        self._value = value
+        self._ready_at = ready_at
+
+    def __array__(self, dtype=None, copy=None):
+        time.sleep(max(0.0, self._ready_at - time.time()))
+        return self._value
+
+    def block_until_ready(self):
+        time.sleep(max(0.0, self._ready_at - time.time()))
+        return self
+
+
+class _StubPipeline:
+    """generate_async contract of SD15Pipeline, no JAX involved."""
+
+    def __init__(self, compute_s: float = 0.05):
+        self.compute_s = compute_s
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def generate_async(self, prompt, *, steps=30, guidance_scale=7.5,
+                       seed=None, width=512, height=512, negative_prompt="",
+                       batch_size=1, mesh=None):
+        prompts = [prompt] * batch_size if isinstance(prompt, str) else list(prompt)
+        seeds = seed if isinstance(seed, (list, tuple)) else [seed] * len(prompts)
+        with self.lock:
+            self.calls.append(list(seeds))
+        imgs = np.stack([
+            np.full((height, width, 3), (0 if s is None else s) % 256, np.uint8)
+            for s in seeds])
+        return _StubDeviceArray(imgs, time.time() + self.compute_s)
+
+    def generate(self, prompt, **kw):
+        t0 = time.time()
+        return np.asarray(self.generate_async(prompt, **kw)), time.time() - t0
+
+
+def _make_server(**kw):
+    from tpustack.serving.sd_server import SDServer
+
+    return SDServer(pipeline=_StubPipeline(), mesh=None, **kw)
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_concurrent_same_signature_coalesce_into_one_dispatch():
+    server = _make_server(batch_window_ms=100, max_batch=4)
+
+    async def scenario():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            body = {"prompt": "stub", "steps": 2, "width": 64, "height": 64}
+            rs = await asyncio.gather(*[
+                client.post("/generate", json=dict(body, seed=s))
+                for s in (7, 8, 9)])
+            assert all(r.status == 200 for r in rs)
+            pngs = [await r.read() for r in rs]
+            assert all(p[:8] == PNG_MAGIC for p in pngs)
+        finally:
+            await client.close()
+
+    _run(scenario())
+    assert len(server.pipe.calls) == 1, server.pipe.calls
+    assert sorted(server.pipe.calls[0][:3]) == [7, 8, 9]
+
+
+def test_batches_pipeline_dispatch_outside_transfer():
+    """Two different-signature groups: the second dispatch must begin while
+    the first batch is still 'computing' (in-flight list non-empty at
+    dispatch time) — the overlap that bought +32% throughput."""
+    server = _make_server(batch_window_ms=1, max_batch=2)
+    server.pipe.compute_s = 0.3
+    inflight_at_dispatch = []
+    orig = server.pipe.generate_async
+
+    def spy(*a, **kw):
+        inflight_at_dispatch.append(len(server._inflight))
+        return orig(*a, **kw)
+
+    server.pipe.generate_async = spy
+
+    async def scenario():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r1 = asyncio.ensure_future(client.post("/generate", json={
+                "prompt": "a", "steps": 2, "width": 64, "height": 64}))
+            await asyncio.sleep(0.1)  # r1 dispatched, still in flight
+            r2 = await client.post("/generate", json={
+                "prompt": "b", "steps": 3, "width": 64, "height": 64})
+            assert (await r1).status == 200 and r2.status == 200
+        finally:
+            await client.close()
+
+    _run(scenario())
+    assert inflight_at_dispatch == [0, 1], inflight_at_dispatch
+    assert server._inflight == []  # all fetched and removed
+
+
+def test_pipeline_error_propagates_to_every_request():
+    server = _make_server(batch_window_ms=50, max_batch=4)
+
+    def boom(*a, **kw):
+        raise RuntimeError("device on fire")
+
+    server.pipe.generate_async = boom
+
+    async def scenario():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            rs = await asyncio.gather(*[
+                client.post("/generate", json={
+                    "prompt": "x", "steps": 2, "width": 64, "height": 64,
+                    "seed": s})
+                for s in (1, 2)])
+            assert [r.status for r in rs] == [500, 500]
+        finally:
+            await client.close()
+
+    _run(scenario())
+    assert server._inflight == []
