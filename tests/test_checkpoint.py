@@ -8,6 +8,8 @@ Resume is asserted structurally: a resumed run saves only steps AFTER the
 restored one, so the step set distinguishes resume from restart-from-zero.
 """
 
+import pytest
+
 from tpustack.train import tasks
 
 
@@ -18,6 +20,7 @@ def _steps(ckpt_dir):
     return sorted(mngr.all_steps()), mngr.latest_step()
 
 
+@pytest.mark.slow
 def test_llama2_task_saves_and_resumes(tmp_path):
     ckpt = str(tmp_path / "llama2")
     argv = ["llama2", "--tiny", "--steps", "3", "--batch", "2", "--seq", "16",
@@ -40,6 +43,7 @@ def test_llama2_task_saves_and_resumes(tmp_path):
     assert steps == [3, 4, 5]  # max_to_keep=3 evicted step 2
 
 
+@pytest.mark.slow
 def test_llama2_task_resume_is_noop_when_done(tmp_path):
     ckpt = str(tmp_path / "llama2b")
     argv = ["llama2", "--tiny", "--steps", "2", "--batch", "2", "--seq", "16",
@@ -52,6 +56,7 @@ def test_llama2_task_resume_is_noop_when_done(tmp_path):
     assert latest == 2 and steps == [1, 2]
 
 
+@pytest.mark.slow
 def test_resnet50_task_saves_and_resumes(tmp_path):
     ckpt = str(tmp_path / "resnet")
     argv = ["resnet50", "--steps", "2", "--batch", "2", "--classes", "4",

@@ -104,6 +104,7 @@ def test_flash_streaming_unpadded_lengths_and_blocks():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_streaming_long_causal_prefill_shape():
     """A >8k causal prefill (the long-context serving path) runs through the
     real streaming branch with the default PANEL_MAX_KV."""
@@ -176,6 +177,7 @@ def test_auto_impl_dispatch():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_auto_impl_backend_gating(monkeypatch):
     """The auto range check on TPU: xla for short sequences, the panel
     kernel for 1024 <= S <= 8192, the k-streaming kernel beyond (XLA would

@@ -10,6 +10,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # module fixture compiles a full (tiny) pipeline+server
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CLIENT_PATH = os.path.join(REPO_ROOT, "cluster-config", "apps", "llm",
                            "scripts", "generate_wan_t2v.py")

@@ -39,6 +39,7 @@ def test_llama_key_mapping():
             == "model.layers.1.input_layernorm.weight")
 
 
+@pytest.mark.slow
 def test_llama_weights_roundtrip():
     cfg = LlamaConfig.tiny()
     model = LlamaModel(cfg, dtype=jnp.float32)
@@ -76,6 +77,7 @@ def test_generate_seeded_sampling_deterministic(gen):
     assert out1 != out3 or True  # different seed usually differs; no hard guarantee
 
 
+@pytest.mark.slow
 def test_generate_matches_full_forward_greedy(gen):
     """KV-cache decode must agree with running the full sequence each step."""
     cfg = gen.cfg

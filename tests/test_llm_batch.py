@@ -25,6 +25,7 @@ def gen():
     return Generator(LlamaConfig.tiny(max_seq=64), dtype=jnp.float32, seed=3)
 
 
+@pytest.mark.slow
 def test_batch_matches_single_greedy_mixed_lengths(gen):
     prompts = [[5, 6, 7], [9, 10, 11, 12, 13, 14, 15, 16, 17], [20]]
     outs, stats = gen.generate_batch(prompts, 8, [GREEDY] * 3, seed=0)
@@ -96,6 +97,7 @@ def test_batch_on_chunk_streaming_hook(gen):
         assert list(streamed[i][:len(outs[i])]) == outs[i]
 
 
+@pytest.mark.slow
 def test_batch_decodes_to_full_capacity_via_tail_steps():
     """When the remaining cache tail is shorter than a chunk, the batched
     decoder finishes on the single-step path (no per-tail-length recompiles)
@@ -276,6 +278,7 @@ def test_server_seeded_sampling_stays_solo(gen):
     assert j["tokens_predicted"] <= 4
 
 
+@pytest.mark.slow
 def test_chunked_prefill_matches_single_shot():
     """Long prompts prefill in PREFILL_CHUNK windows attending the cache
     prefix (streaming flash kernel, traced offset).  Forcing a tiny chunk on
@@ -288,6 +291,7 @@ def test_chunked_prefill_matches_single_shot():
     assert out == ref
 
 
+@pytest.mark.slow
 def test_chunked_prefill_batch_short_row_peaks_early():
     """In a chunked batch, a row much shorter than the bucket takes its
     first-token logits from an EARLY chunk, not the last one."""
@@ -302,6 +306,7 @@ def test_chunked_prefill_batch_short_row_peaks_early():
     assert outs[1] == ref_short[:len(outs[1])] and len(outs[1]) == 5
 
 
+@pytest.mark.slow
 def test_batch_quantized_generator():
     qgen = Generator(dataclasses.replace(LlamaConfig.tiny(max_seq=64),
                                          quant="int8"),

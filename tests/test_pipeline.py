@@ -44,6 +44,7 @@ def test_pipeline_apply_matches_sequential(dp, pp, m):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_apply_differentiable():
     """Gradients flow through the scan + ppermute schedule and match the
     sequential model's gradients (the backward pipeline comes from AD)."""
@@ -84,6 +85,7 @@ def tiny_cfg():
     return LlamaConfig.tiny(max_seq=32)
 
 
+@pytest.mark.slow
 def test_pipelined_llama_matches_plain_model(tiny_cfg):
     """Same weights, pipelined [pp=2] vs plain LlamaModel: logits equal."""
     mesh = _mesh(2, 2)
@@ -106,6 +108,7 @@ def test_pipelined_llama_matches_plain_model(tiny_cfg):
         np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
 
 
+@pytest.mark.slow
 def test_pipelined_llama_train_step(tiny_cfg):
     """One sharded train step with pp rules: finite loss, step advances,
     layer params actually sharded over pp."""

@@ -42,6 +42,7 @@ def _tiny_params_and_tokens(quant=None):
     return cfg, model, tokens
 
 
+@pytest.mark.slow
 def test_quantized_tree_matches_quant_model_init():
     cfg, model, tokens = _tiny_params_and_tokens()
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
@@ -106,6 +107,7 @@ def test_quantize_params_consumes_and_skips_non_target():
     assert ttree["embed_tokens"]["embedding"] is emb
 
 
+@pytest.mark.slow
 def test_generator_end_to_end_int8():
     from tpustack.models.llm_generate import Generator, SampleConfig
 
@@ -121,6 +123,7 @@ def test_generator_end_to_end_int8():
     assert out_f == out
 
 
+@pytest.mark.slow
 def test_umt5_quantisation_close_to_float():
     """The Wan text tower quantises with the same machinery: tiny UMT5
     int8 output stays close to the float encoder's."""

@@ -31,6 +31,7 @@ def test_clip_shapes(tiny):
     assert out.shape == (2, tiny.text.max_length, tiny.text.hidden_size)
 
 
+@pytest.mark.slow
 def test_unet_shapes(tiny):
     m = UNet2DCondition(tiny.unet)
     x = jnp.zeros((1, 8, 8, 4))
@@ -42,6 +43,7 @@ def test_unet_shapes(tiny):
     assert out.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_vae_roundtrip_shapes(tiny):
     dec = VAEDecoder(tiny.vae)
     enc = VAEEncoder(tiny.vae)
@@ -107,6 +109,7 @@ def test_host_key_data_matches_prngkey():
                                           err_msg=f"x64 seed {seed}")
 
 
+@pytest.mark.slow
 def test_pipeline_generate_dp_mesh(pipe, mesh8):
     """DP generate over the 8-device mesh matches the unsharded program."""
     kw = dict(steps=2, seed=7, width=64, height=64, batch_size=8)
@@ -133,3 +136,27 @@ def test_pipeline_generate_tiny(pipe):
     # different seed → different image
     img3, _ = pipe.generate("a tiny test", steps=2, seed=43, width=64, height=64)
     assert (img != img3).any()
+    # generate_async is the same program, fetched later (the serving/bench
+    # pipelining path): identical bytes
+    dev = pipe.generate_async("a tiny test", steps=2, seed=42, width=64,
+                              height=64)
+    np.testing.assert_array_equal(np.asarray(dev), img)
+
+
+@pytest.mark.slow
+def test_compiled_generate_aot_handle(pipe):
+    """The AOT handle compiles the exact generate program and reports
+    per-component analyses (pipeline_flops counts the fori_loop body per
+    step, unlike raw cost_analysis on the fused program)."""
+    compiled = pipe.compiled_generate(steps=2, width=64, height=64,
+                                      batch_size=1)
+    assert compiled.memory_analysis() is not None
+    flops = pipe.pipeline_flops(steps=2, width=64, height=64, batch_size=1)
+    assert flops > 0
+    # more steps must cost strictly more, by exactly 2 extra UNet evals
+    # (the raw fused-program count would be step-invariant); on the tiny
+    # config the fixed text+VAE share dominates, so only assert linearity
+    f4 = pipe.pipeline_flops(steps=4, width=64, height=64, batch_size=1)
+    f6 = pipe.pipeline_flops(steps=6, width=64, height=64, batch_size=1)
+    assert f4 > flops
+    np.testing.assert_allclose(f6 - f4, f4 - flops, rtol=1e-6)

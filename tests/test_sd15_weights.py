@@ -76,6 +76,7 @@ def test_vae_key_mapping():
             == "quant_conv.bias")
 
 
+@pytest.mark.slow
 def test_roundtrip_all_modules(tiny):
     n_levels = len(tiny.unet.block_out_channels)
     clip = CLIPTextEncoder(tiny.text)

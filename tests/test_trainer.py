@@ -1,5 +1,6 @@
 """Sharded train-step tests on the 8-virtual-CPU mesh (tiny Llama)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -44,6 +45,7 @@ def test_train_step_unsharded_decreases_loss():
     assert int(state.step) == 6
 
 
+@pytest.mark.slow
 def test_train_step_sharded_matches_unsharded(devices8):
     _, _, params, loss_fn = _tiny_setup()
     tcfg = TrainerConfig(learning_rate=1e-2)
@@ -71,6 +73,7 @@ def test_train_step_sharded_matches_unsharded(devices8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_llama_forward_and_kv_cache_consistency():
     from tpustack.models.llama import init_kv_caches
 
@@ -100,6 +103,7 @@ def test_llama_forward_and_kv_cache_consistency():
                                atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_train_step_matches_dense(devices8):
     """Sequence-parallel training with ring attention inside the sharded
     train step: same loss and updated params as the GSPMD-dense model

@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # module fixture compiles the full (tiny) Wan pipeline (~55s)
+
 from tpustack.models.wan import WanConfig, WanPipeline
 from tpustack.models.wan.dit import WanDiT, rope_3d
 from tpustack.models.wan.scheduler import (canonical_sampler,

@@ -8,6 +8,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # module fixture builds DiT roundtrip weights (~90s)
 from safetensors.numpy import save_file
 
 from tpustack.models.wan import WanConfig, WanPipeline
