@@ -1,0 +1,261 @@
+"""Real-weight end-to-end proofs (VERDICT r1 #5): for each model family,
+TRAIN a tiny model (real gradient steps), EXPORT it through the same
+HF/checkpoint-format safetensors writer a production snapshot would use,
+RE-LOAD it through the serving path's reader, SERVE it over HTTP, and assert
+content-level equality between the served output and a reference computed
+directly from the trained weights.
+
+This closes the loop the reference demonstrated with real images
+(docs/panda-motorbike.png): checkpoint bytes → server → correct pixels or
+tokens, with no random-weight shortcut anywhere on the serving side.
+"""
+
+import asyncio
+import io
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+pytestmark = pytest.mark.slow  # each test compiles a full (tiny) pipeline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _adam_steps(loss_fn, params, steps):
+    """Real Adam steps; asserts the loss moved down and stayed finite."""
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    return params
+
+
+# ---------------------------------------------------------------------- SD15
+def test_sd15_train_export_serve_parity(tmp_path, monkeypatch):
+    from aiohttp.test_utils import TestClient, TestServer
+    from PIL import Image
+
+    from tpustack.models.sd15 import SD15Config, SD15Pipeline
+    from tpustack.models.sd15.weights import save_sd15_safetensors
+
+    cfg = SD15Config.tiny()
+    pipe = SD15Pipeline(cfg, seed=0)
+
+    # train the UNet on a toy denoising objective — real gradients, so the
+    # exported checkpoint is provably not the random init
+    x = jax.random.normal(jax.random.PRNGKey(42), (2, 8, 8, cfg.unet.in_channels))
+    t = jnp.array([3, 7], jnp.int32)
+    ctx = jax.random.normal(
+        jax.random.PRNGKey(43),
+        (2, cfg.text.max_length, cfg.unet.cross_attention_dim))
+    target = jax.random.normal(jax.random.PRNGKey(44), x.shape)
+
+    def loss_fn(unet_params):
+        eps = pipe.unet.apply({"params": unet_params}, x, t, ctx)
+        return jnp.mean((eps.astype(jnp.float32) - target) ** 2)
+
+    pipe.params = dict(pipe.params,
+                       unet=_adam_steps(loss_fn, pipe.params["unet"], 3))
+
+    # export through the HF-diffusers writer; reference pixels from memory
+    save_sd15_safetensors(str(tmp_path), cfg, pipe.params)
+    ref, _ = pipe.generate("a panda on mars", steps=2, seed=5,
+                           width=64, height=64)
+
+    # serving path: SDServer builds its pipeline from MODEL_DIR
+    monkeypatch.setenv("SD15_PRESET", "tiny")
+    monkeypatch.setenv("MODEL_DIR", str(tmp_path))
+    from tpustack.serving.sd_server import SDServer
+
+    server = SDServer(max_batch=1)
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/generate", json={
+                "prompt": "a panda on mars", "steps": 2, "seed": 5,
+                "width": 64, "height": 64})
+            assert r.status == 200, await r.text()
+            return await r.read()
+        finally:
+            await client.close()
+
+    served = np.asarray(Image.open(io.BytesIO(_run(scenario()))).convert("RGB"))
+    np.testing.assert_array_equal(served, ref[0])
+
+
+# ----------------------------------------------------------------------- LLM
+def test_llm_train_export_serve_parity(tmp_path, monkeypatch):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.models.llama import LlamaConfig, LlamaModel, causal_lm_loss
+    from tpustack.models.llama_weights import (load_llama_safetensors,
+                                               save_llama_safetensors)
+    from tpustack.models.llm_generate import Generator, SampleConfig
+    from tpustack.models.text_tokenizer import load_text_tokenizer
+
+    cfg = LlamaConfig.tiny(max_seq=64)
+    model = LlamaModel(cfg, dtype=jnp.float32)
+    batch = jax.random.randint(jax.random.PRNGKey(0), (4, 32), 0,
+                               cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), batch)["params"]
+
+    def loss_fn(p):
+        logits, _ = model.apply({"params": p}, batch)
+        return causal_lm_loss(logits, batch)
+
+    params = _adam_steps(loss_fn, params, 3)
+    save_llama_safetensors(str(tmp_path), params)
+
+    # reference: greedy decode from the re-LOADED weights (the reader is
+    # part of the proof), f32 to match the tiny serving preset
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(1), batch))["params"]
+    loaded = load_llama_safetensors(str(tmp_path), cfg, template,
+                                    dtype=jnp.float32)
+    gen = Generator(cfg, params=loaded, dtype=jnp.float32)
+    tok = load_text_tokenizer(cfg.vocab_size)
+    prompt_ids = tok.encode("the tiny panda")
+    new_ids, _ = gen.generate(prompt_ids, max_new_tokens=8,
+                              sample=SampleConfig(temperature=0.0, top_k=40,
+                                                  greedy=True))
+    if new_ids and new_ids[-1] == tok.eos_id:  # server trims trailing eos
+        new_ids = new_ids[:-1]
+    ref_text = tok.decode(new_ids)
+
+    # serving path: LLMServer builds generator + tokenizer from env
+    monkeypatch.setenv("LLM_PRESET", "tiny")
+    monkeypatch.setenv("LLM_CTX", "64")
+    monkeypatch.delenv("LLM_QUANT", raising=False)
+    monkeypatch.setenv("MODEL_DIR", str(tmp_path))
+    from tpustack.serving.llm_server import LLMServer
+
+    server = LLMServer()
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion", json={
+                "prompt": "the tiny panda", "n_predict": 8,
+                "temperature": 0.0})
+            assert r.status == 200, await r.text()
+            return await r.json()
+        finally:
+            await client.close()
+
+    j = _run(scenario())
+    assert j["content"] == ref_text, (j["content"], ref_text)
+
+
+# ----------------------------------------------------------------------- Wan
+def test_wan_train_export_serve_parity(tmp_path, monkeypatch):
+    from aiohttp.test_utils import TestClient, TestServer
+    from PIL import Image
+
+    from tpustack.models.wan import WanConfig, WanPipeline
+    from tpustack.models.wan.weights import save_wan_safetensors
+    from tpustack.serving.graph_server import GraphServer, WanRuntime
+
+    cfg = WanConfig.tiny()
+    pipe = WanPipeline(cfg, seed=0)
+
+    # a few real MSE steps on the DiT (flow-matching-style velocity target)
+    lat = jax.random.normal(jax.random.PRNGKey(2),
+                            (1, 1, 8, 8, cfg.dit.in_channels))
+    t = jnp.array([0.5], jnp.float32)
+    txt = jax.random.normal(jax.random.PRNGKey(3),
+                            (1, cfg.text.max_length, cfg.dit.text_dim))
+    vel = jax.random.normal(jax.random.PRNGKey(4), lat.shape)
+
+    def loss_fn(p):
+        out = pipe.dit.apply({"params": p}, lat, t, txt)
+        return jnp.mean((out.astype(jnp.float32) - vel) ** 2)
+
+    pipe.params = dict(pipe.params,
+                       dit=_adam_steps(loss_fn, pipe.params["dit"], 2))
+
+    models = tmp_path / "models"
+    save_wan_safetensors(str(models), pipe.params)
+    ref, _ = pipe.generate("a tiny panda", negative_prompt="", frames=5,
+                           steps=1, seed=9, width=32, height=32,
+                           guidance_scale=6.0)
+
+    # serving path: WanRuntime maps the exported checkpoint in from
+    # models_dir; the VAE has no checkpoint format (own architecture) and
+    # its seed-0 init matches the reference pipeline's
+    monkeypatch.setenv("WAN_PRESET", "tiny")
+    rt = WanRuntime(models_dir=str(models), output_dir=str(tmp_path / "out"))
+    server = GraphServer(runtime=rt)
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "wan_client_e2e",
+        os.path.join(REPO, "cluster-config", "apps", "llm", "scripts",
+                     "generate_wan_t2v.py"))
+    client_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(client_mod)
+    graph = client_mod.build_graph(
+        prompt="a tiny panda", negative="", seed=9, width=32, height=32,
+        frames=5, steps=1, cfg=6.0, sampler="uni_pc", scheduler="simple",
+        denoise=1.0, save_webp=False, save_images=True,
+        # the graph must name the models the server discovered — our
+        # exported fp32 files, not the upstream canonical names
+        unet_name="wan2.1_t2v_1.3B_fp32.safetensors",
+        clip_name="umt5_xxl_fp32.safetensors")
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/prompt", json={"prompt": graph,
+                                                   "client_id": "e2e"})
+            assert r.status == 200, await r.text()
+            pid = (await r.json())["prompt_id"]
+            hist = None
+            for _ in range(600):
+                r = await client.get(f"/history/{pid}")
+                h = await r.json()
+                if pid in h and h[pid]["status"]["completed"]:
+                    hist = h[pid]
+                    break
+                await asyncio.sleep(0.5)
+            assert hist is not None, "prompt never completed"
+            files = client_mod.result_files(hist)
+            assert files, hist["outputs"]
+            first = sorted(files, key=lambda f: f["filename"])[0]
+            r = await client.get("/view", params={
+                "filename": first["filename"],
+                "subfolder": first.get("subfolder", ""),
+                "type": first.get("type", "output")})
+            assert r.status == 200
+            return await r.read()
+        finally:
+            await client.close()
+
+    try:
+        png = _run(scenario())
+    finally:
+        server.shutdown()
+    served = np.asarray(Image.open(io.BytesIO(png)).convert("RGB"))
+    np.testing.assert_array_equal(served, ref[0, 0])

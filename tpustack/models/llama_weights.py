@@ -80,8 +80,38 @@ def load_llama_safetensors(root: str, cfg: LlamaConfig, template: Dict[str, Any]
     return params
 
 
+def export_llama_state_dict(params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_llama_state_dict`: our tree → HF keys/layout,
+    value preserving (kernels transposed back to torch ``[O, I]``)."""
+    out: Dict[str, np.ndarray] = {}
+    for path, leaf in _flatten(params):
+        key = our_path_to_hf_key(path)
+        if key in out:
+            # int8 trees map kernel+scale to the same '.weight' key — the
+            # export contract is the bf16/f32 tree (quantize after reload)
+            raise ValueError(
+                f"duplicate checkpoint key {key!r} (from {'/'.join(path)}) — "
+                "is this a quantized tree? export the pre-quantization params")
+        w = np.asarray(leaf, dtype=np.float32)
+        if path[-1] == "kernel":
+            w = np.transpose(w)
+        out[key] = np.ascontiguousarray(w)
+    return out
+
+
+def save_llama_safetensors(root: str, params: Dict[str, Any]) -> None:
+    """Write our params as an HF-layout checkpoint dir readable by
+    :func:`load_llama_safetensors` (and by transformers)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(root, exist_ok=True)
+    save_file(export_llama_state_dict(params),
+              os.path.join(root, "model.safetensors"))
+    log.info("Saved llama checkpoint to %s", root)
+
+
 def make_fake_hf_llama_state_dict(template: Dict[str, Any], seed: int = 0):
-    """Inverse mapping for offline converter tests."""
+    """Inverse mapping for offline converter tests (random values)."""
     rng = np.random.RandomState(seed)
     out = {}
     for path, tmpl in _flatten(template):

@@ -254,24 +254,63 @@ def load_sd15_safetensors(root: str, config: SD15Config, template_params: Tree) 
     return params
 
 
-def make_fake_hf_state_dict(template: Tree, model: str, n_levels: int = 4,
-                            seed: int = 0) -> Dict[str, np.ndarray]:
-    """Inverse mapping: build an HF-layout random state dict matching our tree.
-
-    Test-only helper — lets the converter round-trip be verified offline
-    without the real (zero-egress-unreachable) checkpoint.
-    """
-    rng = np.random.RandomState(seed)
+def export_state_dict(params: Tree, model: str,
+                      n_levels: int = 4) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_state_dict`: OUR param tree → HF-layout
+    state dict (torch tensor layouts, diffusers/transformers keys), value
+    preserving.  This is the writer half of the checkpoint contract: a tree
+    exported here and re-loaded through ``convert_state_dict`` is
+    bit-identical, so in-repo-trained checkpoints ship in the same format
+    the reference pulls from the hub."""
     out: Dict[str, np.ndarray] = {}
-    for path, tmpl in _flatten(template).items():
+    for path, leaf in _flatten(params).items():
         if model == "text_encoder" and path == ("position_embedding",):
             key = _CLIP_POS_KEY
         else:
             key = our_path_to_hf_key(path, model, n_levels)
-        w = rng.randn(*tmpl.shape).astype(np.float32) * 0.02
-        if _is_conv_kernel(tmpl.shape, path[-1]):
+        if key in out:
+            # quantized trees map kernel+scale onto one '.weight' key —
+            # export the pre-quantization tree instead
+            raise ValueError(
+                f"duplicate checkpoint key {key!r} (from {'/'.join(path)})")
+        w = np.asarray(leaf, dtype=np.float32)
+        if _is_conv_kernel(w.shape, path[-1]):
             w = conv_to_torch(w)
         elif path[-1] == "kernel":
             w = linear_to_torch(w)
-        out[key] = w
+        out[key] = np.ascontiguousarray(w)
     return out
+
+
+def save_sd15_safetensors(root: str, config: SD15Config, params: Tree) -> None:
+    """Write ``params`` as a diffusers SD1.5 snapshot directory — the exact
+    layout :func:`load_sd15_safetensors` (and HF diffusers itself) reads."""
+    from safetensors.numpy import save_file
+
+    n_levels = len(config.unet.block_out_channels)
+    vae_sd = export_state_dict(params["vae_decoder"], "vae_decoder")
+    if "vae_encoder" in params:
+        vae_sd.update(export_state_dict(params["vae_encoder"], "vae_encoder"))
+    files = {
+        os.path.join(root, "text_encoder", "model.safetensors"):
+            export_state_dict(params["text_encoder"], "text_encoder"),
+        os.path.join(root, "unet", "diffusion_pytorch_model.safetensors"):
+            export_state_dict(params["unet"], "unet", n_levels),
+        os.path.join(root, "vae", "diffusion_pytorch_model.safetensors"):
+            vae_sd,
+    }
+    for path, sd in files.items():
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        save_file(sd, path)
+    log.info("Saved SD1.5 snapshot to %s", root)
+
+
+def make_fake_hf_state_dict(template: Tree, model: str, n_levels: int = 4,
+                            seed: int = 0) -> Dict[str, np.ndarray]:
+    """HF-layout RANDOM state dict matching our tree (offline converter
+    tests); same mapping as :func:`export_state_dict`, random values."""
+    rng = np.random.RandomState(seed)
+    random_tree = _unflatten({
+        path: rng.randn(*tmpl.shape).astype(np.float32) * 0.02
+        for path, tmpl in _flatten(template).items()})
+    return export_state_dict(random_tree, model, n_levels)

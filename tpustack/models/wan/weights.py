@@ -234,26 +234,58 @@ def load_wan_safetensors(models_dir: str, config: WanConfig,
     return params
 
 
-def make_fake_wan_state_dict(template: Tree, model: str,
-                             seed: int = 0) -> Dict[str, np.ndarray]:
-    """Inverse mapping: a checkpoint-layout random state dict for our tree.
-
-    Test-only helper (same pattern as sd15.weights.make_fake_hf_state_dict):
-    verifies the converter round-trips offline, since the real checkpoints
-    are unreachable from the zero-egress dev environment.
-    """
-    rng = np.random.RandomState(seed)
+def export_wan_state_dict(params: Tree, model: str) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_state_dict` for ``dit``/``umt5``: our tree →
+    checkpoint-layout keys and torch tensor layouts, value preserving."""
     key_fn = {"dit": dit_key, "umt5": umt5_key}[model]
     inverse = {  # flax→torch layout inverses
         "_t": lambda w: np.transpose(w),
         "_conv3d": lambda w: np.transpose(w, (4, 3, 0, 1, 2)),
     }
     out: Dict[str, np.ndarray] = {}
-    for path, tmpl in _flatten(template).items():
+    for path, leaf in _flatten(params).items():
         key, transform = key_fn(path)
-        arr = rng.normal(0, 0.02, size=np.shape(tmpl)).astype(np.float32)
+        if key in out:
+            # int8-quantized trees carry kernel+scale leaves that map to the
+            # SAME checkpoint key — exporting one would silently overwrite
+            # the other.  Export the bf16 tree, quantize after reload.
+            raise WanWeightsError(
+                f"duplicate checkpoint key {key!r} (from {'/'.join(path)}) — "
+                "is this a quantized tree? export the pre-quantization params")
+        arr = np.asarray(leaf, dtype=np.float32)
         name = getattr(transform, "__name__", "")
         if name in inverse:
             arr = inverse[name](arr)
-        out[key] = arr
+        out[key] = np.ascontiguousarray(arr)
     return out
+
+
+def save_wan_safetensors(models_dir: str, params: Tree, *,
+                         unet_name: str = "wan2.1_t2v_1.3B_fp32.safetensors",
+                         clip_name: str = "umt5_xxl_fp32.safetensors") -> None:
+    """Write ``params['dit']``/``params['text_encoder']`` as a ComfyUI-layout
+    models dir readable by :func:`load_wan_safetensors` (the VAE is this
+    package's own architecture and has no checkpoint format — see module
+    docstring).  Default filenames say ``fp32`` because that is what the
+    numpy safetensors writer emits — the canonical bf16/fp16 names belong to
+    the upstream checkpoints; the runtime discovers either by listing."""
+    from safetensors.numpy import save_file
+
+    for sub, name, model, tree in (
+            ("diffusion_models", unet_name, "dit", params["dit"]),
+            ("text_encoders", clip_name, "umt5", params["text_encoder"])):
+        d = os.path.join(models_dir, sub)
+        os.makedirs(d, exist_ok=True)
+        save_file(export_wan_state_dict(tree, model), os.path.join(d, name))
+    log.info("Saved Wan checkpoints to %s", models_dir)
+
+
+def make_fake_wan_state_dict(template: Tree, model: str,
+                             seed: int = 0) -> Dict[str, np.ndarray]:
+    """Checkpoint-layout RANDOM state dict for our tree (offline converter
+    tests); same mapping as :func:`export_wan_state_dict`."""
+    rng = np.random.RandomState(seed)
+    random_tree = _unflatten({
+        path: rng.normal(0, 0.02, size=np.shape(tmpl)).astype(np.float32)
+        for path, tmpl in _flatten(template).items()})
+    return export_wan_state_dict(random_tree, model)
