@@ -48,6 +48,9 @@ def main() -> int:
     from tpustack.models.wan.pipeline import WanPipeline
 
     log = lambda *a: print(*a, file=sys.stderr, flush=True)
+    from tpustack.utils import enable_compile_cache
+
+    log(f"[bench_wan] compile cache: {enable_compile_cache() or 'unavailable'}")
     log(f"[bench_wan] backend={jax.default_backend()}")
 
     if args.small:

@@ -1,4 +1,6 @@
-from tpustack.utils.config import EnvConfig, env_flag, env_int, env_str
+from tpustack.utils.config import (EnvConfig, enable_compile_cache, env_flag,
+                                   env_int, env_str)
 from tpustack.utils.logging import get_logger
 
-__all__ = ["EnvConfig", "env_flag", "env_int", "env_str", "get_logger"]
+__all__ = ["EnvConfig", "enable_compile_cache", "env_flag", "env_int",
+           "env_str", "get_logger"]

@@ -115,3 +115,34 @@ def _default_of(field: dataclasses.Field) -> Any:
     if field.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
         return field.default_factory()  # type: ignore[misc]
     return None
+
+
+def enable_compile_cache(default_dir: Optional[str] = None) -> Optional[str]:
+    """Turn on JAX's persistent compilation cache, honouring
+    ``JAX_COMPILATION_CACHE_DIR`` (the env contract the serving manifests
+    set — e.g. ``cluster-config/apps/sd15-api/deployment.yaml:79``).
+
+    For CLI tools the env var is usually unset and jax may already be
+    imported, so this applies the config programmatically.  ``default_dir``
+    defaults to ``<repo root>/.cache/xla`` (gitignored).  Returns the cache
+    dir, or None if the cache could not be enabled — the failure cause is
+    logged, never raised: the cache is an optimisation, not a dependency.
+    """
+    import jax
+
+    from tpustack.utils.logging import get_logger
+
+    if default_dir is None:
+        default_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".cache", "xla")
+    cache = os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_dir
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return cache
+    except Exception as e:
+        get_logger("utils.config").warning(
+            "compile cache unavailable at %s: %r", cache, e)
+        return None
