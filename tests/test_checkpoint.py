@@ -57,6 +57,39 @@ def test_llama2_task_resume_is_noop_when_done(tmp_path):
 
 
 @pytest.mark.slow
+def test_sd15_task_saves_resumes_and_exports_servable_snapshot(tmp_path):
+    ckpt = str(tmp_path / "sd15")
+    export = str(tmp_path / "snapshot")
+    argv = ["sd15", "--tiny", "--steps", "3", "--batch", "2", "--no-bf16",
+            "--dp", "2", "--ckpt-dir", ckpt, "--save-every", "2",
+            "--export-dir", export]
+    assert tasks.main(argv) == 0
+    steps, latest = _steps(ckpt)
+    assert latest == 3 and steps == [1, 2, 3]
+
+    # resume: steps 4..5 only (same resume contract as the LM tasks)
+    argv[argv.index("--steps") + 1] = "5"
+    assert tasks.main(argv) == 0
+    steps, latest = _steps(ckpt)
+    assert latest == 5 and steps == [3, 4, 5]
+
+    # the export is a loadable diffusers snapshot with the TRAINED UNet
+    import jax
+    import numpy as np
+
+    from tpustack.models.sd15 import SD15Config, SD15Pipeline
+    from tpustack.models.sd15.weights import load_sd15_safetensors
+
+    cfg = SD15Config.tiny()
+    pipe = SD15Pipeline(cfg, seed=0)
+    loaded = load_sd15_safetensors(export, cfg, pipe.params)
+    fresh = jax.tree.leaves(pipe.params["unet"])
+    trained = jax.tree.leaves(loaded["unet"])
+    assert any(not np.allclose(np.asarray(a), np.asarray(b))
+               for a, b in zip(fresh, trained)), "export equals random init"
+
+
+@pytest.mark.slow
 def test_resnet50_task_saves_and_resumes(tmp_path):
     ckpt = str(tmp_path / "resnet")
     argv = ["resnet50", "--steps", "2", "--batch", "2", "--classes", "4",

@@ -63,7 +63,105 @@ def _maybe_save(mngr, step: int, state, force: bool = False) -> None:
     mngr.save(step, args=ocp.args.StandardSave(state), force=force)
 
 
+def _train_loop(state, mngr, step, make_batch, args) -> Any:
+    """The shared step loop: resume-deterministic data (per-step seeded),
+    per-step rng (``fold_in`` — tasks whose loss samples noise must see
+    FRESH randomness each step), periodic report, checkpointing."""
+    rng = jax.random.PRNGKey(2)
+    t0 = None
+    start = int(state.step)
+    for i in range(start, args.steps):
+        batch = make_batch(np.random.RandomState(i))
+        state, metrics = step(state, batch, jax.random.fold_in(rng, i))
+        if i == start:
+            jax.block_until_ready(metrics["loss"])
+            t0 = time.time()
+        elif (i + 1) % 10 == 0 or i == args.steps - 1:
+            jax.block_until_ready(metrics["loss"])
+            _report(i + 1, metrics, t0, i - start, args.batch)
+        _maybe_save(mngr, i + 1, state, force=i == args.steps - 1)
+    if mngr is not None:
+        mngr.wait_until_finished()
+    return state, start
+
+
 # --------------------------------------------------------------------- tasks
+
+def run_sd15(args) -> None:
+    """SD1.5 UNet fine-tune: DDPM epsilon-prediction MSE, dp-sharded.
+
+    The diffusion-training counterpart of the serving flagship (reference
+    trains nothing — SURVEY.md §2.10): noise a latent with the forward
+    process at a random timestep, predict the noise, MSE.  Text/VAE towers
+    stay frozen (standard SD fine-tune).  ``--export-dir`` writes the result
+    through the diffusers-layout safetensors writer, so ``sd_server``
+    (``MODEL_DIR``) serves it directly — the train→serve loop of
+    ``tests/test_real_weight_e2e.py`` as an operable k8s Job.
+    """
+    from jax.sharding import PartitionSpec as PS
+
+    from tpustack.models.sd15 import SD15Config, SD15Pipeline
+    from tpustack.models.sd15.scheduler import NUM_TRAIN_TIMESTEPS, add_noise
+    from tpustack.parallel import build_mesh
+    from tpustack.parallel.sharding import BATCH_SPEC
+    from tpustack.train.trainer import (TrainerConfig, make_sharded_train_step,
+                                        make_train_state)
+
+    import os
+
+    dtype = "bfloat16" if args.bf16 else "float32"
+    cfg = (SD15Config.tiny(dtype=dtype) if args.tiny
+           else SD15Config.sd15(dtype=dtype))
+    pipe = SD15Pipeline(cfg)
+    model_dir = os.environ.get("MODEL_DIR", "")
+    if model_dir:  # fine-tune FROM a checkpoint (same env contract as serving)
+        from tpustack.models.sd15.weights import load_sd15_safetensors
+
+        pipe.params = load_sd15_safetensors(model_dir, cfg, pipe.params)
+    lat = 8 if args.tiny else 64  # latent side: 64 ↔ the 512x512 serving shape
+    ctx_dim = cfg.unet.cross_attention_dim
+
+    dp = args.dp or len(jax.devices())
+    mesh = build_mesh((dp, 1, 1, 1), devices=jax.devices()[:dp])
+    rules = ((r".*", PS()),)  # DP fine-tune: replicate params, shard batch
+
+    def make_batch(rng):
+        return {
+            "x0": jnp.asarray(rng.randn(args.batch, lat, lat,
+                                        cfg.unet.in_channels), jnp.float32),
+            "ctx": jnp.asarray(rng.randn(args.batch, cfg.text.max_length,
+                                         ctx_dim), jnp.float32),
+            "t": jnp.asarray(rng.randint(0, NUM_TRAIN_TIMESTEPS,
+                                         (args.batch,)), jnp.int32),
+        }
+
+    def loss_fn(params, batch, rng):
+        noise = jax.random.normal(rng, batch["x0"].shape)
+        x_t = add_noise(batch["x0"], noise, batch["t"])
+        eps = pipe.unet.apply({"params": params},
+                              x_t.astype(cfg.compute_dtype), batch["t"],
+                              batch["ctx"].astype(cfg.compute_dtype))
+        return jnp.mean((eps.astype(jnp.float32) - noise) ** 2)
+
+    tcfg = TrainerConfig(learning_rate=args.lr, remat=args.remat)
+    state, _ = make_train_state(pipe.params["unet"], tcfg, mesh=mesh,
+                                rules=rules)
+    state, mngr = _maybe_restore(args.ckpt_dir, state, args.save_every)
+    step = make_sharded_train_step(loss_fn, tcfg, mesh=mesh,
+                                   batch_spec=BATCH_SPEC)
+    state, start = _train_loop(state, mngr, step, make_batch, args)
+
+    if args.export_dir:
+        from tpustack.models.sd15.weights import save_sd15_safetensors
+
+        pipe.params = dict(pipe.params,
+                           unet=jax.device_get(state.params))
+        save_sd15_safetensors(args.export_dir, cfg, pipe.params)
+        log.info("Exported servable snapshot to %s (point MODEL_DIR at it)",
+                 args.export_dir)
+    log.info("sd15 done: %d steps on mesh %s", args.steps - start,
+             dict(zip(mesh.axis_names, mesh.devices.shape)))
+
 
 def run_resnet50(args) -> None:
     """Config #3: ResNet-50, 1 chip.  BatchNorm stats threaded explicitly."""
@@ -259,30 +357,14 @@ def _generic_lm_task(args, kind: str) -> None:
     state, mngr = _maybe_restore(args.ckpt_dir, state, args.save_every)
     step = make_sharded_train_step(loss_fn, tcfg, mesh=mesh,
                                    batch_spec=BATCH_SPEC)
-
-    rng = jax.random.PRNGKey(2)
-    t0 = None
-    start = int(state.step)
-    for i in range(start, args.steps):
-        # per-step seed: deterministic data stream across checkpoint resume
-        batch = make_batch(np.random.RandomState(i))
-        state, metrics = step(state, batch, rng)
-        if i == start:
-            jax.block_until_ready(metrics["loss"])
-            t0 = time.time()
-        elif (i + 1) % 10 == 0 or i == args.steps - 1:
-            jax.block_until_ready(metrics["loss"])
-            _report(i + 1, metrics, t0, i - start, args.batch)
-        _maybe_save(mngr, i + 1, state, force=i == args.steps - 1)
-    if mngr is not None:
-        mngr.wait_until_finished()
+    state, start = _train_loop(state, mngr, step, make_batch, args)
     log.info("%s done: %d steps on mesh %s", kind, args.steps - start,
              dict(zip(mesh.axis_names, mesh.devices.shape)))
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="tpustack training ladder")
-    p.add_argument("task", choices=["resnet50", "bert", "llama2"])
+    p.add_argument("task", choices=["resnet50", "bert", "llama2", "sd15"])
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--lr", type=float, default=1e-4)
@@ -309,10 +391,15 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt-dir", default="")
     p.add_argument("--save-every", type=int, default=50,
                    help="checkpoint save interval in steps")
+    p.add_argument("--export-dir", default="",
+                   help="sd15: write the fine-tuned model as a diffusers "
+                        "snapshot servable via MODEL_DIR")
     args = p.parse_args(argv)
 
     if args.task == "resnet50":
         run_resnet50(args)
+    elif args.task == "sd15":
+        run_sd15(args)
     else:
         _generic_lm_task(args, args.task)
     return 0
