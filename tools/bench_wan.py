@@ -92,12 +92,30 @@ def main() -> int:
         log(f"[bench_wan] run {i + 1}/{args.repeats}: {dt:.2f}s")
 
     sec = statistics.median(times)
+
+    mfu = None
+    PEAKS = {"v6": 918e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+             "v5": 459e12, "v4": 275e12}
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    peak = next((v for k, v in PEAKS.items() if k in kind), None)
+    if peak:
+        try:
+            flops = pipe.pipeline_flops(steps=args.steps, frames=args.frames,
+                                        width=args.width, height=args.height)
+            mfu = flops / sec / peak
+            log(f"[bench_wan] {flops / 1e12:.1f} TFLOP/video → "
+                f"{flops / sec / 1e12:.1f} TFLOP/s ({100 * mfu:.1f}% of "
+                f"bf16 peak)")
+        except Exception as e:
+            log(f"[bench_wan] cost analysis unavailable: {e!r}")
+
     print(json.dumps({
         "metric": f"wan21_1.3b_{args.width}x{args.height}x{args.frames}f_"
                   f"{args.steps}step_videos_per_hour_per_chip",
         "value": round(3600.0 / sec, 2),
         "unit": "videos/hour/chip",
         "seconds_per_video": round(sec, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
     }))
     return 0
 

@@ -125,10 +125,7 @@ class WanPipeline:
         Defaults mirror the reference client (``generate_wan_t2v.py:305-312``):
         512x320, 16 frames, 25 steps, cfg 6.0, sampler uni_pc.
         """
-        c = self.config
-        ts = c.vae.temporal_scale
-        lat_f = max(0, int(frames) - 1) // ts + 1  # ComfyUI floor convention
-        lat_shape = c.latent_shape(1 + (lat_f - 1) * ts, height, width)
+        lat_shape = self._lat_shape(frames, height, width)
 
         t0 = time.time()
         ids, mask = self.tokenizer([negative_prompt] * batch_size
@@ -140,6 +137,50 @@ class WanPipeline:
                              noise, int(steps), canonical_sampler(sampler),
                              jnp.float32(guidance_scale))
         return np.asarray(vid), time.time() - t0
+
+    def _lat_shape(self, frames: int, height: int, width: int):
+        """Latent shape for a frame count (ComfyUI floor convention) —
+        single source for ``generate`` and ``pipeline_flops``."""
+        c = self.config
+        ts = c.vae.temporal_scale
+        lat_f = max(0, int(frames) - 1) // ts + 1
+        return c.latent_shape(1 + (lat_f - 1) * ts, height, width)
+
+    def pipeline_flops(self, *, steps: int = 25, frames: int = 16,
+                       width: int = 512, height: int = 320,
+                       batch_size: int = 1, sampler: str = "uni_pc") -> float:
+        """Model FLOPs of one ``generate`` (MFU accounting): XLA's
+        ``cost_analysis`` counts the denoise ``fori_loop`` body once, so sum
+        per-component AOT analyses — ``text(2B) + steps × DiT(CFG 2B) +
+        VAE decode(B)``.  Second-order samplers (heun — including uni_pc
+        etc., which :func:`canonical_sampler` maps onto it, exactly as
+        ``generate`` does) run the DiT twice per step."""
+        c = self.config
+        lat_shape = self._lat_shape(frames, height, width)
+        b2 = batch_size * 2  # CFG batches uncond+cond through one DiT eval
+
+        def cost(fn, *args):
+            comp = jax.jit(fn).lower(*args).compile()
+            ca = comp.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            return float(ca["flops"])
+
+        ids = jnp.zeros((b2, c.text.max_length), jnp.int32)
+        mask = jnp.ones((b2, c.text.max_length), jnp.int32)
+        lat = jnp.zeros((b2, *lat_shape), c.compute_dtype)
+        t = jnp.zeros((b2,), jnp.float32)
+        ctx = jnp.zeros((b2, c.text.max_length, c.dit.text_dim),
+                        c.compute_dtype)
+        z = jnp.zeros((batch_size, *lat_shape), jnp.float32)
+        f_text = cost(lambda p, i, m: self.text_encoder.apply(
+            {"params": p}, i, m), self.params["text_encoder"], ids, mask)
+        f_dit = cost(lambda p, x, t, cx: self.dit.apply(
+            {"params": p}, x, t, cx), self.params["dit"], lat, t, ctx)
+        f_vae = cost(lambda p, z: self.vae_decoder.apply({"params": p}, z),
+                     self.params["vae_decoder"], z)
+        per_step = (2 * f_dit if canonical_sampler(sampler) == "heun"
+                    else f_dit)
+        return f_text + steps * per_step + f_vae
 
     def warmup(self, **kw) -> float:
         t0 = time.time()
