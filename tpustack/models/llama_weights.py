@@ -12,8 +12,9 @@ from __future__ import annotations
 
 import glob
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -41,7 +42,13 @@ def our_path_to_hf_key(path: tuple) -> str:
 
 
 def convert_llama_state_dict(template: Dict[str, Any], hf: Dict[str, np.ndarray],
-                             dtype=jnp.bfloat16) -> Dict[str, Any]:
+                             dtype=jnp.bfloat16,
+                             shardings: Optional[Dict] = None) -> Dict[str, Any]:
+    """``shardings``: optional tree (matching ``template``) of
+    ``jax.sharding.Sharding`` — each tensor goes HOST → its own shard set
+    directly, never materialising the whole model on one device (the load
+    path for models bigger than a single chip's HBM)."""
+    shard_flat = dict(_flatten(shardings)) if shardings is not None else {}
     out: Dict[tuple, Any] = {}
     missing, bad = [], []
     for path, tmpl in _flatten(template):
@@ -55,7 +62,16 @@ def convert_llama_state_dict(template: Dict[str, Any], hf: Dict[str, np.ndarray]
         if w.shape != tmpl.shape:
             bad.append((key, w.shape, tmpl.shape))
             continue
-        out[path] = jnp.asarray(w, dtype)
+        sharding = shard_flat.get(path)
+        if sharding is not None:
+            import ml_dtypes
+
+            out[path] = jax.device_put(
+                np.ascontiguousarray(w).astype(
+                    ml_dtypes.bfloat16 if dtype == jnp.bfloat16
+                    else np.dtype(dtype)), sharding)
+        else:
+            out[path] = jnp.asarray(w, dtype)
     if missing or bad:
         raise ValueError(f"llama load: {len(missing)} missing, {len(bad)} bad shapes; "
                          f"missing[:10]={missing[:10]} bad[:5]={bad[:5]}")
@@ -63,7 +79,8 @@ def convert_llama_state_dict(template: Dict[str, Any], hf: Dict[str, np.ndarray]
 
 
 def load_llama_safetensors(root: str, cfg: LlamaConfig, template: Dict[str, Any],
-                           dtype=jnp.bfloat16) -> Dict[str, Any]:
+                           dtype=jnp.bfloat16,
+                           shardings: Optional[Dict] = None) -> Dict[str, Any]:
     from safetensors.numpy import load_file
 
     files = sorted(glob.glob(os.path.join(root, "*.safetensors")))
@@ -75,7 +92,7 @@ def load_llama_safetensors(root: str, cfg: LlamaConfig, template: Dict[str, Any]
     # tied-embedding checkpoints (Qwen2.5 < 3B etc.) have no lm_head tensor
     if "lm_head.weight" not in hf and "model.embed_tokens.weight" in hf:
         hf["lm_head.weight"] = hf["model.embed_tokens.weight"]
-    params = convert_llama_state_dict(template, hf, dtype)
+    params = convert_llama_state_dict(template, hf, dtype, shardings=shardings)
     log.info("Loaded %d tensors from %s", len(files), root)
     return params
 

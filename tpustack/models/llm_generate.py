@@ -44,10 +44,18 @@ class Generator:
     """Holds params + compiled prefill/decode programs."""
 
     def __init__(self, config: LlamaConfig, params: Optional[Dict] = None,
-                 dtype=jnp.bfloat16, seed: int = 0):
+                 dtype=jnp.bfloat16, seed: int = 0, mesh=None, rules=None):
+        """``mesh``: optional ``jax.sharding.Mesh`` — tensor-parallel
+        serving.  Params shard per ``rules`` (default ``LLAMA_RULES``: qkv/
+        gate column-wise, o/down row-wise over the ``tp`` axis) and every
+        compiled prefill/decode program is GSPMD-partitioned across the mesh,
+        with XLA inserting the ICI collectives — this is how models larger
+        than one chip's HBM serve (e.g. 70B over v5e-8), the inference-side
+        counterpart of the training mesh (SURVEY §2.10)."""
         self.cfg = config
         self.model = LlamaModel(config, dtype=dtype)
         self.cache_dtype = dtype
+        self.mesh = mesh
         if params is None:
             log.warning("Initialising %s-layer LLM with RANDOM weights", config.n_layers)
             tokens = jnp.zeros((1, 8), jnp.int32)
@@ -62,6 +70,13 @@ class Generator:
             else:
                 params = jax.jit(self.model.init)(
                     jax.random.PRNGKey(seed), tokens)["params"]
+        if mesh is not None:
+            from tpustack.parallel.sharding import (LLAMA_RULES,
+                                                    match_partition_rules,
+                                                    shard_params)
+
+            specs = match_partition_rules(rules or LLAMA_RULES, params)
+            params = shard_params(params, specs, mesh)
         self.params = params
 
     @staticmethod
@@ -78,12 +93,19 @@ class Generator:
 
     @classmethod
     def from_checkpoint(cls, config: LlamaConfig, model_dir: str,
-                        dtype=jnp.bfloat16) -> "Generator":
+                        dtype=jnp.bfloat16, mesh=None,
+                        rules=None) -> "Generator":
         """Load HF safetensors without materialising a random template first
         (jax.eval_shape gives the converter shapes at zero device cost).
         With ``config.quant`` the bf16 checkpoint is quantised in one jitted
         pass at load time — the online analog of the reference's offline
-        GGUF conversion step."""
+        GGUF conversion step.
+
+        With ``mesh``, every tensor goes host → its own shard set as it is
+        read (never the whole model on one device), so checkpoints larger
+        than a single chip's HBM load as long as the bf16 tree fits the
+        MESH's combined HBM; quantisation then runs as a GSPMD program over
+        the sharded tree."""
         from tpustack.models.llama_weights import load_llama_safetensors
 
         bf16_cfg = dataclasses.replace(config, quant=None)
@@ -91,10 +113,21 @@ class Generator:
         tmpl = jax.eval_shape(
             lambda: model.init(jax.random.PRNGKey(0),
                                jnp.zeros((1, 8), jnp.int32)))["params"]
-        params = load_llama_safetensors(model_dir, config, tmpl, dtype=dtype)
+        shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from tpustack.parallel.sharding import (LLAMA_RULES,
+                                                    match_partition_rules)
+
+            specs = match_partition_rules(rules or LLAMA_RULES, tmpl)
+            shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                     is_leaf=lambda x: not isinstance(x, dict))
+        params = load_llama_safetensors(model_dir, config, tmpl, dtype=dtype,
+                                        shardings=shardings)
         if config.quant:
             params = cls._quantize(config, params)
-        return cls(config, params=params, dtype=dtype)
+        return cls(config, params=params, dtype=dtype, mesh=mesh, rules=rules)
 
     # -------------------------------------------------------------- compiled
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(4,))

@@ -19,6 +19,8 @@ whole-model on-chip (no GGUF quantisation, no ``--n-gpu-layers`` CPU split —
 v5e HBM holds 7B), ctx 4096 parity via ``LLM_CTX`` env.
 
 Env: ``LLM_PRESET`` (``qwen25_7b``|``llama2_7b``|``tiny``), ``LLM_CTX``,
+``LLM_TP`` (tensor-parallel ways: GSPMD-shards the model over N chips,
+lifting the per-chip HBM ceiling),
 ``LLM_QUANT`` (``int8`` → weight-only quantised serving, the analog of the
 reference's Q4_K_M GGUF but ~2x decode from halved HBM traffic),
 ``LLM_MAX_BATCH``/``LLM_BATCH_WINDOW_MS`` (slot-parallel micro-batching of
@@ -78,11 +80,23 @@ def _build_generator():
         raise ValueError(f"LLM_QUANT={quant!r} unsupported (want int8)")
     cfg = dataclasses.replace(cfg, quant=quant)
 
+    # LLM_TP=N: tensor-parallel serving over N chips (GSPMD over a tp mesh
+    # axis) — the whole-model-per-chip ceiling lifts to N x HBM (70B-class
+    # on a v5e-8 pod, the scale story llama.cpp's GPU/CPU split approximated)
+    mesh = None
+    tp = int(os.environ.get("LLM_TP", "0") or 0)
+    if tp > 1:
+        import jax
+
+        from tpustack.parallel import build_mesh
+
+        mesh = build_mesh((1, 1, tp, 1), devices=jax.devices()[:tp])
+
     model_dir = os.environ.get("MODEL_DIR", "")
     if model_dir:
-        gen = Generator.from_checkpoint(cfg, model_dir, dtype=dtype)
+        gen = Generator.from_checkpoint(cfg, model_dir, dtype=dtype, mesh=mesh)
     else:
-        gen = Generator(cfg, dtype=dtype)
+        gen = Generator(cfg, dtype=dtype, mesh=mesh)
     tok = load_text_tokenizer(cfg.vocab_size)
     return gen, tok, preset
 
