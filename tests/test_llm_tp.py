@@ -80,6 +80,7 @@ def test_tp_int8_quantized_matches_unsharded(ref):
     assert a == b
 
 
+@pytest.mark.slow
 def test_from_checkpoint_shards_at_load(ref, tmp_path):
     """With a mesh, every checkpoint tensor goes host → its own shard set
     as it is read (models larger than one chip's HBM never materialise on a
