@@ -29,6 +29,12 @@ GOLDEN_PROMPTS = [
     "",                                      # empty prompt
     "supercalifragilisticexpialidocious antidisestablishmentarianism",
     "a dslr photograph, 35mm f/1.4, golden hour, bokeh",
+    # literal special-token strings map to bos/eos ids, not byte-BPE
+    "a photo <|endoftext|> of a cat",
+    "<|startoftext|> nested framing <|endoftext|>",
+    "a cat,<|endoftext|> dog",               # adjacent to punctuation
+    "no space<|startoftext|>between words",
+    "case folded <|ENDOFTEXT|> still maps",  # HF lowercases then bpe-caches
 ]
 
 
@@ -68,6 +74,25 @@ def test_truncation_matches(ours, hf):
     theirs = hf(long, padding="max_length", truncation=True, max_length=77,
                 return_tensors="np")["input_ids"][0].astype(np.int32)
     np.testing.assert_array_equal(ours([long], max_length=77)[0], theirs)
+
+
+def test_explicit_tokenizer_dir_fails_hard(tmp_path, monkeypatch):
+    """An explicitly configured SD15_TOKENIZER_DIR that cannot load must NOT
+    silently fall back to the vendored vocab: those ids are meaningless for
+    the configured checkpoint's text tower (ADVICE r2)."""
+    from tpustack.models.sd15.tokenizer import load_tokenizer
+
+    monkeypatch.setenv("SD15_TOKENIZER_DIR", str(tmp_path / "missing"))
+    with pytest.raises(FileNotFoundError):
+        load_tokenizer(49408, 77)
+
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "vocab.json").write_text("{not json")
+    (bad / "merges.txt").write_text("#version\n")
+    monkeypatch.setenv("SD15_TOKENIZER_DIR", str(bad))
+    with pytest.raises(RuntimeError):
+        load_tokenizer(49408, 77)
 
 
 def test_batch_framing(ours):

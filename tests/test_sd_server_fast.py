@@ -32,6 +32,19 @@ class _StubDeviceArray:
         time.sleep(max(0.0, self._ready_at - time.time()))
         return self
 
+    # jax.Array semantics: == is elementwise, truthiness raises — so
+    # list.remove() on an in-flight list raises ValueError unless the entry
+    # happens to sit at index 0 (the advisor-found race)
+    def __eq__(self, other):
+        return np.asarray(self._value) == other
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        raise ValueError("The truth value of an array with more than one "
+                         "element is ambiguous")
+
 
 class _StubPipeline:
     """generate_async contract of SD15Pipeline, no JAX involved."""
@@ -125,6 +138,40 @@ def test_batches_pipeline_dispatch_outside_transfer():
     _run(scenario())
     assert inflight_at_dispatch == [0, 1], inflight_at_dispatch
     assert server._inflight == []  # all fetched and removed
+
+
+def test_overlapping_batches_remove_inflight_by_identity():
+    """The second batch finishes while the first is still at index 0 of the
+    in-flight list; its cleanup must remove its own entry by identity (== on
+    a device array raises / is elementwise) and leave the first untouched."""
+    server = _make_server(batch_window_ms=1, max_batch=2)
+    compute = iter([0.6, 0.05])  # batch 1 slow, batch 2 fast
+    orig = server.pipe.generate_async
+
+    def varying(*a, **kw):
+        server.pipe.compute_s = next(compute)
+        return orig(*a, **kw)
+
+    server.pipe.generate_async = varying
+
+    async def scenario():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r1 = asyncio.ensure_future(client.post("/generate", json={
+                "prompt": "slow", "steps": 2, "width": 64, "height": 64}))
+            await asyncio.sleep(0.1)  # r1 dispatched, still computing
+            r2 = await client.post("/generate", json={
+                "prompt": "fast", "steps": 3, "width": 64, "height": 64})
+            assert r2.status == 200, await r2.text()
+            assert (await r1).status == 200
+        finally:
+            await client.close()
+
+    _run(scenario())
+    assert server._inflight == []
 
 
 def test_pipeline_error_propagates_to_every_request():

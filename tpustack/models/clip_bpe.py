@@ -43,6 +43,12 @@ except ImportError:  # stdlib fallback: ASCII classes (identical on ASCII text)
 BOS_TOKEN = "<|startoftext|>"
 EOS_TOKEN = "<|endoftext|>"
 
+# HF splits added tokens out of the RAW text (token trie, exact match) before
+# any normalisation — so a literal special token adjacent to punctuation
+# ("a cat,<|endoftext|>") must be recognised even though the CLIP split regex
+# would greedily consume the "<|" into the punctuation class.
+_SPECIAL_SPLIT = _re.compile(r"(<\|startoftext\|>|<\|endoftext\|>)")
+
 
 @functools.lru_cache()
 def byte_alphabet() -> Tuple[Dict[int, str], Dict[str, int]]:
@@ -148,9 +154,27 @@ class ClipBPE:
     def encode(self, text: str) -> List[int]:
         """Text → ids, no special-token framing."""
         ids: List[int] = []
-        for tok in _CLIP_PAT.findall(normalize(text)):
-            sym = "".join(self._byte_enc[b] for b in tok.encode("utf-8"))
-            ids.extend(self.encoder.get(p, self.unk_id) for p in self._bpe(sym))
+        for seg in _SPECIAL_SPLIT.split(text):
+            if seg == BOS_TOKEN:
+                ids.append(self.bos_id)
+                continue
+            if seg == EOS_TOKEN:
+                ids.append(self.eos_id)
+                continue
+            for tok in _CLIP_PAT.findall(normalize(seg)):
+                # a special-token string surviving into the normalised text
+                # (e.g. case-folded "<|ENDOFTEXT|>") still maps to its id:
+                # HF's bpe cache pins these strings to themselves, so the
+                # vocab lookup yields bos/eos there too
+                if tok == BOS_TOKEN:
+                    ids.append(self.bos_id)
+                    continue
+                if tok == EOS_TOKEN:
+                    ids.append(self.eos_id)
+                    continue
+                sym = "".join(self._byte_enc[b] for b in tok.encode("utf-8"))
+                ids.extend(self.encoder.get(p, self.unk_id)
+                           for p in self._bpe(sym))
         return ids
 
     def decode(self, ids: Sequence[int]) -> str:

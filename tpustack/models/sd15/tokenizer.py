@@ -77,10 +77,16 @@ def load_tokenizer(vocab_size: int, max_length: int):
     ``tests/test_clip_bpe.py``) → the vendored in-repo vocab (same format,
     trained offline by ``tools/train_bpe.py``) → hash.
     """
-    for which, tok_dir in (("SD15_TOKENIZER_DIR",
-                            os.environ.get("SD15_TOKENIZER_DIR", "")),
+    explicit_dir = os.environ.get("SD15_TOKENIZER_DIR", "")
+    for which, tok_dir in (("SD15_TOKENIZER_DIR", explicit_dir),
                            ("vendored", VENDORED_VOCAB_DIR)):
         if not (tok_dir and os.path.isdir(tok_dir)):
+            if which == "SD15_TOKENIZER_DIR" and explicit_dir:
+                raise FileNotFoundError(
+                    f"SD15_TOKENIZER_DIR={explicit_dir!r} is not a directory; "
+                    "refusing to fall back to the vendored vocab — its ids "
+                    "would be meaningless for the configured checkpoint's "
+                    "text tower")
             continue
         try:
             from tpustack.models.clip_bpe import ClipBPE
@@ -93,7 +99,14 @@ def load_tokenizer(vocab_size: int, max_length: int):
             log.info("Loaded CLIP BPE tokenizer (%s: %s, vocab %d)",
                      which, tok_dir, bpe.vocab_size)
             return ClipBPEWrapper(bpe, max_length)
-        except Exception as e:  # corrupt/partial files → keep serving
+        except Exception as e:  # corrupt/partial files
+            if which == "SD15_TOKENIZER_DIR":
+                # an explicitly configured real vocab failing to load must be
+                # an error: serving with the vendored stand-in against a real
+                # checkpoint yields wrong conditioning / garbage images
+                raise RuntimeError(
+                    f"SD15_TOKENIZER_DIR={tok_dir!r} was set but its vocab "
+                    f"failed to load: {e}") from e
             log.warning("CLIP BPE load from %s failed (%s)", tok_dir, e)
     log.warning(
         "No usable CLIP vocab files; using deterministic hash tokenizer — "

@@ -71,8 +71,12 @@ def load_tokenizer(vocab_size: int, max_length: int):
             tok = AutoTokenizer.from_pretrained(tok_dir)
             log.info("Loaded UMT5 tokenizer from %s", tok_dir)
             return HFT5Tokenizer(tok, max_length)
-        except Exception as e:  # noqa: BLE001 — fall back, but say why
-            log.warning("WAN_TOKENIZER_DIR=%s unusable (%s); hash fallback",
-                        tok_dir, e)
+        except Exception as e:
+            # an explicitly configured real vocab that fails to load must be
+            # an error: hash-tokenizer ids are meaningless for the configured
+            # checkpoint's text tower (same contract as sd15/tokenizer.py)
+            raise RuntimeError(
+                f"WAN_TOKENIZER_DIR={tok_dir!r} was set but its tokenizer "
+                f"failed to load: {e}") from e
     log.warning("Using deterministic HASH tokenizer (not the umt5 vocab)")
     return T5HashTokenizer(vocab_size, max_length)

@@ -306,7 +306,11 @@ class SDServer:
                 imgs = await loop.run_in_executor(None,
                                                   lambda: np.asarray(dev_imgs))
             finally:
-                self._inflight.remove(dev_imgs)
+                # remove by identity: list.remove uses ==, which on jax.Array
+                # raises "truth value is ambiguous" whenever two batches
+                # overlap and ours is no longer at index 0
+                self._inflight[:] = [a for a in self._inflight
+                                     if a is not dev_imgs]
         except Exception as e:
             for r in batch:
                 if not r.future.done():
