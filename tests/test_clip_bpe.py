@@ -76,6 +76,29 @@ def test_truncation_matches(ours, hf):
     np.testing.assert_array_equal(ours([long], max_length=77)[0], theirs)
 
 
+@pytest.mark.skipif(not os.environ.get("SD15_TOKENIZER_DIR"),
+                    reason="real CLIP vocab not mounted (zero-egress build "
+                           "host; in-cluster the init container fetches it "
+                           "and sets SD15_TOKENIZER_DIR)")
+def test_real_openai_vocab_golden_ids():
+    """With the REAL OpenAI CLIP vocab mounted: (a) our ids match
+    transformers on every golden prompt, (b) the vocab is actually the
+    49,408-token OpenAI one, pinned by the canonical 'a photo of a cat'
+    ids from the CLIP prompt-engineering literature."""
+    transformers = pytest.importorskip("transformers")
+    real_dir = os.environ["SD15_TOKENIZER_DIR"]
+    ours_real = ClipBPE.load(real_dir)
+    hf_real = transformers.CLIPTokenizer.from_pretrained(real_dir)
+    assert ours_real.vocab_size == 49408
+    assert ours_real.encode("a photo of a cat") == [320, 1125, 539, 320, 2368]
+    for prompt in GOLDEN_PROMPTS:
+        theirs = hf_real(prompt, padding="max_length", truncation=True,
+                         max_length=77,
+                         return_tensors="np")["input_ids"][0].astype(np.int32)
+        np.testing.assert_array_equal(ours_real([prompt], max_length=77)[0],
+                                      theirs)
+
+
 def test_explicit_tokenizer_dir_fails_hard(tmp_path, monkeypatch):
     """An explicitly configured SD15_TOKENIZER_DIR that cannot load must NOT
     silently fall back to the vendored vocab: those ids are meaningless for
