@@ -81,6 +81,97 @@ def test_two_process_jobset_bootstrap():
         assert f"WORKER-{pid}-OK" in out, out
 
 
+TRAIN_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["TPUSTACK_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from tpustack.parallel.distributed import initialize_from_env
+assert initialize_from_env(timeout_s=120)
+assert jax.process_count() == 2 and jax.local_device_count() == 4
+assert jax.device_count() == 8
+
+import jax.numpy as jnp
+from tpustack.models.llama import LlamaConfig, LlamaModel, causal_lm_loss
+from tpustack.parallel import build_mesh
+from tpustack.parallel.sharding import BATCH_SPEC, LLAMA_RULES
+from tpustack.train import TrainerConfig, make_sharded_train_step, \
+    make_train_state
+
+# dp=2 x fsdp=4 over all 8 global devices: jax sorts devices by id, so the
+# dp axis spans the two processes (proc 0 = dp row 0, proc 1 = dp row 1) —
+# gradient psum rides the DCN transport jax.distributed bootstrapped
+mesh = build_mesh((2, 4, 1, 1))
+rows = [{d.process_index for d in mesh.devices[r].flat} for r in (0, 1)]
+# each dp row must live wholly in ONE process — dp crosses the process
+# boundary, so the gradient psum genuinely rides the bootstrapped DCN
+# transport (a per-row mix would make this assertion-proof vacuous)
+assert rows == [{0}, {1}], f"dp rows do not map 1:1 to processes: {rows}"
+
+cfg = LlamaConfig.tiny(max_seq=32)
+model = LlamaModel(cfg, dtype=jnp.float32)
+# identical PRNGs on both processes: init is host-replicated, then
+# make_train_state device_puts it across the GLOBAL mesh per LLAMA_RULES
+batch = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+params = model.init(jax.random.PRNGKey(0), batch)["params"]
+
+def loss_fn(p, b, rng):
+    logits, _ = model.apply({"params": p}, b)
+    return causal_lm_loss(logits, b)
+
+tcfg = TrainerConfig(learning_rate=1e-3)
+state, _ = make_train_state(params, tcfg, mesh=mesh, rules=LLAMA_RULES)
+step = make_sharded_train_step(loss_fn, tcfg, mesh=mesh, batch_spec=BATCH_SPEC)
+state, metrics = step(state, jax.device_get(batch), jax.random.PRNGKey(2))
+loss = float(metrics["loss"])
+assert jnp.isfinite(loss), loss
+assert int(state.step) == 1
+
+# the loss must be the SAME global scalar on both processes (it psum-reduced
+# over a batch axis that spans them)
+from jax.experimental.multihost_utils import process_allgather
+losses = process_allgather(jnp.asarray([loss]))
+assert abs(losses[0] - losses[1]) < 1e-6, losses
+pid = jax.process_index()
+print(f"TRAIN-{pid}-OK loss={loss:.4f}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_sharded_train_step():
+    """VERDICT r2 #8: the JobSet bootstrap carries a REAL global mesh, not
+    just a psum — 2 processes x 4 virtual devices run one
+    make_sharded_train_step over a dp(2) x fsdp(4) mesh whose dp axis spans
+    both processes."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env.update({
+            "TPUSTACK_REPO": REPO,
+            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "NUM_PROCESSES": "2",
+            "PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", TRAIN_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"TRAIN-{pid}-OK" in out, out
+
+
 def test_detect_env_prefers_explicit_jobset_contract(monkeypatch):
     from tpustack.parallel.distributed import detect_process_env
 

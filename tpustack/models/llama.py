@@ -65,6 +65,14 @@ class LlamaConfig:
                    qkv_bias=True, rms_eps=1e-6)
 
     @classmethod
+    def llama2_70b(cls) -> "LlamaConfig":
+        """Llama-2-70B (GQA 64/8): the shard-at-load TP-serving target —
+        too big for one chip's HBM even at int8, sized for tp=8 on v5e-8
+        (HBM math rehearsed in tests/test_llm_tp.py)."""
+        return cls(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                   ffn_dim=28672)
+
+    @classmethod
     def tiny(cls, max_seq: int = 128) -> "LlamaConfig":
         # vocab 512 ≥ 259 so the byte-level fallback tokenizer fits
         return cls(vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
