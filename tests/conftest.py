@@ -46,12 +46,16 @@ import pytest  # noqa: E402
 
 
 def pytest_configure(config):
-    if TPU_MODE and "tpu" not in (config.option.markexpr or ""):
-        # hardware mode runs ONLY the tpu tier unless the caller's -m
-        # already mentions it — the CPU suite's sharding tests assume 8
-        # virtual devices that don't exist here.  (Checking for emptiness
-        # is not enough: addopts' "-m 'not slow'" pre-fills markexpr.)
-        config.option.markexpr = "tpu"
+    if TPU_MODE:
+        # Hardware mode must never run the CPU suite against the real
+        # backend (its sharding tests assume 8 virtual devices): an explicit
+        # command-line -m narrows WITHIN the tpu tier; anything else —
+        # including addopts' default "-m 'not slow'" — becomes plain "tpu".
+        cli_m = any(a == "-m" or a.startswith("-m=")
+                    for a in config.invocation_params.args)
+        user = config.option.markexpr
+        config.option.markexpr = (f"({user}) and tpu"
+                                  if cli_m and user else "tpu")
 
 
 def pytest_collection_modifyitems(config, items):

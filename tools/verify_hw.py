@@ -370,7 +370,14 @@ def _code_fingerprint(families: list[str]) -> str:
     compare new-code TPU output against a stale old-code reference)."""
     import hashlib
 
+    from importlib.metadata import version
+
     h = hashlib.sha256((",".join(sorted(families))).encode())
+    for pkg in ("jax", "jaxlib", "flax", "numpy"):  # numerics-relevant deps
+        try:
+            h.update(f"{pkg}={version(pkg)};".encode())
+        except Exception:
+            pass
     paths = [os.path.abspath(__file__)]
     for root, _, names in os.walk(os.path.join(REPO, "tpustack")):
         paths += [os.path.join(root, n) for n in names if n.endswith(".py")]
@@ -384,6 +391,10 @@ def _code_fingerprint(families: list[str]) -> str:
 def _run_phase(phase: str, workdir: str, families: list[str],
                env_extra: dict) -> None:
     env = dict(os.environ, **env_extra)
+    if phase == "hw":
+        # an exported JAX_PLATFORMS=cpu (pervasive in this repo's test
+        # tooling) must not make the hw phase refuse with a healthy chip
+        env.pop("JAX_PLATFORMS", None)
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", phase,
            "--workdir", workdir, "--families", ",".join(families)]
     t0 = time.time()

@@ -561,10 +561,12 @@ class Generator:
     ) -> Tuple[List[int], Dict[str, float]]:
         """Like ``generate`` but decodes ``chunk`` tokens per device dispatch
         (``lax.scan``) instead of one — the throughput path when no per-token
-        streaming callback is needed.  Stop tokens are honoured at chunk
-        granularity: the host truncates at the first stop token and at most
-        ``chunk - 1`` speculative tokens are discarded.  With ``greedy`` the
-        output matches ``generate`` token-for-token (same split chain).
+        streaming callback is needed.  Chunks are dispatched as a pipelined
+        chain (next chunk's first token stays on device), so stop tokens are
+        honoured at chunk granularity with up to ``depth`` (2) in-flight
+        chunks of speculative device work discarded: at most
+        ``chunk - 1 + depth*chunk`` tokens.  With ``greedy`` the output
+        matches ``generate`` token-for-token (same split chain).
 
         ``cancel_check()`` — optional; polled between chunks, return True to
         abandon generation (coarser than ``generate``'s per-token hook by at
@@ -577,40 +579,64 @@ class Generator:
         t0 = time.time()
         out: List[int] = [] if max_new_tokens <= 0 else [first]
         tok = first
-        while len(out) and len(out) < max_new_tokens and not (
-                stop_tokens and tok in stop_tokens):
-            if cancel_check is not None and cancel_check():
+        # Pipelined chunk chain: each scan's first token is the PREVIOUS
+        # scan's last output taken as a DEVICE array, so no host round-trip
+        # sits between chunks and the device runs them back-to-back (the
+        # xprof trace of the un-pipelined loop showed 55% device idle over
+        # the tunnel — tokens/s was dispatch-latency-bound, not HBM-bound).
+        # Tokens are fetched one chunk behind the dispatch frontier; a stop
+        # token costs at most `depth` speculative chunks, discarded on host.
+        # Greedy output still matches `generate` token-for-token: the scans
+        # run in the same order with the same split chain — only the host's
+        # fetch position moves.
+        depth = 2
+        queue: List[Any] = []  # in-flight [1, chunk] token arrays
+        next_first = jnp.asarray([[tok]], jnp.int32)
+        dispatched = 1  # prompt-sampled token + every token in a queued scan
+        stopped = max_new_tokens <= 0 or bool(stop_tokens and tok in stop_tokens)
+        while not stopped or queue:
+            while (not stopped and len(queue) < depth
+                   and dispatched < max_new_tokens
+                   and self.cfg.max_seq - (n_prompt + dispatched - 1) >= chunk):
+                if cancel_check is not None and cancel_check():
+                    stopped = True
+                    queue.clear()  # abandon: drop in-flight chunks unfetched
+                    break
+                # always scan a FULL chunk — one compiled signature; surplus
+                # tokens are discarded on the host
+                toks, caches, key = self._decode_scan(
+                    self.params, next_first, caches,
+                    jnp.asarray(n_prompt + dispatched - 1, jnp.int32), key,
+                    jnp.float32(sample.temperature), jnp.int32(sample.top_k),
+                    jnp.bool_(sample.greedy), chunk)
+                next_first = toks[:, -1:]
+                queue.append(toks)
+                dispatched += chunk
+            if not queue:
                 break
-            start = n_prompt + len(out) - 1
-            if self.cfg.max_seq - start < chunk:
-                # cache tail shorter than a chunk: finish on the already-
-                # compiled per-token step instead of compiling a new scan
-                # signature for this exact tail length
-                while (len(out) < max_new_tokens
-                       and not (stop_tokens and tok in stop_tokens)):
-                    step_key, key = jax.random.split(key)
-                    nxt, caches = self._decode_step(
-                        self.params, jnp.asarray([[tok]], jnp.int32),
-                        jnp.asarray(n_prompt + len(out) - 1, jnp.int32),
-                        caches, step_key, jnp.float32(sample.temperature),
-                        jnp.int32(sample.top_k), jnp.bool_(sample.greedy))
-                    tok = int(np.asarray(nxt)[0])
-                    out.append(tok)
-                break
-            # always scan a FULL chunk — one compiled signature; surplus
-            # tokens are discarded on the host
-            toks, caches, key = self._decode_scan(
-                self.params, jnp.asarray([[tok]], jnp.int32), caches,
-                jnp.asarray(start, jnp.int32), key,
-                jnp.float32(sample.temperature), jnp.int32(sample.top_k),
-                jnp.bool_(sample.greedy), chunk)
-            block = [int(t) for t in np.asarray(toks)[0]]
+            block = [int(t) for t in np.asarray(queue.pop(0))[0]]
             for t in block:
                 out.append(t)
+                tok = t
                 if (stop_tokens and t in stop_tokens) or \
                         len(out) >= max_new_tokens:
+                    stopped = True
+                    queue.clear()  # speculative chunks beyond the stop
                     break
-            tok = out[-1]
+        # cache tail shorter than a chunk (the only way the chain drains
+        # without stopping): finish on the already-compiled per-token step
+        # instead of compiling a new scan signature for this tail length
+        while (len(out) and len(out) < max_new_tokens
+               and not (stop_tokens and tok in stop_tokens)
+               and not (cancel_check is not None and cancel_check())):
+            step_key, key = jax.random.split(key)
+            nxt, caches = self._decode_step(
+                self.params, jnp.asarray([[tok]], jnp.int32),
+                jnp.asarray(n_prompt + len(out) - 1, jnp.int32),
+                caches, step_key, jnp.float32(sample.temperature),
+                jnp.int32(sample.top_k), jnp.bool_(sample.greedy))
+            tok = int(np.asarray(nxt)[0])
+            out.append(tok)
         return out, self._finish_stats(out, n_prompt, t_prefill, t0)
 
     def _finish_stats(self, out: List[int], n_prompt: int, t_prefill: float,
