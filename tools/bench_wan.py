@@ -35,7 +35,11 @@ def main() -> int:
     p.add_argument("--frames", type=int, default=16)
     p.add_argument("--width", type=int, default=512)
     p.add_argument("--height", type=int, default=320)
-    p.add_argument("--repeats", type=int, default=2)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--warmup", type=int, default=2,
+                   help="minimum untimed pipelined intervals; warmup "
+                        "continues until two consecutive intervals agree "
+                        "(shared discipline with bench.py)")
     p.add_argument("--small", action="store_true", help="tiny smoke shape")
     p.add_argument("--toy-text", action="store_true",
                    help="miniature text tower instead of the int8 umt5-xxl "
@@ -76,20 +80,26 @@ def main() -> int:
     pipe = WanPipeline(cfg)
     log(f"[bench_wan] init {time.time() - t0:.1f}s")
 
-    gen = lambda seed: pipe.generate(
+    import numpy as np
+
+    gen = lambda seed: pipe.generate_async(
         "a panda riding a motorbike through a neon city",
         steps=args.steps, frames=args.frames, width=args.width,
         height=args.height, seed=seed)
 
     t0 = time.time()
-    gen(0)
+    np.asarray(gen(0))
     log(f"[bench_wan] compile+first {time.time() - t0:.1f}s")
 
-    times = []
-    for i in range(args.repeats):
-        _, dt = gen(i + 1)
-        times.append(dt)
-        log(f"[bench_wan] run {i + 1}/{args.repeats}: {dt:.2f}s")
+    # Steady-state serving regime: one video always in flight, so video k's
+    # >1 s uint8 device→host transfer overlaps video k+1's compute — the
+    # SAME measurement loop as bench.py's SD15 number (adaptive warm-until-
+    # steady, then median of the recorded intervals).
+    from tpustack.utils.benchmark import pipelined_intervals
+
+    times = pipelined_intervals(
+        gen, repeats=args.repeats, warmup_min=args.warmup, warm_tol=0.05,
+        log=lambda s: log(f"[bench_wan] {s}"), unit="video")
 
     sec = statistics.median(times)
 

@@ -125,18 +125,35 @@ class WanPipeline:
         Defaults mirror the reference client (``generate_wan_t2v.py:305-312``):
         512x320, 16 frames, 25 steps, cfg 6.0, sampler uni_pc.
         """
-        lat_shape = self._lat_shape(frames, height, width)
-
         t0 = time.time()
+        vid = self.generate_async(
+            prompt, negative_prompt=negative_prompt, frames=frames,
+            steps=steps, guidance_scale=guidance_scale, seed=seed,
+            width=width, height=height, sampler=sampler,
+            batch_size=batch_size)
+        return np.asarray(vid), time.time() - t0
+
+    def generate_async(self, prompt: str, *, negative_prompt: str = "",
+                       frames: int = 16, steps: int = 25,
+                       guidance_scale: float = 6.0,
+                       seed: Optional[int] = None, width: int = 512,
+                       height: int = 320, sampler: str = "uni_pc",
+                       batch_size: int = 1):
+        """Dispatch one generation and return the DEVICE array (JAX async
+        dispatch) — ``np.asarray`` it to fetch.  The uint8 video transfer
+        costs >1 s through a tunnelled link, so serving/bench callers keep
+        one video in flight and overlap the previous fetch with the next
+        video's compute (same pattern as ``SD15Pipeline.generate_async``)."""
+        lat_shape = self._lat_shape(frames, height, width)
         ids, mask = self.tokenizer([negative_prompt] * batch_size
                                    + [prompt] * batch_size)
         key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None
                                  else seed % (2**31))
         noise = jax.random.normal(key, (batch_size, *lat_shape), jnp.float32)
-        vid = self._generate(self.params, jnp.asarray(ids), jnp.asarray(mask),
-                             noise, int(steps), canonical_sampler(sampler),
-                             jnp.float32(guidance_scale))
-        return np.asarray(vid), time.time() - t0
+        return self._generate(self.params, jnp.asarray(ids),
+                              jnp.asarray(mask), noise, int(steps),
+                              canonical_sampler(sampler),
+                              jnp.float32(guidance_scale))
 
     def _lat_shape(self, frames: int, height: int, width: int):
         """Latent shape for a frame count (ComfyUI floor convention) —

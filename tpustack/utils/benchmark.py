@@ -1,0 +1,71 @@
+"""Shared steady-state measurement loop for the serving benchmarks.
+
+One discipline, two benches (`bench.py` SD15, `tools/bench_wan.py`): keep
+exactly one unit of work in flight so the previous unit's device→host
+transfer overlaps the next unit's compute, warm up IN THAT REGIME until two
+consecutive intervals agree (r2's driver bench drew a 17.7% IQR partly from
+warming through a different code path than it measured), then record each
+sample as the mean over a window of back-to-back units.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+def pipelined_intervals(
+    dispatch: Callable[[int], object],
+    *,
+    repeats: int = 5,
+    window: int = 1,
+    warmup_min: int = 2,
+    warmup_max: int = 8,
+    warm_tol: float = 0.04,
+    log: Optional[Callable[[str], None]] = None,
+    unit: str = "batch",
+) -> List[float]:
+    """Measure steady-state per-unit wall time with one unit always in flight.
+
+    ``dispatch(seed)`` must return a device array (async dispatch);
+    ``np.asarray`` on the PREVIOUS result is the blocking fetch.  Warmup
+    runs until two consecutive intervals agree within ``warm_tol``
+    (``warmup_min``..``warmup_max`` intervals), then ``repeats`` samples are
+    recorded, each averaged over ``window`` back-to-back units.  Returns the
+    per-unit times (length ``repeats``).
+    """
+    say = log or (lambda s: None)
+    prev = dispatch(999)
+    mark, last = time.time(), None
+    for w in range(warmup_max):
+        cur = dispatch(1000 + w)
+        np.asarray(prev)
+        now = time.time()
+        interval = now - mark
+        steady = (last is not None and
+                  abs(interval - last) <= warm_tol * min(interval, last))
+        say(f"warmup {w + 1} (pipelined {unit} interval): {interval:.3f}s"
+            f"{'  [steady]' if steady else ''}")
+        mark, prev, last = now, cur, interval
+        if w + 1 >= warmup_min and steady:
+            break
+    else:
+        say(f"WARNING: warmup hit the {warmup_max}-interval cap without two "
+            f"consecutive intervals within {warm_tol:.0%} — measured samples "
+            "may not be steady-state")
+
+    times: List[float] = []
+    for i in range(repeats):
+        for j in range(window):
+            cur = dispatch(1 + i * window + j)
+            np.asarray(prev)
+            prev = cur
+        now = time.time()
+        times.append((now - mark) / window)
+        say(f"run {i + 1}/{repeats}: {times[-1]:.3f}s/{unit}"
+            f"{f' (mean over a {window}-{unit} window)' if window > 1 else ''}")
+        mark = now
+    np.asarray(prev)  # drain
+    return times
