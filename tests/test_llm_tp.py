@@ -67,6 +67,27 @@ def test_tp_params_actually_sharded(ref):
     assert leaf.addressable_shards[0].data.size < leaf.size
 
 
+def test_tp_kv_quant_matches_unsharded(ref):
+    """tp=2 + int8 KV cache — the Deployment's default combination: the
+    [B, S, Hkv] scale arrays must shard consistently with the Hkv-sharded
+    int8 K/V under the tp mesh, and greedy decode must stay token-identical
+    to the unsharded int8-KV engine."""
+    import dataclasses
+
+    cfg = dataclasses.replace(ref.cfg, kv_quant="int8")
+    solo = Generator(cfg, params=jax.device_get(ref.params),
+                     dtype=jnp.float32)
+    mesh = build_mesh((1, 1, 2, 1), devices=jax.devices()[:2])
+    tpg = Generator(cfg, params=jax.device_get(ref.params),
+                    dtype=jnp.float32, mesh=mesh)
+    prompt = list(range(5, 25))
+    a, _ = solo.generate_fused(prompt, max_new_tokens=10, sample=GREEDY,
+                               seed=1)
+    b, _ = tpg.generate_fused(prompt, max_new_tokens=10, sample=GREEDY,
+                              seed=1)
+    assert a == b, (a, b)
+
+
 @pytest.mark.slow
 def test_tp_int8_quantized_matches_unsharded(ref):
     """int8 weight-only serving composes with tp (the int8 kernels shard by

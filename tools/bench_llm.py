@@ -39,6 +39,10 @@ def main() -> int:
     p.add_argument("--quant", choices=["int8"], default=None,
                    help="weight-only quantised serving (the reference serves "
                         "Q4_K_M; int8 halves decode HBM traffic)")
+    p.add_argument("--kv-quant", choices=["int8"], default=None,
+                   help="per-vector int8 KV cache — halves KV read traffic "
+                        "and cache HBM (the dominant step-bytes term at "
+                        "long context)")
     p.add_argument("--batch", type=int, default=1,
                    help=">1: slot-parallel batched decode (generate_batch) — "
                         "aggregate tokens/s across the batch")
@@ -60,14 +64,15 @@ def main() -> int:
 
     if args.preset == "tiny":
         cfg = dataclasses.replace(LlamaConfig.tiny(max_seq=min(args.ctx, 128)),
-                                  quant=args.quant)
+                                  quant=args.quant, kv_quant=args.kv_quant)
         dtype = jnp.float32
         args.prompt_tokens = min(args.prompt_tokens, 32)
         args.new_tokens = min(args.new_tokens, 16)
     else:
         base = (LlamaConfig.llama2_7b() if args.preset == "llama2_7b"
                 else LlamaConfig.qwen25_7b())
-        cfg = dataclasses.replace(base, max_seq=args.ctx, quant=args.quant)
+        cfg = dataclasses.replace(base, max_seq=args.ctx, quant=args.quant,
+                                  kv_quant=args.kv_quant)
         dtype = jnp.bfloat16
 
     t0 = time.time()
@@ -150,10 +155,13 @@ def main() -> int:
         weight_bytes = sum(
             x.nbytes for p, x in flat
             if not any("embed" in str(getattr(k, "key", k)) for k in p))
-        # KV reads: full cache every step (static shapes; masked attention)
+        # KV reads: full cache every step (static shapes; masked attention);
+        # int8 cache = 1 byte/element + one f32 scale per vector
+        kv_elt = 1 if cfg.kv_quant == "int8" else jnp.dtype(dtype).itemsize
         kv_bytes = (args.batch * cfg.n_layers * 2 * cfg.max_seq *
-                    cfg.n_kv_heads * cfg.head_dim *
-                    jnp.dtype(dtype).itemsize)
+                    cfg.n_kv_heads *
+                    (cfg.head_dim * kv_elt +
+                     (4 if cfg.kv_quant == "int8" else 0)))
         matmul_flops_per_tok = 2 * sum(
             x.size for p, x in flat if leaf_name(p) == "kernel")
         decode_rate = statistics.median(dec)  # aggregate tok/s
@@ -168,9 +176,10 @@ def main() -> int:
             f"{100 * prefill_mfu:.0f}% of bf16 MXU peak")
 
     batch_tag = f"_batch{args.batch}" if args.batch > 1 else ""
+    kv_tag = f"_kv{args.kv_quant}" if args.kv_quant else ""
     print(json.dumps({
         "metric": f"{args.preset}_{args.quant or 'bf16'}_ctx{args.ctx}"
-                  f"{batch_tag}_decode_tokens_per_sec",
+                  f"{kv_tag}{batch_tag}_decode_tokens_per_sec",
         "value": round(statistics.median(dec), 2),
         "unit": "tokens/s/chip",
         "prefill_tokens_per_sec": round(statistics.median(pre), 1),

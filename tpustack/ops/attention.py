@@ -66,6 +66,8 @@ def dot_product_attention(
     scale: Optional[float] = None,
     impl: str = "xla",
     data_shards: int = 1,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Scaled dot-product attention over BSHD tensors.
 
@@ -82,6 +84,13 @@ def dot_product_attention(
         ``"auto"`` — flash on TPU for long sequences at small batch·heads
         (2.5x at SD1.5's 4k-token spatial attention, single image), XLA
         otherwise.
+      k_scale/v_scale: optional ``[B, Sk, Hkv]`` per-vector dequantisation
+        scales for an int8 KV cache (XLA impl only).  The int8 arrays stay
+        the dot operands (XLA fuses the int8→compute convert into the
+        operand read, so no bf16-sized cache ever materialises in HBM):
+        ``k_scale`` factors out of the ``d``-contraction and is applied to
+        the SCORES; ``v_scale`` rides the ``Sk``-contraction and folds into
+        the softmax probabilities.
     """
     b, sq, h, d = q.shape
     hkv = k.shape[2]
@@ -103,6 +112,15 @@ def dot_product_attention(
                          jax.default_backend(), data_shards, d)
         if impl == "flash" and causal and sq > k.shape[1]:
             impl = "xla"  # flash rejects this shape (below); auto must not
+
+    if k_scale is not None or v_scale is not None:
+        if impl != "xla":
+            raise NotImplementedError(
+                "k_scale/v_scale (int8 KV cache) require impl='xla'; "
+                "dequantise explicitly for the flash kernel")
+        compute = q.dtype
+        k = k.astype(compute)
+        v = v.astype(compute)
 
     if impl == "flash":
         if mask is not None:
@@ -136,14 +154,24 @@ def dot_product_attention(
         causal_mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
         mask = causal_mask if mask is None else jnp.logical_and(mask, causal_mask)
 
+    # [B, Sk, Hkv] scales → broadcastable over the score/prob layouts
+    ks_b = (jnp.transpose(k_scale, (0, 2, 1))
+            if k_scale is not None else None)  # [B, Hkv, Sk]
+    vs_b = (jnp.transpose(v_scale, (0, 2, 1))
+            if v_scale is not None else None)
+
     if hkv == h:
         # [B, H, Sq, Sk]; accumulate logits in fp32 for bf16 inputs.
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                             preferred_element_type=jnp.float32)
+        if ks_b is not None:
+            logits = logits * ks_b[:, :, None, :].astype(logits.dtype)
         logits = logits * jnp.asarray(scale, logits.dtype)
         if mask is not None:
             logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
         probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        if vs_b is not None:
+            probs = probs * vs_b[:, :, None, :].astype(probs.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
     # GQA contracts grouped queries against UNEXPANDED K/V — a ``jnp.repeat``
@@ -155,6 +183,8 @@ def dot_product_attention(
     # [B, Hkv, G, Sq, Sk]
     logits = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
                         preferred_element_type=jnp.float32)
+    if ks_b is not None:
+        logits = logits * ks_b[:, :, None, None, :].astype(logits.dtype)
     logits = logits * jnp.asarray(scale, logits.dtype)
     if mask is not None:
         # mask.ndim is 2 or 4 (validated above), so the head axis is exact
@@ -171,5 +201,7 @@ def dot_product_attention(
             mask = mask[..., None, :, :]
         logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    if vs_b is not None:
+        probs = probs * vs_b[:, :, None, None, :].astype(probs.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
     return out.reshape(b, sq, h, d)

@@ -21,6 +21,8 @@ v5e HBM holds 7B), ctx 4096 parity via ``LLM_CTX`` env.
 Env: ``LLM_PRESET`` (``qwen25_7b``|``llama2_7b``|``tiny``), ``LLM_CTX``,
 ``LLM_TP`` (tensor-parallel ways: GSPMD-shards the model over N chips,
 lifting the per-chip HBM ceiling),
+``LLM_KV_QUANT`` (``int8`` → per-vector int8 KV cache: halves long-context
+decode KV traffic and cache HBM),
 ``LLM_QUANT`` (``int8`` → weight-only quantised serving, the analog of the
 reference's Q4_K_M GGUF but ~2x decode from halved HBM traffic),
 ``LLM_MAX_BATCH``/``LLM_BATCH_WINDOW_MS`` (slot-parallel micro-batching of
@@ -78,7 +80,10 @@ def _build_generator():
     quant = os.environ.get("LLM_QUANT", "").lower() or None
     if quant not in (None, "int8"):
         raise ValueError(f"LLM_QUANT={quant!r} unsupported (want int8)")
-    cfg = dataclasses.replace(cfg, quant=quant)
+    kv_quant = os.environ.get("LLM_KV_QUANT", "").lower() or None
+    if kv_quant not in (None, "int8"):
+        raise ValueError(f"LLM_KV_QUANT={kv_quant!r} unsupported (want int8)")
+    cfg = dataclasses.replace(cfg, quant=quant, kv_quant=kv_quant)
 
     # LLM_TP=N: tensor-parallel serving over N chips (GSPMD over a tp mesh
     # axis) — the whole-model-per-chip ceiling lifts to N x HBM (70B-class
