@@ -232,6 +232,50 @@ class Generator:
         return self._sample_from_logits(logits, key, temperature, top_k,
                                         greedy), caches
 
+    @staticmethod
+    def _run_chunk_chain(scan, first_dev, consume, *, chunk: int,
+                         budget: int, cache_room: int, cancel_check,
+                         initial_stop: bool = False, depth: int = 2) -> None:
+        """Pipelined decode-chunk chain — the shared driver of
+        ``generate_fused`` and ``generate_batch``.
+
+        Each scan's first token is the PREVIOUS scan's last column as a
+        DEVICE array, so no host round-trip sits between chunk dispatches
+        (the xprof trace of the un-pipelined loop showed 55% device idle
+        over the tunnel); the host fetches one chunk behind the frontier
+        and a stop costs at most ``depth`` speculative chunks of discarded
+        device work.
+
+        ``scan(first_tok_dev, dispatched) -> toks_dev [B, chunk]`` performs
+        one fused dispatch (mutating caches/key in its closure);
+        ``consume(block) -> bool`` ingests a fetched ``[B, chunk]`` numpy
+        block and returns True to stop.  ``budget``: decode steps wanted
+        beyond the already-known first token; ``cache_room``: steps the
+        cache can still hold — a full chunk must fit or the chain drains
+        (callers finish on their single-step tail path).
+        """
+        chain: List[Any] = []
+        next_first = first_dev
+        dispatched = 0
+        stopped = initial_stop or budget <= 0
+        while not stopped or chain:
+            while (not stopped and len(chain) < depth
+                   and dispatched < budget
+                   and cache_room - dispatched >= chunk):
+                if cancel_check is not None and cancel_check():
+                    stopped = True
+                    chain.clear()  # abandon: drop in-flight chunks unfetched
+                    break
+                toks = scan(next_first, dispatched)
+                next_first = toks[:, -1:]
+                chain.append(toks)
+                dispatched += chunk
+            if not chain:
+                break
+            if consume(np.asarray(chain.pop(0))):
+                stopped = True
+                chain.clear()  # speculative chunks beyond the stop
+
     @functools.partial(jax.jit, static_argnums=(0, 9), donate_argnums=(3,))
     def _decode_scan(self, params, first_tok, caches, start_index, key,
                      temperature, top_k, greedy, n_steps: int):
@@ -413,37 +457,20 @@ class Generator:
         for i in range(b):
             if done[i]:
                 notify(i)
-        step = 0  # decode steps already scanned past the first token
+        step = 0  # decode steps already fetched past the first token
         bucket_arr = jnp.asarray(bucket, jnp.int32)
-        while not all(done) and step < max(max_new) - 1:
-            if cancel_check is not None and cancel_check():
-                break
-            tail = capacity - 1 - step
-            if tail <= 0:
-                break
-            if tail >= chunk:
-                # always scan a FULL chunk — one compiled signature per
-                # (B, chunk); surplus tokens are discarded on the host
-                toks, caches, key = self._decode_scan_batch(
-                    self.params, jnp.asarray(tok),
-                    jnp.asarray(step, jnp.int32), lengths, bucket_arr,
-                    caches, key, temperature, top_k, greedy, chunk)
-                block = np.asarray(toks)  # [B, chunk]
-            else:
-                # cache tail shorter than a chunk: finish on the single-step
-                # batched decoder instead of compiling a scan signature for
-                # this exact tail length
-                cols = []
-                for j in range(tail):
-                    step_key, key = jax.random.split(key)
-                    nxt, caches = self._decode_step_batch(
-                        self.params, jnp.asarray(tok),
-                        jnp.asarray(step + j, jnp.int32), lengths,
-                        bucket_arr, caches, step_key, temperature, top_k,
-                        greedy)
-                    tok = np.asarray(nxt)[:, None].astype(np.int32)
-                    cols.append(tok[:, 0])
-                block = np.stack(cols, axis=1)  # [B, tail]
+        state = {"caches": caches, "key": key, "tok": tok, "step": step}
+
+        def scan(first_dev, dispatched):
+            # always scan a FULL chunk — one compiled signature per
+            # (B, chunk); surplus tokens are discarded on the host
+            toks, state["caches"], state["key"] = self._decode_scan_batch(
+                self.params, first_dev, jnp.asarray(dispatched, jnp.int32),
+                lengths, bucket_arr, state["caches"], state["key"],
+                temperature, top_k, greedy, chunk)
+            return toks
+
+        def consume(block) -> bool:
             if on_chunk is not None:  # before notify: tokens precede sentinels
                 on_chunk(block)
             for i in range(b):
@@ -455,8 +482,39 @@ class Generator:
                         done[i] = True
                         notify(i)
                         break
-            tok = block[:, -1:].astype(np.int32)
-            step += block.shape[1]
+            state["tok"] = block[:, -1:].astype(np.int32)
+            state["step"] += block.shape[1]
+            return all(done)
+
+        self._run_chunk_chain(
+            scan, jnp.asarray(tok), consume, chunk=chunk,
+            budget=max(max_new) - 1, cache_room=capacity - 1,
+            cancel_check=cancel_check, initial_stop=all(done))
+        caches, key, tok, step = (state["caches"], state["key"],
+                                  state["tok"], state["step"])
+        # cache tail shorter than a chunk (the only way the chain drains
+        # with rows still running): finish on the single-step batched
+        # decoder instead of compiling a scan signature for this tail
+        while (not all(done) and step < max(max_new) - 1
+               and capacity - 1 - step > 0
+               and not (cancel_check is not None and cancel_check())):
+            step_key, key = jax.random.split(key)
+            nxt, caches = self._decode_step_batch(
+                self.params, jnp.asarray(tok), jnp.asarray(step, jnp.int32),
+                lengths, bucket_arr, caches, step_key, temperature, top_k,
+                greedy)
+            tok = np.asarray(nxt)[:, None].astype(np.int32)
+            if on_chunk is not None:
+                on_chunk(tok.copy())
+            for i in range(b):
+                if done[i]:
+                    continue
+                t = int(tok[i, 0])
+                out[i].append(t)
+                if t in stop_tokens or len(out[i]) >= max_new[i]:
+                    done[i] = True
+                    notify(i)
+            step += 1
         for i in range(b):  # stragglers: budget/cancel exits without done[i]
             notify(i)
         t_decode = time.time() - t0
@@ -579,50 +637,37 @@ class Generator:
         t0 = time.time()
         out: List[int] = [] if max_new_tokens <= 0 else [first]
         tok = first
-        # Pipelined chunk chain: each scan's first token is the PREVIOUS
-        # scan's last output taken as a DEVICE array, so no host round-trip
-        # sits between chunks and the device runs them back-to-back (the
-        # xprof trace of the un-pipelined loop showed 55% device idle over
-        # the tunnel — tokens/s was dispatch-latency-bound, not HBM-bound).
-        # Tokens are fetched one chunk behind the dispatch frontier; a stop
-        # token costs at most `depth` speculative chunks, discarded on host.
-        # Greedy output still matches `generate` token-for-token: the scans
-        # run in the same order with the same split chain — only the host's
-        # fetch position moves.
-        depth = 2
-        queue: List[Any] = []  # in-flight [1, chunk] token arrays
-        next_first = jnp.asarray([[tok]], jnp.int32)
-        dispatched = 1  # prompt-sampled token + every token in a queued scan
-        stopped = max_new_tokens <= 0 or bool(stop_tokens and tok in stop_tokens)
-        while not stopped or queue:
-            while (not stopped and len(queue) < depth
-                   and dispatched < max_new_tokens
-                   and self.cfg.max_seq - (n_prompt + dispatched - 1) >= chunk):
-                if cancel_check is not None and cancel_check():
-                    stopped = True
-                    queue.clear()  # abandon: drop in-flight chunks unfetched
-                    break
-                # always scan a FULL chunk — one compiled signature; surplus
-                # tokens are discarded on the host
-                toks, caches, key = self._decode_scan(
-                    self.params, next_first, caches,
-                    jnp.asarray(n_prompt + dispatched - 1, jnp.int32), key,
-                    jnp.float32(sample.temperature), jnp.int32(sample.top_k),
-                    jnp.bool_(sample.greedy), chunk)
-                next_first = toks[:, -1:]
-                queue.append(toks)
-                dispatched += chunk
-            if not queue:
-                break
-            block = [int(t) for t in np.asarray(queue.pop(0))[0]]
-            for t in block:
+        # Greedy output still matches `generate` token-for-token under the
+        # pipelined chain: the scans run in the same order with the same
+        # split chain — only the host's fetch position moves.
+        state = {"caches": caches, "key": key, "tok": tok}
+
+        def scan(first_dev, dispatched):
+            # always scan a FULL chunk — one compiled signature; surplus
+            # tokens are discarded on the host
+            toks, state["caches"], state["key"] = self._decode_scan(
+                self.params, first_dev, state["caches"],
+                jnp.asarray(n_prompt + dispatched, jnp.int32), state["key"],
+                jnp.float32(sample.temperature), jnp.int32(sample.top_k),
+                jnp.bool_(sample.greedy), chunk)
+            return toks
+
+        def consume(block) -> bool:
+            for t in (int(x) for x in block[0]):
                 out.append(t)
-                tok = t
+                state["tok"] = t
                 if (stop_tokens and t in stop_tokens) or \
                         len(out) >= max_new_tokens:
-                    stopped = True
-                    queue.clear()  # speculative chunks beyond the stop
-                    break
+                    return True
+            return False
+
+        self._run_chunk_chain(
+            scan, jnp.asarray([[tok]], jnp.int32), consume, chunk=chunk,
+            budget=max_new_tokens - 1,
+            cache_room=self.cfg.max_seq - n_prompt,
+            cancel_check=cancel_check,
+            initial_stop=bool(stop_tokens and tok in stop_tokens))
+        caches, key, tok = state["caches"], state["key"], state["tok"]
         # cache tail shorter than a chunk (the only way the chain drains
         # without stopping): finish on the already-compiled per-token step
         # instead of compiling a new scan signature for this tail length
