@@ -154,6 +154,64 @@ def test_e2e_webp_and_image_graphs(server):
     _run(scenario())
 
 
+def test_back_to_back_prompts_pipeline_through_worker(server):
+    """Exercises the worker's overlap branch (prompt k+1 dispatched before
+    prompt k's deferred saves run): submit three prompts at once, all must
+    complete with distinct valid output files, and an error graph queued
+    behind them must fail cleanly while its neighbours succeed."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        http = TestClient(TestServer(server.build_app()))
+        await http.start_server()
+        try:
+            pids = []
+            for seed in (11, 12, 13):
+                r = await http.post("/prompt", json={
+                    "prompt": _tiny_graph(seed=seed), "client_id": "t"})
+                assert r.status == 200, await r.text()
+                pids.append((await r.json())["prompt_id"])
+            # an invalid graph queued BEHIND the batch: its failure must not
+            # disturb the in-flight pipeline
+            r = await http.post("/prompt", json={
+                "prompt": {"1": {"class_type": "KSampler", "inputs": {}}},
+                "client_id": "t"})
+            bad_pid = (await r.json())["prompt_id"]
+
+            entries = {}
+            for _ in range(600):
+                for pid in pids + [bad_pid]:
+                    if pid in entries:
+                        continue
+                    r = await http.get(f"/history/{pid}")
+                    hist = await r.json()
+                    if pid in hist and hist[pid]["status"]["completed"]:
+                        entries[pid] = hist[pid]
+                if len(entries) == 4:
+                    break
+                await asyncio.sleep(0.2)
+            assert len(entries) == 4, f"only {len(entries)} completed"
+
+            seen = set()
+            for pid in pids:
+                assert entries[pid]["status"]["status_str"] == "success", \
+                    entries[pid]["status"]
+                files = client_mod.result_files(entries[pid])
+                assert len(files) == 1
+                name = files[0]["filename"]
+                assert name not in seen  # no counter/file collisions
+                seen.add(name)
+                r = await http.get("/view", params={
+                    "filename": name, "subfolder": "", "type": "output"})
+                body = await r.read()
+                assert body[:4] == b"RIFF" and body[8:12] == b"WEBP"
+            assert entries[bad_pid]["status"]["status_str"] == "error"
+        finally:
+            await http.close()
+
+    _run(scenario())
+
+
 def test_graph_failure_surfaces_in_history(server):
     """Node-level errors must land in status.messages, not crash the worker
     (the client raises them as 'Generation failed: …')."""

@@ -259,6 +259,12 @@ class Generator:
         dispatched = 0
         stopped = initial_stop or budget <= 0
         while not stopped or chain:
+            # polled before every fill AND every fetch: once dispatching
+            # ends, the drain phase must still abandon in-flight chunks on
+            # cancellation instead of consuming them
+            if cancel_check is not None and cancel_check():
+                chain.clear()
+                break
             while (not stopped and len(chain) < depth
                    and dispatched < budget
                    and cache_room - dispatched >= chunk):
@@ -490,31 +496,18 @@ class Generator:
             scan, jnp.asarray(tok), consume, chunk=chunk,
             budget=max(max_new) - 1, cache_room=capacity - 1,
             cancel_check=cancel_check, initial_stop=all(done))
-        caches, key, tok, step = (state["caches"], state["key"],
-                                  state["tok"], state["step"])
         # cache tail shorter than a chunk (the only way the chain drains
         # with rows still running): finish on the single-step batched
-        # decoder instead of compiling a scan signature for this tail
-        while (not all(done) and step < max(max_new) - 1
-               and capacity - 1 - step > 0
+        # decoder, reusing the same consume() bookkeeping per [B, 1] block
+        while (not all(done) and state["step"] < max(max_new) - 1
+               and capacity - 1 - state["step"] > 0
                and not (cancel_check is not None and cancel_check())):
-            step_key, key = jax.random.split(key)
-            nxt, caches = self._decode_step_batch(
-                self.params, jnp.asarray(tok), jnp.asarray(step, jnp.int32),
-                lengths, bucket_arr, caches, step_key, temperature, top_k,
-                greedy)
-            tok = np.asarray(nxt)[:, None].astype(np.int32)
-            if on_chunk is not None:
-                on_chunk(tok.copy())
-            for i in range(b):
-                if done[i]:
-                    continue
-                t = int(tok[i, 0])
-                out[i].append(t)
-                if t in stop_tokens or len(out[i]) >= max_new[i]:
-                    done[i] = True
-                    notify(i)
-            step += 1
+            step_key, state["key"] = jax.random.split(state["key"])
+            nxt, state["caches"] = self._decode_step_batch(
+                self.params, jnp.asarray(state["tok"]),
+                jnp.asarray(state["step"], jnp.int32), lengths, bucket_arr,
+                state["caches"], step_key, temperature, top_k, greedy)
+            consume(np.asarray(nxt)[:, None].astype(np.int32))
         for i in range(b):  # stragglers: budget/cancel exits without done[i]
             notify(i)
         t_decode = time.time() - t0

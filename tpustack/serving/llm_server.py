@@ -678,6 +678,9 @@ class LLMServer:
 
 
 def main() -> None:
+    from tpustack.utils import enable_compile_cache
+
+    enable_compile_cache()  # JAX_COMPILATION_CACHE_DIR or <repo>/.cache/xla
     port = int(os.environ.get("PORT", "8080"))
     server = LLMServer()
     web.run_app(server.build_app(), port=port, access_log=None)

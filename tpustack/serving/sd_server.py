@@ -393,7 +393,9 @@ class SDServer:
 
 def main() -> None:
     from tpustack import runtime
+    from tpustack.utils import enable_compile_cache
 
+    enable_compile_cache()  # JAX_COMPILATION_CACHE_DIR or <repo>/.cache/xla
     runtime.available()  # build/load the native PNG encoder before serving
     port = int(os.environ.get("PORT", "8000"))
     server = SDServer()
