@@ -82,6 +82,23 @@ def test_object_info_advertises_canonical_models(server):
     assert ("SaveWEBM" in info) == (_ffmpeg() is not None)
 
 
+def test_text_quant_env_resolution(monkeypatch):
+    """int8 is the serving default; '' keeps it (the OOM footgun: a
+    full-precision umt5-xxl doesn't even compile on a 16 GB chip); only
+    explicit none/off opts out; typos fail fast."""
+    from tpustack.serving.graph_server import _text_quant
+
+    for raw, expect in (("", "int8"), ("int8", "int8"), ("none", None),
+                        ("off", None), ("  INT8 ", "int8")):
+        monkeypatch.setenv("WAN_TEXT_QUANT", raw)
+        assert _text_quant("wan_1_3b") == expect, raw
+    monkeypatch.setenv("WAN_TEXT_QUANT", "")
+    assert _text_quant("tiny") is None  # tiny tests stay unquantised
+    monkeypatch.setenv("WAN_TEXT_QUANT", "fp8")
+    with pytest.raises(ValueError, match="WAN_TEXT_QUANT"):
+        _text_quant("wan_1_3b")
+
+
 def test_models_dir_discovery(tmp_path):
     from tpustack.serving.graph_server import WanRuntime
 

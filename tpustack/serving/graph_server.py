@@ -117,6 +117,24 @@ class OutputFile:
 
 
 # --------------------------------------------------------------------- runtime
+def _text_quant(preset: str) -> Optional[str]:
+    """Resolve ``WAN_TEXT_QUANT``: serving default is the weight-only int8
+    umt5-xxl text tower (5.7 GB instead of 11.4 bf16 / 22.8 f32 — a
+    full-precision tower does not even COMPILE beside the DiT on a 16 GB
+    chip: XLA reports 30.9 GB HBM for the f32 build).  An empty/unset env
+    keeps the default; explicit ``none``/``off`` opts out (multi-chip
+    setups).  Called at server startup too, so a typo fails the pod at
+    deploy time instead of erroring every /prompt."""
+    raw = os.environ.get("WAN_TEXT_QUANT", "").strip().lower()
+    if raw in ("none", "off"):
+        return None
+    if raw == "":
+        return None if preset == "tiny" else "int8"
+    if raw != "int8":
+        raise ValueError(f"WAN_TEXT_QUANT={raw!r} unsupported (int8|none)")
+    return raw
+
+
 class WanRuntime:
     """Owns the (lazily built) pipeline + models/output directories."""
 
@@ -165,19 +183,7 @@ class WanRuntime:
                 preset = os.environ.get("WAN_PRESET", "wan_1_3b")
                 cfg = (WanConfig.tiny() if preset == "tiny"
                        else WanConfig.wan_1_3b())
-                # serving default: umt5-xxl text tower in weight-only int8
-                # (5.7 GB instead of 11.4 bf16 / 22.8 f32 — a full-precision
-                # tower does not even COMPILE beside the DiT on a 16 GB
-                # chip: XLA reports 30.9 GB HBM for the f32 build).
-                # WAN_TEXT_QUANT=none opts out for multi-chip setups.
-                tq = os.environ.get(
-                    "WAN_TEXT_QUANT", "" if preset == "tiny" else "int8")
-                tq = (tq or "").lower() or None
-                if tq in ("none", "off"):
-                    tq = None
-                if tq not in (None, "int8"):
-                    raise ValueError(
-                        f"WAN_TEXT_QUANT={tq!r} unsupported (int8|none)")
+                tq = _text_quant(preset)
                 if tq:
                     cfg = dataclasses.replace(
                         cfg, text=dataclasses.replace(cfg.text, quant=tq))
@@ -656,6 +662,7 @@ def main() -> None:
     # the full multi-minute Wan compile
     enable_compile_cache()
     runtime.available()  # build/load the native PNG encoder before serving
+    _text_quant(os.environ.get("WAN_PRESET", "wan_1_3b"))  # fail fast on typo
     port = int(os.environ.get("PORT", "8181"))
     server = GraphServer()
     log.info("Wan graph server on :%d (models=%s, outputs=%s)",
