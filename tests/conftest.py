@@ -51,8 +51,9 @@ def pytest_configure(config):
         # backend (its sharding tests assume 8 virtual devices): an explicit
         # command-line -m narrows WITHIN the tpu tier; anything else —
         # including addopts' default "-m 'not slow'" — becomes plain "tpu".
-        cli_m = any(a == "-m" or a.startswith("-m=")
-                    for a in config.invocation_params.args)
+        cli_m = any(a == "-m" or (a.startswith("-m") and
+                                  not a.startswith("--"))
+                    for a in config.invocation_params.args)  # incl. -mEXPR
         user = config.option.markexpr
         config.option.markexpr = (f"({user}) and tpu"
                                   if cli_m and user else "tpu")

@@ -223,6 +223,29 @@ def test_back_to_back_prompts_pipeline_through_worker(server):
                 body = await r.read()
                 assert body[:4] == b"RIFF" and body[8:12] == b"WEBP"
             assert entries[bad_pid]["status"]["status_str"] == "error"
+
+            # starvation guard: a good prompt followed by a burst of failing
+            # ones must still get its deferred saves finalized (the failure
+            # path finalizes the in-flight entry instead of skipping it)
+            r = await http.post("/prompt", json={
+                "prompt": _tiny_graph(seed=21), "client_id": "t"})
+            good = (await r.json())["prompt_id"]
+            for _ in range(3):
+                await http.post("/prompt", json={
+                    "prompt": {"1": {"class_type": "KSampler", "inputs": {}}},
+                    "client_id": "t"})
+            for _ in range(600):
+                r = await http.get(f"/history/{good}")
+                hist = await r.json()
+                if good in hist and hist[good]["status"]["completed"]:
+                    break
+                await asyncio.sleep(0.2)
+            assert hist[good]["status"]["status_str"] == "success", \
+                hist[good]["status"]
+            name = client_mod.result_files(hist[good])[0]["filename"]
+            r = await http.get("/view", params={
+                "filename": name, "subfolder": "", "type": "output"})
+            assert (await r.read())[:4] == b"RIFF"
         finally:
             await http.close()
 

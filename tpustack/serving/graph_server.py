@@ -554,6 +554,10 @@ class GraphServer:
                     entry.messages.append(f"{type(e).__name__}: {e}")
                     entry.completed = True
                     self._running.remove(pid)
+                if in_flight is not None:
+                    # a stream of failing prompts must not starve the
+                    # previous prompt's deferred saves
+                    in_flight = self._finalize(*in_flight)
                 continue
             # this prompt's compute is now queued on device; finalize the
             # PREVIOUS one while it runs
