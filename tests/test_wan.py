@@ -27,6 +27,46 @@ def pipe():
 
 
 # ----------------------------------------------------------------- components
+def test_serving_config_fits_hbm_at_eval_shape(monkeypatch):
+    """Regression guard for the graph-server OOM found in r3: the serving
+    default (full wan_1_3b DiT + int8 umt5-xxl text tower) must fit a 16 GB
+    v5e param budget, and the UNQUANTISED tower must provably NOT — that is
+    why WAN_TEXT_QUANT=int8 is load-bearing (graph_server._text_quant)."""
+    import dataclasses
+
+    from tpustack.serving.graph_server import _text_quant
+
+    # the config serving actually resolves with no env override must BE the
+    # int8 default this test proves fits
+    monkeypatch.delenv("WAN_TEXT_QUANT", raising=False)
+    assert _text_quant("wan_1_3b") == "int8"
+
+    cfg = WanConfig.wan_1_3b()
+
+    def param_bytes(module, *args):
+        tree = jax.eval_shape(
+            lambda: module.init(jax.random.PRNGKey(0), *args))["params"]
+        return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(tree))
+
+    ids = jnp.zeros((1, cfg.text.max_length), jnp.int32)
+    lat = jnp.zeros((1, 1, 4, 4, cfg.dit.in_channels), jnp.float32)
+    ctx = jnp.zeros((1, cfg.text.max_length, cfg.dit.text_dim), jnp.float32)
+    dit_b = param_bytes(WanDiT(cfg.dit, dtype=cfg.compute_dtype), lat,
+                        jnp.zeros((1,), jnp.float32), ctx)
+
+    int8_text = dataclasses.replace(cfg.text, quant="int8")
+    text8_b = param_bytes(UMT5Encoder(int8_text, dtype=cfg.compute_dtype),
+                          ids)
+    text32_b = param_bytes(UMT5Encoder(cfg.text, dtype=cfg.compute_dtype),
+                           ids)
+
+    budget = 16e9 * 0.9  # leave workspace for the fused generate program
+    assert dit_b + text8_b < budget, (dit_b, text8_b)
+    assert dit_b + text32_b > budget, (
+        "unquantised umt5-xxl now fits — WAN_TEXT_QUANT's load-bearing "
+        "comment and the graph-server default need revisiting")
+
+
 def test_latent_shape_math():
     cfg = WanConfig.wan_1_3b()
     # 81 frames, 512x320 → (81-1)/4+1=21 latent frames, /8 spatial, z=16
