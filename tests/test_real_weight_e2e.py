@@ -200,9 +200,8 @@ def test_wan_train_export_serve_parity(tmp_path, monkeypatch):
                            steps=1, seed=9, width=32, height=32,
                            guidance_scale=6.0)
 
-    # serving path: WanRuntime maps the exported checkpoint in from
-    # models_dir; the VAE has no checkpoint format (own architecture) and
-    # its seed-0 init matches the reference pipeline's
+    # serving path: WanRuntime maps the exported checkpoints in from
+    # models_dir — all three files (DiT + UMT5 + the checkpoint-mapped VAE)
     monkeypatch.setenv("WAN_PRESET", "tiny")
     rt = WanRuntime(models_dir=str(models), output_dir=str(tmp_path / "out"))
     server = GraphServer(runtime=rt)

@@ -136,6 +136,59 @@ def test_vae3d_temporal_causality():
     assert not np.array_equal(base[:, -1], pert[:, -1])
 
 
+def test_wanvae_shapes_and_frame_convention():
+    """Checkpoint-mapped arch: 9 px frames <-> 3 latent frames, decode
+    returns 1 + 4(F'-1)."""
+    from tpustack.models.wan.wanvae import WanVAEDecoder, WanVAEEncoder
+
+    cfg = CFG.vae
+    enc, dec = WanVAEEncoder(cfg), WanVAEDecoder(cfg)
+    x = jnp.zeros((1, 9, 32, 32, 3))
+    pe = enc.init(jax.random.PRNGKey(0), x)["params"]
+    moments = enc.apply({"params": pe}, x)
+    assert moments.shape == (1, 3, 4, 4, 2 * cfg.z_channels)
+    z = moments[..., : cfg.z_channels]
+    pd = dec.init(jax.random.PRNGKey(1), z)["params"]
+    out = dec.apply({"params": pd}, z)
+    assert out.shape == (1, 9, 32, 32, 3)
+
+
+def test_wanvae_temporal_causality():
+    """Decoder frame blocks must not depend on later latent frames (the
+    streaming torch reference decodes latent-frame-at-a-time, so any
+    look-ahead would diverge from it)."""
+    from tpustack.models.wan.wanvae import WanVAEDecoder
+
+    cfg = CFG.vae
+    dec = WanVAEDecoder(cfg)
+    z = jax.random.normal(jax.random.PRNGKey(2), (1, 3, 4, 4, cfg.z_channels))
+    params = dec.init(jax.random.PRNGKey(0), z)["params"]
+    base = np.asarray(dec.apply({"params": params}, z))
+    z2 = z.at[:, 2:].set(jax.random.normal(jax.random.PRNGKey(3),
+                                           (1, 1, 4, 4, cfg.z_channels)))
+    pert = np.asarray(dec.apply({"params": params}, z2))
+    # latent frame 0 → px frame 0; latent frame 1 → px 1..4; frame 2 → 5..8
+    np.testing.assert_array_equal(base[:, :5], pert[:, :5])
+    assert not np.array_equal(base[:, 5:], pert[:, 5:])
+
+
+def test_wanvae_latent_stats_applied():
+    """arch='wan' decode de-normalizes with the per-channel stats; the
+    normalize helper inverts it."""
+    import dataclasses
+
+    from tpustack.models.wan.wanvae import latent_stats, normalize_latents
+
+    cfg = dataclasses.replace(CFG.vae, latent_mean=(0.5,) * CFG.vae.z_channels,
+                              latent_std=(2.0,) * CFG.vae.z_channels)
+    mean, std = latent_stats(cfg)
+    mu = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 2, 2, cfg.z_channels))
+    z = normalize_latents(cfg, mu)
+    np.testing.assert_allclose(np.asarray(z * std + mean), np.asarray(mu),
+                               atol=1e-6)
+    assert latent_stats(CFG.vae) is None  # tiny config carries no stats
+
+
 def test_dit_shapes_and_rope():
     cfg = CFG.dit
     head_dim = cfg.dim // cfg.num_heads

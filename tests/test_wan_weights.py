@@ -15,7 +15,8 @@ from safetensors.numpy import save_file
 from tpustack.models.wan import WanConfig, WanPipeline
 from tpustack.models.wan.weights import (WanWeightsError, convert_state_dict,
                                          dit_key, load_wan_safetensors,
-                                         make_fake_wan_state_dict, umt5_key)
+                                         make_fake_wan_state_dict, umt5_key,
+                                         vae_decoder_key, vae_encoder_key)
 from tpustack.utils.tree import flatten_dict
 
 CFG = WanConfig.tiny()
@@ -56,6 +57,35 @@ def test_umt5_roundtrip(pipe):
     assert _tree_shapes(loaded) == _tree_shapes(pipe.params["text_encoder"])
 
 
+def test_vae_roundtrip(pipe):
+    """Both VAE trees export into ONE wan_2.1_vae-layout file and convert
+    back; key names follow the torch Sequential indexing (cross-validated
+    against real torch modules in tests/test_wanvae_torch_ref.py)."""
+    vae_tree = {"vae_decoder": pipe.params["vae_decoder"],
+                "vae_encoder": pipe.params["vae_encoder"]}
+    state = make_fake_wan_state_dict(vae_tree, "vae")
+    # top-level 1x1x1 convs + both halves present
+    assert "conv1.weight" in state and "conv2.weight" in state
+    assert "decoder.conv1.weight" in state
+    assert "decoder.middle.1.to_qkv.weight" in state
+    assert "decoder.head.0.gamma" in state
+    # tiny has num_res_blocks=1 → first encoder resample sits at index 1
+    # (real nrb=2 checkpoint: index 2 — indices are emitted, not hardcoded)
+    assert "encoder.downsamples.1.resample.1.weight" in state
+    # upsample3d time conv exists exactly where temporal upsampling happens
+    assert any(k.endswith("time_conv.weight") and k.startswith("decoder.")
+               for k in state)
+    # RMS norm gammas keep the torch broadcast shapes
+    assert state["decoder.head.0.gamma"].ndim == 4  # (C,1,1,1)
+    assert state["decoder.middle.1.norm.gamma"].ndim == 3  # (C,1,1)
+    dec = convert_state_dict(pipe.params["vae_decoder"], state,
+                             vae_decoder_key)
+    enc = convert_state_dict(pipe.params["vae_encoder"], state,
+                             vae_encoder_key)
+    assert _tree_shapes(dec) == _tree_shapes(pipe.params["vae_decoder"])
+    assert _tree_shapes(enc) == _tree_shapes(pipe.params["vae_encoder"])
+
+
 def test_convert_fails_loudly_on_missing_and_misshaped(pipe):
     state = make_fake_wan_state_dict(pipe.params["dit"], "dit")
     del state["patch_embedding.weight"]
@@ -68,16 +98,20 @@ def test_convert_fails_loudly_on_missing_and_misshaped(pipe):
 
 
 def test_load_from_models_dir_and_output_changes(pipe, tmp_path):
-    """End-to-end: safetensors on disk → loaded params → different video."""
-    for sub, model, tmpl in (("diffusion_models", "dit", pipe.params["dit"]),
-                             ("text_encoders", "umt5",
-                              pipe.params["text_encoder"])):
+    """End-to-end: ComfyUI-layout dir with ALL THREE files → loaded params →
+    different video; a missing VAE file refuses loudly (no partial mode)."""
+    vae_tree = {"vae_decoder": pipe.params["vae_decoder"],
+                "vae_encoder": pipe.params["vae_encoder"]}
+    for sub, name, model, tmpl in (
+            ("diffusion_models", "wan2.1_t2v_1.3B_bf16.safetensors", "dit",
+             pipe.params["dit"]),
+            ("text_encoders", "umt5_xxl_fp16.safetensors", "umt5",
+             pipe.params["text_encoder"]),
+            ("vae", "wan_2.1_vae.safetensors", "vae", vae_tree)):
         d = tmp_path / sub
         d.mkdir()
-        state = make_fake_wan_state_dict(tmpl, model, seed=99)
-        name = ("wan2.1_t2v_1.3B_bf16.safetensors" if model == "dit"
-                else "umt5_xxl_fp16.safetensors")
-        save_file(state, str(d / name))
+        save_file(make_fake_wan_state_dict(tmpl, model, seed=99),
+                  str(d / name))
 
     params = load_wan_safetensors(str(tmp_path), CFG, pipe.params)
     base, _ = pipe.generate("a panda", frames=1, steps=1, width=32, height=32,
@@ -87,11 +121,13 @@ def test_load_from_models_dir_and_output_changes(pipe, tmp_path):
                                   height=32, seed=0)
     assert out.shape == base.shape
     assert not np.array_equal(out, base)  # weights actually took effect
+    # the mapped VAE decoder took effect too (not just DiT/text)
+    half = dict(params, vae_decoder=pipe.params["vae_decoder"])
+    out2, _ = WanPipeline(CFG, params=half).generate(
+        "a panda", frames=1, steps=1, width=32, height=32, seed=0)
+    assert not np.array_equal(out, out2)
 
-    # a present-but-unmapped VAE file must refuse unless allow_partial
-    vdir = tmp_path / "vae"
-    vdir.mkdir()
-    (vdir / "wan_2.1_vae.safetensors").write_bytes(b"x")
-    with pytest.raises(WanWeightsError, match="VAE"):
+    # all three files are mandatory — removing the VAE refuses loudly
+    (tmp_path / "vae" / "wan_2.1_vae.safetensors").unlink()
+    with pytest.raises(FileNotFoundError, match="VAE"):
         load_wan_safetensors(str(tmp_path), CFG, pipe.params)
-    load_wan_safetensors(str(tmp_path), CFG, pipe.params, allow_partial=True)

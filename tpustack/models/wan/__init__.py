@@ -9,6 +9,7 @@ which the reference never actually ships a server or model for.
 from tpustack.models.wan.config import (UMT5Config, WanConfig, WanDiTConfig,
                                         WanVAEConfig)
 from tpustack.models.wan.pipeline import WanPipeline
+from tpustack.models.wan.wanvae import WanVAEDecoder, WanVAEEncoder
 
 __all__ = ["WanConfig", "WanDiTConfig", "WanVAEConfig", "UMT5Config",
-           "WanPipeline"]
+           "WanPipeline", "WanVAEDecoder", "WanVAEEncoder"]

@@ -18,6 +18,15 @@ from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
+# Per-channel stats of the z=16 Wan 2.1 latent space (code-side constants
+# upstream as well — they are not stored in the VAE checkpoint file).
+WAN21_LATENT_MEAN: Tuple[float, ...] = (
+    -0.7571, -0.7089, -0.9113, 0.1075, -0.1745, 0.9653, -0.1517, 1.5508,
+    0.4134, -0.0715, 0.5517, -0.3632, -0.1922, -0.9497, 0.2503, -0.2921)
+WAN21_LATENT_STD: Tuple[float, ...] = (
+    2.8184, 1.4541, 2.3275, 2.6558, 1.2196, 1.7708, 2.6052, 2.0743,
+    3.2687, 2.1526, 2.8652, 1.5579, 1.6382, 1.1253, 2.8251, 1.9160)
+
 
 @dataclasses.dataclass(frozen=True)
 class UMT5Config:
@@ -41,15 +50,31 @@ class UMT5Config:
 
 @dataclasses.dataclass(frozen=True)
 class WanVAEConfig:
-    """Causal 3D video VAE: 8x spatial, 4x temporal compression, z=16."""
+    """Causal 3D video VAE: 8x spatial, 4x temporal compression, z=16.
+
+    ``arch`` selects the implementation: ``"wan"`` is the checkpoint-mapped
+    Wan 2.1 architecture (:mod:`tpustack.models.wan.wanvae`) that loads the
+    reference's real ``wan_2.1_vae.safetensors``; ``"tpu"`` is this package's
+    own TPU-first design (:mod:`tpustack.models.wan.vae3d`), kept as an
+    opt-in alternative with no checkpoint format.
+    """
 
     z_channels: int = 16
     base_channels: int = 96
     channel_mults: Tuple[int, ...] = (1, 2, 4, 4)
     num_res_blocks: int = 2
-    # temporal downsampling happens at the first len(temporal_downsample)
-    # spatial downsamples that are marked True (Wan: 4x = two 2x stages)
+    # stages (in encoder order) whose downsample also halves time.  Wan 2.1:
+    # the LAST two of the three resamples (upstream temperal_downsample =
+    # [False, True, True]) — time reduction happens at the smaller spatial
+    # resolutions, and the decoder mirrors it as temperal_upsample
+    # [True, True, False] (time_convs at decoder.upsamples.{3,7})
     temporal_downsample: Tuple[bool, ...] = (False, True, True)
+    arch: str = "wan"
+    # the DiT works on (mu - mean) / std; None => identity (tiny configs,
+    # z != 16)
+    latent_mean: Optional[Tuple[float, ...]] = WAN21_LATENT_MEAN
+    latent_std: Optional[Tuple[float, ...]] = WAN21_LATENT_STD
+    # "tpu"-arch latent scaling only; the "wan" arch uses latent_mean/std
     scaling_factor: float = 1.0
 
     @property
@@ -103,7 +128,8 @@ class WanConfig:
                             head_dim=16, num_layers=2, max_length=16),
             vae=WanVAEConfig(z_channels=4, base_channels=8,
                              channel_mults=(1, 2, 4, 4), num_res_blocks=1,
-                             temporal_downsample=(False, True, True)),
+                             temporal_downsample=(False, True, True),
+                             latent_mean=None, latent_std=None),
             dit=WanDiTConfig(dim=32, ffn_dim=64, num_heads=2, num_layers=2,
                              in_channels=4, out_channels=4, text_dim=32,
                              freq_dim=32),

@@ -31,6 +31,7 @@ from tpustack.models.wan.scheduler import (FlowSchedule, canonical_sampler,
 from tpustack.models.wan.tokenizer import load_tokenizer
 from tpustack.models.wan.umt5 import UMT5Encoder
 from tpustack.models.wan.vae3d import VAE3DDecoder, VAE3DEncoder
+from tpustack.models.wan.wanvae import WanVAEDecoder, WanVAEEncoder
 from tpustack.utils import get_logger
 
 log = get_logger("models.wan.pipeline")
@@ -45,8 +46,12 @@ class WanPipeline:
         dtype = self.config.compute_dtype
         self.text_encoder = UMT5Encoder(self.config.text, dtype=dtype)
         self.dit = WanDiT(self.config.dit, dtype=dtype)
-        self.vae_decoder = VAE3DDecoder(self.config.vae, dtype=dtype)
-        self.vae_encoder = VAE3DEncoder(self.config.vae, dtype=dtype)
+        if self.config.vae.arch == "wan":  # checkpoint-mapped Wan 2.1 arch
+            self.vae_decoder = WanVAEDecoder(self.config.vae, dtype=dtype)
+            self.vae_encoder = WanVAEEncoder(self.config.vae, dtype=dtype)
+        else:  # "tpu": this package's own design (no checkpoint format)
+            self.vae_decoder = VAE3DDecoder(self.config.vae, dtype=dtype)
+            self.vae_encoder = VAE3DEncoder(self.config.vae, dtype=dtype)
         self.tokenizer = load_tokenizer(self.config.text.vocab_size,
                                         self.config.text.max_length)
         self.params = params if params is not None else self._random_init(seed)
@@ -100,8 +105,11 @@ class WanPipeline:
 
         x = jax.lax.fori_loop(0, num_steps, body, noise)
 
-        frames = self.vae_decoder.apply(
-            {"params": params["vae_decoder"]}, x / c.vae.scaling_factor)
+        if c.vae.arch == "wan":  # decoder owns de-normalization + conv2
+            frames = self.vae_decoder.apply({"params": params["vae_decoder"]}, x)
+        else:
+            frames = self.vae_decoder.apply(
+                {"params": params["vae_decoder"]}, x / c.vae.scaling_factor)
         frames = jnp.clip((frames.astype(jnp.float32) + 1.0) * 127.5, 0.0, 255.0)
         return jnp.round(frames).astype(jnp.uint8)
 

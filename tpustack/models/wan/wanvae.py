@@ -1,0 +1,285 @@
+"""Wan 2.1 3D causal VAE — the checkpoint-mapped architecture.
+
+This is the architecture of the reference's actual ``wan_2.1_vae.safetensors``
+(loaded by its graph via a VAELoader node, reference
+``generate_wan_t2v.py:98-103,347-349``): a causal 3D conv VAE with 8x spatial
+/ 4x temporal compression, z=16, dim=96, channel mults (1,2,4,4), two
+residual blocks per stage, spatial attention at the bottleneck, and
+RMS-style channel norms.  Checkpoint layout (torch module names):
+``encoder.*``, ``decoder.*`` plus two top-level 1x1x1 convs ``conv1``
+(post-encoder, on the 2z moments) and ``conv2`` (pre-decoder, on z) — see
+:mod:`tpustack.models.wan.weights` for the key mapping.
+
+**TPU-first execution model.**  The upstream torch implementation streams the
+video through the network one latent frame at a time, carrying a per-conv
+``feat_cache`` of the last two frames so every temporal conv stays causal
+across chunk boundaries.  That chunked loop is a GPU memory workaround, not
+part of the function being computed: with a kernel-3 left-zero-padded causal
+conv, streaming with a 2-frame cache computes *exactly* the same values as
+one full-sequence causal conv.  We therefore run the whole sequence as one
+static-shape XLA program (fori-free, fusable, MXU-friendly convs) and encode
+the two places where the streaming loop's first-chunk special cases change
+the math:
+
+- ``upsample3d``: the first latent frame bypasses the temporal doubling
+  entirely (the stream marks it ``'Rep'`` and never time-convs it), so
+  ``T' -> 1 + 2(T'-1)`` frames; later frames go through a causal kernel-3
+  time conv (zero history before frame 1, i.e. frame 0 is *excluded* from
+  the conv's receptive field) whose 2C outputs interleave into frame pairs.
+- ``downsample3d``: spatial stride-2 conv first, then the first frame passes
+  through unchanged and frames ``1..T-1`` reduce via a stride-2 VALID conv
+  over windows ``(x[2k-2], x[2k-1], x[2k])``.
+
+Frame counts: ``F = 1 + 4k`` pixel frames <-> ``F' = (F-1)/4 + 1`` latent
+frames, decode returns ``1 + 4(F'-1)`` frames — the ComfyUI convention the
+reference behaves under.
+
+The DiT exchanges *normalized* latents with this VAE: ``z_norm =
+(mu - mean) / std`` with the per-channel Wan 2.1 stats below (code-side
+constants upstream as well — they are not stored in the checkpoint file).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpustack.models.wan.config import (WAN21_LATENT_MEAN, WAN21_LATENT_STD,
+                                        WanVAEConfig)
+
+__all__ = ["WAN21_LATENT_MEAN", "WAN21_LATENT_STD", "WanVAEDecoder",
+           "WanVAEEncoder", "latent_stats", "normalize_latents"]
+
+
+def latent_stats(cfg: WanVAEConfig):
+    """(mean, std) f32 vectors for normalized-latent <-> VAE-latent maps, or
+    None when the config carries no stats (tiny test configs)."""
+    if cfg.latent_mean is None or cfg.latent_std is None:
+        return None
+    for name, vals in (("latent_mean", cfg.latent_mean),
+                       ("latent_std", cfg.latent_std)):
+        if len(vals) != cfg.z_channels:
+            raise ValueError(f"{name} has {len(vals)} entries for "
+                             f"z={cfg.z_channels}")
+    return (jnp.asarray(cfg.latent_mean, jnp.float32),
+            jnp.asarray(cfg.latent_std, jnp.float32))
+
+
+class WanRMSNorm(nn.Module):
+    """Upstream ``RMS_norm``: ``x / ||x||_C * sqrt(C) * gamma`` (no bias in
+    the VAE).  Channel-last here; the checkpoint's ``gamma`` is stored
+    ``(C,1,1,1)`` (video) / ``(C,1,1)`` (per-frame attn norm) and reshaped to
+    ``(C,)`` by the converter."""
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        gamma = self.param("gamma", nn.initializers.ones, (c,))
+        x32 = x.astype(jnp.float32)
+        norm = jnp.sqrt(jnp.maximum(
+            jnp.sum(x32 * x32, axis=-1, keepdims=True), 1e-24))
+        return ((x32 / norm) * (c ** 0.5) * gamma).astype(x.dtype)
+
+
+class WanCausalConv3d(nn.Module):
+    """3D conv, left-only (causal) temporal zero padding, SAME-style spatial
+    padding; ``causal_pad=False`` drops all temporal padding (the stride-2
+    ``downsample3d`` time conv runs VALID)."""
+
+    features: int
+    kernel: Tuple[int, int, int] = (3, 3, 3)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    causal_pad: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kt, kh, kw = self.kernel
+        pad = [((kt - 1) if self.causal_pad else 0, 0),
+               ((kh - 1) // 2, (kh - 1) // 2), ((kw - 1) // 2, (kw - 1) // 2)]
+        return nn.Conv(self.features, self.kernel, strides=self.stride,
+                       padding=pad, dtype=self.dtype)(x)
+
+
+class WanResBlock(nn.Module):
+    """``residual = conv3(silu(rms)) x2`` with a 1x1x1 ``skip`` conv exactly
+    when channels change (upstream ``ResidualBlock``)."""
+
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = WanRMSNorm(name="norm_1")(x)
+        h = WanCausalConv3d(self.features, dtype=self.dtype,
+                            name="conv_1")(nn.silu(h))
+        h = WanRMSNorm(name="norm_2")(h)
+        h = WanCausalConv3d(self.features, dtype=self.dtype,
+                            name="conv_2")(nn.silu(h))
+        if x.shape[-1] != self.features:
+            x = WanCausalConv3d(self.features, kernel=(1, 1, 1),
+                                dtype=self.dtype, name="skip")(x)
+        return x + h
+
+
+class WanAttnBlock(nn.Module):
+    """Per-frame single-head spatial self-attention over the full channel dim
+    (upstream ``AttentionBlock``: 1x1-conv qkv/proj, scale ``C^-0.5``)."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, f, hh, ww, c = x.shape
+        h = WanRMSNorm(name="norm")(x).reshape(b * f, hh * ww, c)
+        qkv = nn.Dense(3 * c, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        logits = jnp.einsum("bqc,bkc->bqk", q, k,
+                            preferred_element_type=jnp.float32) * (c ** -0.5)
+        h = jnp.einsum("bqk,bkc->bqc",
+                       jnp.asarray(nn.softmax(logits, axis=-1), v.dtype), v)
+        h = nn.Dense(c, dtype=self.dtype, name="proj")(h)
+        return x + h.reshape(b, f, hh, ww, c)
+
+
+def _nearest_up2x(x):
+    """'nearest-exact' at integer 2x == plain pixel repetition."""
+    return jnp.repeat(jnp.repeat(x, 2, axis=2), 2, axis=3)
+
+
+class WanResample(nn.Module):
+    """Upstream ``Resample``.  Channel behavior matches the checkpoint:
+    upsampling halves channels (``C -> C//2``), downsampling keeps them.
+
+    Temporal semantics (full-sequence equivalents of the streaming loop —
+    derivation in the module docstring): the first frame always bypasses the
+    time conv; ``up3d`` doubles frames ``1..T-1``; ``down3d`` halves them.
+    """
+
+    mode: str  # "up2d" | "up3d" | "down2d" | "down3d"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, f, hh, ww, c = x.shape
+        if self.mode == "up3d":
+            tc = WanCausalConv3d(2 * c, kernel=(3, 1, 1), dtype=self.dtype,
+                                 name="time_conv")
+            tail = x[:, 1:]
+            if f > 1:
+                y = tc(tail)
+                pair = jnp.stack([y[..., :c], y[..., c:]], axis=2)
+                x = jnp.concatenate(
+                    [x[:, :1], pair.reshape(b, 2 * (f - 1), hh, ww, c)], axis=1)
+            else:
+                # single-frame program: no doubling (the stream's 'Rep' first
+                # chunk) — still instantiate the conv so the param tree (and
+                # hence the checkpoint mapping) is shape-independent
+                tc(jnp.zeros((b, 1, hh, ww, c), x.dtype))
+        if self.mode in ("up2d", "up3d"):
+            x = _nearest_up2x(x)
+            bb, ff = x.shape[0], x.shape[1]
+            x = x.reshape(bb * ff, *x.shape[2:])
+            x = nn.Conv(c // 2, (3, 3), padding=[(1, 1), (1, 1)],
+                        dtype=self.dtype, name="conv")(x)
+            return x.reshape(bb, ff, *x.shape[1:])
+        # down: spatial first (asymmetric (0,1) pad + stride-2 VALID conv)
+        x = x.reshape(b * f, hh, ww, c)
+        x = nn.Conv(c, (3, 3), strides=(2, 2), padding=[(0, 1), (0, 1)],
+                    dtype=self.dtype, name="conv")(x)
+        x = x.reshape(b, f, *x.shape[1:])
+        if self.mode == "down3d":
+            tc = WanCausalConv3d(c, kernel=(3, 1, 1), stride=(2, 1, 1),
+                                 causal_pad=False, dtype=self.dtype,
+                                 name="time_conv")
+            if f > 2:
+                x = jnp.concatenate([x[:, :1], tc(x)], axis=1)
+            else:
+                tc(jnp.zeros((b, 3, *x.shape[2:]), x.dtype))
+        return x
+
+
+class WanVAEDecoder(nn.Module):
+    """Normalized latents ``[B, F', H', W', z]`` -> frames
+    ``[B, 1+4(F'-1), 8H', 8W', 3]`` (unclamped; callers clip to [-1, 1]).
+
+    Owns the pre-decoder pieces of the upstream top level: the latent
+    de-normalization (``z * std + mean``) and the ``conv2`` 1x1x1 conv, so
+    one `.apply` is the complete ComfyUI ``VAEDecode`` node.
+    """
+
+    cfg: WanVAEConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z):
+        c = self.cfg
+        stats = latent_stats(c)
+        if stats is not None:
+            mean, std = stats
+            z = (z.astype(jnp.float32) * std + mean).astype(z.dtype)
+        z = WanCausalConv3d(c.z_channels, kernel=(1, 1, 1), dtype=self.dtype,
+                            name="conv_z")(z)
+        mults = [c.channel_mults[-1]] + list(reversed(c.channel_mults))
+        dims = [c.base_channels * m for m in mults]
+        up3d = tuple(reversed(c.temporal_downsample))  # temporal_upsample
+        h = WanCausalConv3d(dims[0], dtype=self.dtype, name="conv_in")(z)
+        h = WanResBlock(dims[0], dtype=self.dtype, name="mid_res_0")(h)
+        h = WanAttnBlock(dtype=self.dtype, name="mid_attn")(h)
+        h = WanResBlock(dims[0], dtype=self.dtype, name="mid_res_1")(h)
+        n = 0
+        for i, out_dim in enumerate(dims[1:]):
+            for _ in range(c.num_res_blocks + 1):
+                h = WanResBlock(out_dim, dtype=self.dtype, name=f"up_{n}")(h)
+                n += 1
+            if i < len(c.channel_mults) - 1:
+                mode = "up3d" if up3d[i] else "up2d"
+                h = WanResample(mode, dtype=self.dtype, name=f"up_{n}")(h)
+                n += 1
+        h = WanRMSNorm(name="head_norm")(h)
+        return WanCausalConv3d(3, dtype=self.dtype,
+                               name="head_conv")(nn.silu(h))
+
+
+class WanVAEEncoder(nn.Module):
+    """Frames ``[B, 1+4k, H, W, 3]`` in [-1,1] -> raw moments
+    ``[B, k+1, H/8, W/8, 2z]`` (mu = first z channels; normalize with
+    :func:`normalize_latents`).  Includes the top-level ``conv1``
+    (``conv_quant``) so the output is exactly what upstream chunks."""
+
+    cfg: WanVAEConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        dims = [c.base_channels * m for m in [1] + list(c.channel_mults)]
+        h = WanCausalConv3d(dims[1], dtype=self.dtype, name="conv_in")(x)
+        n = 0
+        for i, out_dim in enumerate(dims[1:]):
+            for _ in range(c.num_res_blocks):
+                h = WanResBlock(out_dim, dtype=self.dtype, name=f"down_{n}")(h)
+                n += 1
+            if i < len(c.channel_mults) - 1:
+                mode = "down3d" if c.temporal_downsample[i] else "down2d"
+                h = WanResample(mode, dtype=self.dtype, name=f"down_{n}")(h)
+                n += 1
+        h = WanResBlock(dims[-1], dtype=self.dtype, name="mid_res_0")(h)
+        h = WanAttnBlock(dtype=self.dtype, name="mid_attn")(h)
+        h = WanResBlock(dims[-1], dtype=self.dtype, name="mid_res_1")(h)
+        h = WanRMSNorm(name="head_norm")(h)
+        h = WanCausalConv3d(2 * c.z_channels, dtype=self.dtype,
+                            name="head_conv")(nn.silu(h))
+        return WanCausalConv3d(2 * c.z_channels, kernel=(1, 1, 1),
+                               dtype=self.dtype, name="conv_quant")(h)
+
+
+def normalize_latents(cfg: WanVAEConfig, mu):
+    """VAE-space mu -> the normalized latents the DiT denoises."""
+    stats = latent_stats(cfg)
+    if stats is None:
+        return mu
+    mean, std = stats
+    return ((mu.astype(jnp.float32) - mean) / std).astype(mu.dtype)

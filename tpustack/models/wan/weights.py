@@ -1,24 +1,31 @@
-"""Wan2.1 / UMT5 checkpoint → tpustack weight conversion.
+"""Wan2.1 / UMT5 / Wan-VAE checkpoint → tpustack weight conversion.
 
 The reference's graph loads ``wan2.1_t2v_1.3B_bf16.safetensors`` +
-``umt5_xxl_fp16.safetensors`` through ComfyUI loader nodes (reference
-``generate_wan_t2v.py:347-349``); this module maps those checkpoints (the
-original Wan-repo tensor naming, which the ComfyUI repackage preserves) into
-this package's Flax param tree:
+``umt5_xxl_fp16.safetensors`` + ``wan_2.1_vae.safetensors`` through ComfyUI
+loader nodes (reference ``generate_wan_t2v.py:98-103,347-349``); this module
+maps those checkpoints (the original Wan-repo tensor naming, which the
+ComfyUI repackage preserves) into this package's Flax param trees:
 
-- torch Linear ``[O, I]``        → flax kernel ``[I, O]``
+- torch Linear ``[O, I]``             → flax kernel ``[I, O]``
 - torch Conv3d ``[O, I, kf, kh, kw]`` → flax kernel ``[kf, kh, kw, I, O]``
-- norm ``weight``/``bias``       → flax ``scale``/``bias``
+- torch Conv2d ``[O, I, kh, kw]``     → flax kernel ``[kh, kw, I, O]``
+- torch 1x1 Conv2d ``[O, I, 1, 1]``   → flax Dense kernel ``[I, O]``
+- norm ``weight``/``bias``            → flax ``scale``/``bias``
+- VAE ``RMS_norm`` ``gamma`` ``(C,1,1,1)``/``(C,1,1)`` → flax ``(C,)``
 
 Like the SD15 converter, the mapping is *driven by our param tree*: every
 leaf computes its expected checkpoint key, so a missing or mis-shaped tensor
-fails loudly with the exact key, never a silent random init.
+fails loudly with the exact key, never a silent random init.  All three
+checkpoints are required — there is no partial-load escape hatch.
 
-The 3D VAE is **not** mapped: this package's VAE is its own TPU-first
-architecture, not a clone of Wan's (``tpustack.models.wan.vae3d``).  Loading
-a real ``wan_2.1_vae.safetensors`` therefore raises unless
-``allow_partial=True`` (env ``WAN_WEIGHTS_PARTIAL=1``), which keeps the
-random-init VAE and logs the degradation prominently.
+The VAE mapping targets the checkpoint-native architecture
+(``tpustack.models.wan.wanvae``, config ``arch="wan"``): top-level ``conv1``
+(our encoder's ``conv_quant``) / ``conv2`` (our decoder's ``conv_z``) plus
+``encoder.*`` / ``decoder.*`` with ``nn.Sequential`` integer indices
+(``residual.{0,2,3,6}``, ``upsamples.{n}``, ``middle.{0,1,2}``,
+``head.{0,2}``).  The package's own TPU-first VAE (``arch="tpu"``,
+``tpustack.models.wan.vae3d``) has no checkpoint format and cannot load
+real weights.
 """
 
 from __future__ import annotations
@@ -50,6 +57,22 @@ def _t(w):  # torch Linear → flax Dense kernel
 
 def _conv3d(w):  # torch [O, I, kf, kh, kw] → flax [kf, kh, kw, I, O]
     return jnp.transpose(w, (2, 3, 4, 1, 0))
+
+
+def _conv2d(w):  # torch [O, I, kh, kw] → flax [kh, kw, I, O]
+    return jnp.transpose(w, (2, 3, 1, 0))
+
+
+def _pw(w):  # torch 1x1 Conv2d [O, I, 1, 1] → flax Dense kernel [I, O]
+    return jnp.transpose(w[:, :, 0, 0])
+
+
+def _gamma3(w):  # VAE video RMS_norm gamma (C,1,1,1) → (C,)
+    return jnp.reshape(w, (-1,))
+
+
+def _gamma2(w):  # VAE per-frame attn RMS_norm gamma (C,1,1) → (C,)
+    return jnp.reshape(w, (-1,))
 
 
 # --------------------------------------------------------------------------
@@ -138,6 +161,70 @@ def umt5_key(path: Path) -> Tuple[str, Any]:
     raise KeyError(f"unmapped UMT5 path {'/'.join(path)}")
 
 
+def _vae_block(base: str, path: Path) -> Tuple[str, Any]:
+    """Sub-block mapping shared by encoder/decoder stages: our WanResBlock /
+    WanAttnBlock / WanResample param names → the checkpoint's Sequential
+    indices under ``base``."""
+    sub, leaf = path[1], path[-1]
+    ident = lambda w: w
+    wl = "weight" if leaf == "kernel" else "bias"
+    res = {"conv_1": "residual.2", "conv_2": "residual.6", "skip": "shortcut"}
+    if sub in res:
+        return f"{base}.{res[sub]}.{wl}", (_conv3d if leaf == "kernel" else ident)
+    if sub == "norm_1":
+        return f"{base}.residual.0.gamma", _gamma3
+    if sub == "norm_2":
+        return f"{base}.residual.3.gamma", _gamma3
+    if sub == "norm":  # attn block
+        return f"{base}.norm.gamma", _gamma2
+    if sub in ("qkv", "proj"):
+        name = "to_qkv" if sub == "qkv" else "proj"
+        return f"{base}.{name}.{wl}", (_pw if leaf == "kernel" else ident)
+    if sub == "conv":  # resample spatial conv (2D)
+        return f"{base}.resample.1.{wl}", (_conv2d if leaf == "kernel" else ident)
+    if sub == "time_conv":
+        return f"{base}.time_conv.{wl}", (_conv3d if leaf == "kernel" else ident)
+    raise KeyError(f"unmapped VAE sub-block path {base}/{'/'.join(path)}")
+
+
+_VAE_MID = {"mid_res_0": "middle.0", "mid_attn": "middle.1",
+            "mid_res_1": "middle.2"}
+
+
+def _vae_key(path: Path, side: str, io_conv: str) -> Tuple[str, Any]:
+    path = tuple(p for p in path if p != "Conv_0")  # WanCausalConv3d wrapper
+    head, leaf = path[0], path[-1]
+    ident = lambda w: w
+    wl = "weight" if leaf == "kernel" else "bias"
+    if head in ("conv_z", "conv_quant"):  # top-level 1x1x1 convs
+        return f"{io_conv}.{wl}", (_conv3d if leaf == "kernel" else ident)
+    if head == "conv_in":
+        return f"{side}.conv1.{wl}", (_conv3d if leaf == "kernel" else ident)
+    if head == "head_norm":
+        return f"{side}.head.0.gamma", _gamma3
+    if head == "head_conv":
+        return f"{side}.head.2.{wl}", (_conv3d if leaf == "kernel" else ident)
+    if head in _VAE_MID:
+        return _vae_block(f"{side}.{_VAE_MID[head]}", path)
+    if head.startswith("up_") or head.startswith("down_"):
+        n = int(head.split("_")[1])
+        seq = "upsamples" if side == "decoder" else "downsamples"
+        return _vae_block(f"{side}.{seq}.{n}", path)
+    raise KeyError(f"unmapped VAE path {'/'.join(path)}")
+
+
+def vae_decoder_key(path: Path) -> Tuple[str, Any]:
+    """Map our WanVAEDecoder param path (incl. ``conv_z`` = the top-level
+    pre-decoder ``conv2``) to (wan_2.1_vae checkpoint key, transform)."""
+    return _vae_key(path, "decoder", "conv2")
+
+
+def vae_encoder_key(path: Path) -> Tuple[str, Any]:
+    """Map our WanVAEEncoder param path (incl. ``conv_quant`` = the top-level
+    post-encoder ``conv1``) to (wan_2.1_vae checkpoint key, transform)."""
+    return _vae_key(path, "encoder", "conv1")
+
+
 def convert_state_dict(template: Tree, state: Dict[str, Any], key_fn) -> Tree:
     """Fill our param tree from a checkpoint dict; loud failure on mismatch."""
     out: Dict[Path, Any] = {}
@@ -167,11 +254,13 @@ def load_wan_safetensors(models_dir: str, config: WanConfig,
                          template_params: Tree, *,
                          unet_name: str = "wan2.1_t2v_1.3B_bf16.safetensors",
                          clip_name: str = "umt5_xxl_fp16.safetensors",
-                         allow_partial: bool = False) -> Tree:
-    """Load DiT + UMT5 checkpoints from a ComfyUI-layout models dir.
+                         vae_name: str = "wan_2.1_vae.safetensors") -> Tree:
+    """Load DiT + UMT5 + VAE checkpoints from a ComfyUI-layout models dir.
 
     ``models_dir`` follows the ComfyUI convention the reference's server used:
-    ``diffusion_models/``, ``text_encoders/``, ``vae/``.
+    ``diffusion_models/``, ``text_encoders/``, ``vae/``.  All three files are
+    required (the reference graph wires UNETLoader + CLIPLoader + VAELoader);
+    any missing or mismatched tensor fails loudly.
     """
     from safetensors import safe_open
 
@@ -187,9 +276,15 @@ def load_wan_safetensors(models_dir: str, config: WanConfig,
     params = dict(template_params)
     unet_path = os.path.join(models_dir, "diffusion_models", unet_name)
     clip_path = os.path.join(models_dir, "text_encoders", clip_name)
-    for label, path in (("DiT", unet_path), ("UMT5", clip_path)):
+    vae_path = os.path.join(models_dir, "vae", vae_name)
+    for label, path in (("DiT", unet_path), ("UMT5", clip_path),
+                        ("VAE", vae_path)):
         if not os.path.exists(path):
             raise FileNotFoundError(f"{label} weights not found at {path}")
+    if config.vae.arch != "wan":
+        raise WanWeightsError(
+            f"VAE arch {config.vae.arch!r} has no checkpoint format — real "
+            "wan_2.1_vae weights require WanVAEConfig(arch='wan')")
 
     # UMT5 loads FIRST: quantising umt5-xxl transiently needs the bf16
     # encoder (~11.4 GB) on the chip, which only fits while nothing else is
@@ -222,25 +317,38 @@ def load_wan_safetensors(models_dir: str, config: WanConfig,
                                        dit_key)
     log.info("Loaded Wan DiT weights from %s", unet_path)
 
-    vae_dir = os.path.join(models_dir, "vae")
-    if os.path.isdir(vae_dir) and os.listdir(vae_dir):
-        msg = ("a VAE checkpoint is present but this package's 3D VAE is its "
-               "own architecture — it stays randomly initialised (output "
-               "quality will be degraded until the VAE port lands)")
-        if not allow_partial:
-            raise WanWeightsError(msg + "; set WAN_WEIGHTS_PARTIAL=1 to serve "
-                                        "anyway")
-        log.warning("PARTIAL WEIGHTS: %s", msg)
+    vae_state = read(vae_path)
+    params["vae_decoder"] = convert_state_dict(
+        template_params["vae_decoder"], vae_state, vae_decoder_key)
+    params["vae_encoder"] = convert_state_dict(
+        template_params["vae_encoder"], vae_state, vae_encoder_key)
+    log.info("Loaded Wan VAE weights from %s", vae_path)
     return params
 
 
 def export_wan_state_dict(params: Tree, model: str) -> Dict[str, np.ndarray]:
-    """Inverse of :func:`convert_state_dict` for ``dit``/``umt5``: our tree →
-    checkpoint-layout keys and torch tensor layouts, value preserving."""
-    key_fn = {"dit": dit_key, "umt5": umt5_key}[model]
+    """Inverse of :func:`convert_state_dict`: our tree → checkpoint-layout
+    keys and torch tensor layouts, value preserving.  ``model`` is one of
+    ``dit``/``umt5``/``vae_decoder``/``vae_encoder``, or ``vae`` with
+    ``params = {"vae_decoder": ..., "vae_encoder": ...}`` to produce the
+    single-file wan_2.1_vae layout."""
+    if model == "vae":
+        out = export_wan_state_dict(params["vae_decoder"], "vae_decoder")
+        for k, v in export_wan_state_dict(params["vae_encoder"],
+                                          "vae_encoder").items():
+            if k in out:
+                raise WanWeightsError(f"VAE encoder/decoder key clash: {k!r}")
+            out[k] = v
+        return out
+    key_fn = {"dit": dit_key, "umt5": umt5_key, "vae_decoder": vae_decoder_key,
+              "vae_encoder": vae_encoder_key}[model]
     inverse = {  # flax→torch layout inverses
         "_t": lambda w: np.transpose(w),
         "_conv3d": lambda w: np.transpose(w, (4, 3, 0, 1, 2)),
+        "_conv2d": lambda w: np.transpose(w, (3, 2, 0, 1)),
+        "_pw": lambda w: np.transpose(w)[:, :, None, None],
+        "_gamma3": lambda w: np.reshape(w, (-1, 1, 1, 1)),
+        "_gamma2": lambda w: np.reshape(w, (-1, 1, 1)),
     }
     out: Dict[str, np.ndarray] = {}
     for path, leaf in _flatten(params).items():
@@ -262,18 +370,22 @@ def export_wan_state_dict(params: Tree, model: str) -> Dict[str, np.ndarray]:
 
 def save_wan_safetensors(models_dir: str, params: Tree, *,
                          unet_name: str = "wan2.1_t2v_1.3B_fp32.safetensors",
-                         clip_name: str = "umt5_xxl_fp32.safetensors") -> None:
-    """Write ``params['dit']``/``params['text_encoder']`` as a ComfyUI-layout
-    models dir readable by :func:`load_wan_safetensors` (the VAE is this
-    package's own architecture and has no checkpoint format — see module
-    docstring).  Default filenames say ``fp32`` because that is what the
-    numpy safetensors writer emits — the canonical bf16/fp16 names belong to
-    the upstream checkpoints; the runtime discovers either by listing."""
+                         clip_name: str = "umt5_xxl_fp32.safetensors",
+                         vae_name: str = "wan_2.1_vae.safetensors") -> None:
+    """Write ``params['dit']``/``params['text_encoder']``/the VAE trees as a
+    ComfyUI-layout models dir readable by :func:`load_wan_safetensors`.
+    DiT/text filenames say ``fp32`` because that is what the numpy
+    safetensors writer emits — the canonical bf16/fp16 names belong to the
+    upstream checkpoints; the runtime discovers either by listing.  The VAE
+    keeps the canonical name (it is the checkpoint-layout single file)."""
     from safetensors.numpy import save_file
 
+    vae_tree = {"vae_decoder": params["vae_decoder"],
+                "vae_encoder": params["vae_encoder"]}
     for sub, name, model, tree in (
             ("diffusion_models", unet_name, "dit", params["dit"]),
-            ("text_encoders", clip_name, "umt5", params["text_encoder"])):
+            ("text_encoders", clip_name, "umt5", params["text_encoder"]),
+            ("vae", vae_name, "vae", vae_tree)):
         d = os.path.join(models_dir, sub)
         os.makedirs(d, exist_ok=True)
         save_file(export_wan_state_dict(tree, model), os.path.join(d, name))

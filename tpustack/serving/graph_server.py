@@ -191,18 +191,19 @@ class WanRuntime:
                          preset, tq)
                 pipe = WanPipeline(cfg)
                 unets, clips = self.unet_names(), self.clip_names()
+                vaes = self.vae_names()
                 have_real = os.path.isdir(
                     os.path.join(self.models_dir, "diffusion_models"))
                 if have_real and unets and clips:
-                    # real checkpoints on the PVC → map them in (DiT + UMT5);
-                    # any mismatch raises rather than silently serving noise
+                    # real checkpoints on the PVC → map them in (DiT + UMT5 +
+                    # VAE); any mismatch raises rather than silently serving
+                    # noise — there is no partial-load mode
                     from tpustack.models.wan.weights import load_wan_safetensors
 
                     pipe.params = load_wan_safetensors(
                         self.models_dir, cfg, pipe.params,
                         unet_name=unets[0], clip_name=clips[0],
-                        allow_partial=os.environ.get("WAN_WEIGHTS_PARTIAL", "0")
-                        in ("1", "true"))
+                        vae_name=vaes[0] if vaes else CANONICAL_VAE)
                 elif not self._allow_random():
                     raise RuntimeError(
                         f"no Wan checkpoints under {self.models_dir} and "
