@@ -41,6 +41,9 @@ def main() -> int:
                         "continues until two consecutive intervals agree "
                         "(shared discipline with bench.py)")
     p.add_argument("--small", action="store_true", help="tiny smoke shape")
+    p.add_argument("--no-content-check", action="store_true",
+                   help="skip the tools/verify_hw.py wan-family content "
+                        "verification folded into the result")
     p.add_argument("--toy-text", action="store_true",
                    help="miniature text tower instead of the int8 umt5-xxl "
                         "shape (isolates the DiT+VAE number)")
@@ -119,14 +122,26 @@ def main() -> int:
         except Exception as e:
             log(f"[bench_wan] cost analysis unavailable: {e!r}")
 
-    print(json.dumps({
+    result = {
         "metric": f"wan21_1.3b_{args.width}x{args.height}x{args.frames}f_"
                   f"{args.steps}step_videos_per_hour_per_chip",
         "value": round(3600.0 / sec, 2),
         "unit": "videos/hour/chip",
         "seconds_per_video": round(sec, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
-    }))
+    }
+    if not args.small and not args.no_content_check:
+        # bench.py-style gating: the Wan number only counts if the chip
+        # provably computes the right frames (wan family: 3-file export→
+        # reload→denoise+mapped-VAE parity; flash family incl. the S=8320
+        # d=128 case this very workload's DiT runs)
+        import bench
+
+        result["content_check"] = bench._content_check(
+            log, families="wan,flash", workdir="verify_hw_wan",
+            out=os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "HWVERIFY_wan_r04.json"))
+    print(json.dumps(result))
     return 0
 
 

@@ -143,30 +143,57 @@ class LlamaAttention(nn.Module):
 
         if kv_cache is not None:
             quantized = "k_scale" in kv_cache
+            # cache_index may be a scalar (uniform write slot — prefill and
+            # the solo/shared-bucket decoders) or a [B] vector (continuous
+            # batching: every slot decodes at its OWN contiguous position;
+            # requires s == 1).  Vector writes use a one-hot select fused
+            # into one linear pass over the cache — NOT lax.scatter, which
+            # serializes on TPU (measured 7x decode slowdown), and decode
+            # attention streams the whole cache anyway so the extra write
+            # pass costs only the write-back bandwidth.
+            per_row = getattr(cache_index, "ndim", 0) == 1
+            if per_row:
+                hit = (jnp.arange(kv_cache["k"].shape[1])[None, :]
+                       == cache_index[:, None])  # [B, S]
+
+                def place(cache, new):  # new: [B, 1, ...] broadcast over S
+                    extra = (1,) * (cache.ndim - 2)
+                    return jnp.where(hit.reshape(hit.shape + extra),
+                                     new.astype(cache.dtype), cache)
             if quantized:
                 # int8 cache: quantise this call's K/V vectors as they are
                 # written; reads below keep int8 as the attention matmul
                 # operand and apply the scales outside the d-contraction
                 k_q, k_s = _quantize_kv(k)
                 v_q, v_s = _quantize_kv(v)
-                k_all = jax.lax.dynamic_update_slice(
-                    kv_cache["k"], k_q, (0, cache_index, 0, 0))
-                v_all = jax.lax.dynamic_update_slice(
-                    kv_cache["v"], v_q, (0, cache_index, 0, 0))
-                ks_all = jax.lax.dynamic_update_slice(
-                    kv_cache["k_scale"], k_s, (0, cache_index, 0))
-                vs_all = jax.lax.dynamic_update_slice(
-                    kv_cache["v_scale"], v_s, (0, cache_index, 0))
+                if per_row:
+                    k_all = place(kv_cache["k"], k_q)
+                    v_all = place(kv_cache["v"], v_q)
+                    ks_all = place(kv_cache["k_scale"], k_s)
+                    vs_all = place(kv_cache["v_scale"], v_s)
+                else:
+                    k_all = jax.lax.dynamic_update_slice(
+                        kv_cache["k"], k_q, (0, cache_index, 0, 0))
+                    v_all = jax.lax.dynamic_update_slice(
+                        kv_cache["v"], v_q, (0, cache_index, 0, 0))
+                    ks_all = jax.lax.dynamic_update_slice(
+                        kv_cache["k_scale"], k_s, (0, cache_index, 0))
+                    vs_all = jax.lax.dynamic_update_slice(
+                        kv_cache["v_scale"], v_s, (0, cache_index, 0))
                 new_cache = {"k": k_all, "k_scale": ks_all,
                              "v": v_all, "v_scale": vs_all}
             else:
-                # static-shape cache update at cache_index (decode: s == 1)
-                k_all = jax.lax.dynamic_update_slice(
-                    kv_cache["k"], k.astype(kv_cache["k"].dtype),
-                    (0, cache_index, 0, 0))
-                v_all = jax.lax.dynamic_update_slice(
-                    kv_cache["v"], v.astype(kv_cache["v"].dtype),
-                    (0, cache_index, 0, 0))
+                if per_row:
+                    k_all = place(kv_cache["k"], k)
+                    v_all = place(kv_cache["v"], v)
+                else:
+                    # static-shape cache update at cache_index (decode: s==1)
+                    k_all = jax.lax.dynamic_update_slice(
+                        kv_cache["k"], k.astype(kv_cache["k"].dtype),
+                        (0, cache_index, 0, 0))
+                    v_all = jax.lax.dynamic_update_slice(
+                        kv_cache["v"], v.astype(kv_cache["v"].dtype),
+                        (0, cache_index, 0, 0))
                 ks_all = vs_all = None
                 new_cache = {"k": k_all, "v": v_all}
             from_zero = isinstance(cache_index, int) and cache_index == 0

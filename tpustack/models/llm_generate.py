@@ -366,6 +366,89 @@ class Generator:
             step, (first_tok, caches, key), jnp.arange(n_steps))
         return toks.T, caches, key_out  # [B, n_steps]
 
+    # --------------------------------------------------- continuous batching
+    #
+    # A third decode layout for the CONTINUOUS batcher
+    # (tpustack.models.llm_continuous): B persistent slots, each with its own
+    # CONTIGUOUS cache line — row i writes at cur[i] (a [B] vector index, the
+    # per-row scatter path in LlamaAttention), attends [0, cur[i]] and takes
+    # RoPE position cur[i], exactly the solo decoder's layout per row.  Slots
+    # join (B=1 prefill inserted via _insert_cache_row) and retire at chunk
+    # boundaries without touching their peers; parked slots idle at position
+    # 0 (active=0 freezes cur) until reassigned.  Greedy rows are therefore
+    # bit-compatible with the solo path regardless of batch composition.
+
+    @functools.partial(jax.jit, static_argnums=(0, 10), donate_argnums=(5,))
+    def _decode_scan_cont(self, params, first_tok, cur, active, caches, key,
+                          temperature, top_k, greedy, n_steps: int):
+        """``n_steps`` continuous-slot decode iterations in ONE dispatch.
+
+        ``cur [B]``: per-slot write/attention frontier (advances only where
+        ``active``); clamped at max_seq-1 so host-side fetch lag can never
+        write out of bounds (a retiring row's overshoot steps rewrite its own
+        final cache slot, which its accepted tokens never attend)."""
+        S = self.cfg.max_seq
+
+        def step(carry, _):
+            tok, cur, caches, key = carry
+            positions = cur[:, None]
+            valid = (jnp.arange(S)[None, :] <= cur[:, None])[:, None, None, :]
+            logits, caches = self.model.apply(
+                {"params": params}, tok, positions, caches, cur, valid)
+            step_key, key = jax.random.split(key)
+            nxt = self._sample_from_logits(
+                logits[:, -1].astype(jnp.float32), step_key, temperature,
+                top_k, greedy)
+            cur = jnp.minimum(cur + active, S - 1)
+            return (nxt[:, None], cur, caches, key), nxt
+
+        (last, cur, caches, key), toks = jax.lax.scan(
+            step, (first_tok, cur, caches, key), None, length=n_steps)
+        return toks.T, last, cur, caches, key
+
+    @functools.partial(jax.jit, static_argnums=(0, 4, 5), donate_argnums=(1,))
+    def _insert_cache_rows(self, slot_caches, row_caches, slot_ids,
+                           n: int, bucket: int):
+        """Copy positions ``[0, bucket)`` of an n-row prefill cache into the
+        slot rows ``slot_ids[j]`` (all layers, K/V and int8 scales alike) —
+        ONE dispatch per admission wave, not one per tensor per row.  One
+        compiled program per (n, bucket)."""
+
+        def ins(dst, src):
+            src = jax.lax.slice_in_dim(src, 0, bucket, axis=1)
+            for j in range(n):
+                row = jax.lax.slice_in_dim(src, j, j + 1, axis=0)
+                idx = ((slot_ids[j],)
+                       + (jnp.zeros((), jnp.int32),) * (dst.ndim - 1))
+                dst = jax.lax.dynamic_update_slice(dst, row.astype(dst.dtype),
+                                                   idx)
+            return dst
+
+        return jax.tree.map(ins, slot_caches, row_caches)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _sample_logits_jit(self, logits, key, temperature, top_k, greedy):
+        """One-dispatch device sampling of prefill logits ([n, V] → [n]).
+        The continuous engine fetches only the n int32 tokens — fetching the
+        logits themselves for host sampling costs ~1 s per admission wave at
+        150k vocab over a tunnelled link (measured)."""
+        return self._sample_from_logits(logits, key, temperature, top_k,
+                                        greedy)
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(1, 2, 3, 4, 5, 6))
+    def _slot_update(self, cur, active, first, temp, topk, greedy, mask,
+                     new_cur, new_active, new_first, new_temp, new_topk,
+                     new_greedy):
+        """Apply per-slot state changes for the slots selected by ``mask``
+        ([B] bool) in ONE dispatch — admissions and retirements coalesce
+        their updates instead of paying a tunnel round-trip per array."""
+        pick = lambda a, b: jnp.where(mask, b, a)
+        return (pick(cur, new_cur), pick(active, new_active),
+                jnp.where(mask[:, None], new_first, first),
+                pick(temp, new_temp), pick(topk, new_topk),
+                pick(greedy, new_greedy))
+
     def generate_batch(
         self,
         prompts: List[List[int]],

@@ -23,12 +23,14 @@ Env: ``LLM_PRESET`` (``qwen25_7b``|``llama2_7b``|``tiny``), ``LLM_CTX``,
 lifting the per-chip HBM ceiling),
 ``LLM_KV_QUANT`` (``int8`` → per-vector int8 KV cache: halves long-context
 decode KV traffic and cache HBM),
-``LLM_CHUNK`` (decode tokens per fused dispatch, default 32; streaming
-batches cap at 16 for latency),
+``LLM_CHUNK`` (decode tokens per fused dispatch for the solo path, default
+32; the continuous engine runs at ``min(LLM_CHUNK, 16)`` — its chunk is
+also the admission/streaming cadence, so latency caps it),
 ``LLM_QUANT`` (``int8`` → weight-only quantised serving, the analog of the
 reference's Q4_K_M GGUF but ~2x decode from halved HBM traffic),
-``LLM_MAX_BATCH``/``LLM_BATCH_WINDOW_MS`` (slot-parallel micro-batching of
-concurrent non-streaming completions — llama.cpp ``--parallel`` analog),
+``LLM_MAX_BATCH`` (continuous-batching slot count — llama.cpp
+``--parallel`` analog; requests join/leave the running batch at chunk
+boundaries; ``LLM_BATCH_WINDOW_MS`` is a legacy no-op),
 ``MODEL_DIR`` (HF safetensors), ``LLM_TOKENIZER_DIR``, ``PORT`` (8080).
 """
 
@@ -128,20 +130,23 @@ class _PendingCompletion:
 
 
 class LLMServer:
-    """llama.cpp-surface LLM server with slot-parallel micro-batching.
+    """llama.cpp-surface LLM server with CONTINUOUS batching.
 
-    Non-streaming completions that arrive within ``LLM_BATCH_WINDOW_MS`` of
-    each other (up to ``LLM_MAX_BATCH``) decode as ONE batched device program
-    (``Generator.generate_batch``) — decode streams the weights once per step
-    regardless of batch size, so aggregate tokens/s scales ~linearly
-    (measured ~6.7x at batch 8, 7B int8).  The slot-parallel analog of the
-    reference server's ``--parallel`` (llama.cpp ``-np``), with the same
-    trade-off: batch peers share the context budget (a row's generation
-    capacity is ``max_seq - bucket(longest prompt in the batch)``).
+    Concurrent completions decode in persistent slots
+    (``tpustack.models.llm_continuous.ContinuousEngine``): a request
+    arriving mid-generation joins the running batch at the next
+    ``LLM_CHUNK``-token boundary (its prefill + KV splice happen while the
+    chain keeps flowing) and a finished row is answered and its slot freed
+    immediately — llama.cpp's slot semantics (reference server
+    ``--parallel``; deployment.yaml:67-84), not a collect-window batch.
+    Decode streams the weights once per step regardless of how many slots
+    are live, so aggregate tokens/s scales ~linearly with occupancy, and
+    each row's context budget is its own ``max_seq - len(prompt)`` (no
+    shared longest-peer bucket).
 
-    Kept solo (the existing one-at-a-time path): streaming requests
-    (per-token latency) and seeded non-greedy requests (reproducibility
-    would depend on batch composition).
+    Kept solo (the one-at-a-time path): seeded non-greedy requests
+    (reproducibility would depend on admission timing) and prompts longer
+    than half the context (they'd monopolize the slot cache).
     """
 
     def __init__(self, generator=None, tokenizer=None, model_name: str = "tpustack",
@@ -155,15 +160,22 @@ class LLMServer:
         self._lock = asyncio.Lock()
         self.max_batch = (int(os.environ.get("LLM_MAX_BATCH", "8"))
                           if max_batch is None else max_batch)
+        # legacy knob (pre-continuous window batching): accepted, unused
         self.batch_window_ms = (
-            float(os.environ.get("LLM_BATCH_WINDOW_MS", "25"))
+            float(os.environ.get("LLM_BATCH_WINDOW_MS", "0"))
             if batch_window_ms is None else batch_window_ms)
         # decode tokens per fused scan dispatch: larger chunks amortise the
         # per-dispatch tail (chunk 64 measured ~6% over 32 at 7B int8);
-        # stop-token waste is bounded at chunk-1 + 2 speculative chunks
+        # also the admission/streaming granularity of the continuous engine
         self.chunk = max(1, int(os.environ.get("LLM_CHUNK", "32")))
-        self._pending: Optional[asyncio.Queue] = None
+        import collections
+
+        self._queue: "collections.deque" = collections.deque()
+        self._wake: Optional[asyncio.Event] = None
         self._batch_task = None
+        # solo requests queued on the device lock; the engine stops
+        # admitting while > 0 so the FIFO-fair lock can hand over
+        self._solo_waiting = 0
 
     async def _run_on_device(self, fn, cancel: Optional[threading.Event] = None):
         """Run blocking ``fn`` in the executor under the generation lock, in
@@ -212,11 +224,14 @@ class LLMServer:
         return self.gen._bucket(len(ids)) <= self.gen.cfg.max_seq // 2
 
     async def _enqueue_raw(self, req: _PendingCompletion) -> None:
-        if self._pending is None:
-            self._pending = asyncio.Queue()
+        if self._wake is None:
+            self._wake = asyncio.Event()
         if self._batch_task is None or self._batch_task.done():
             self._batch_task = asyncio.create_task(self._batch_loop())
-        await self._pending.put(req)
+        # deque append is atomic — the engine thread polls this queue
+        # directly at chunk boundaries (continuous admission), no window
+        self._queue.append(req)
+        self._wake.set()
 
     async def _enqueue_completion(self, ids, n_predict, sample):
         loop = asyncio.get_running_loop()
@@ -228,102 +243,104 @@ class LLMServer:
             req.cancel.set()  # dropped if still queued; batch notices if all die
             raise
 
+    def _slot_request(self, r: _PendingCompletion, loop):
+        """Adapt a parked request into a ContinuousEngine SlotRequest."""
+        from tpustack.models.llm_continuous import SlotRequest
+
+        eos = self.tok.eos_id
+
+        def on_tokens(toks):
+            if r.stream_put is None:
+                return
+            for t in toks:  # engine already enforced budget/stop
+                if t != eos:
+                    r.stream_put(t)
+
+        def on_done(tokens, row_stats):
+            if tokens is None:  # admission-time validation failure
+                exc = ValueError(row_stats.get("error", "bad request"))
+                loop.call_soon_threadsafe(
+                    lambda: r.future.done() or r.future.set_exception(exc))
+            else:
+                loop.call_soon_threadsafe(
+                    lambda: r.future.done()
+                    or r.future.set_result((tokens, row_stats)))
+            if r.stream_put is not None:
+                r.stream_put(None)  # end-of-stream sentinel
+
+        return SlotRequest(ids=r.ids, max_new=r.n_predict, sample=r.sample,
+                           on_tokens=on_tokens, on_done=on_done,
+                           cancelled=r.cancel.is_set)
+
     async def _batch_loop(self):
-        """Collect concurrent requests for one window, decode them as one
-        batched program under the device lock, fan results back out."""
+        """Run the continuous engine whenever requests are queued: the
+        engine holds the device lock for the duration of a busy period,
+        admitting new arrivals at chunk boundaries and answering each row
+        the moment it finishes; it returns when all slots drain."""
+        from tpustack.models.llm_continuous import ContinuousEngine
+
         loop = asyncio.get_running_loop()
         while True:
-            batch = [await self._pending.get()]
-            deadline = loop.time() + self.batch_window_ms / 1e3
-            while len(batch) < self.max_batch:
-                wait = deadline - loop.time()
-                if wait <= 0:
-                    break
-                try:
-                    batch.append(await asyncio.wait_for(
-                        self._pending.get(), wait))
-                except asyncio.TimeoutError:
-                    break
-            batch = [r for r in batch if not r.cancel.is_set()]
-            if not batch:
+            await self._wake.wait()
+            self._wake.clear()
+            if not self._queue:
                 continue
 
-            def work(batch=batch):
-                eos = self.tok.eos_id
-                # mirror the engine's per-row budget (n_predict clamped to
-                # the shared capacity) so streamed emission stops exactly
-                # where the engine's own bookkeeping does
-                bucket = self.gen._bucket(max(len(r.ids) for r in batch))
-                capacity = self.gen.cfg.max_seq - bucket
-                budget = [min(r.n_predict, capacity) for r in batch]
-                emitted = [0] * len(batch)
-                # budget<=0 rows emit nothing (the engine returns [] for
-                # them — n_predict=0 must not stream a spurious token)
-                stream_done = [r.stream_put is None or budget[i] <= 0
-                               for i, r in enumerate(batch)]
+            handed = []
 
-                def on_chunk(block):
-                    # worker thread → event loop; tokens flow to streaming
-                    # rows as each fused dispatch lands (chunk granularity)
-                    for i, r in enumerate(batch):
-                        if stream_done[i]:
-                            continue
-                        for t in block[i]:
-                            t = int(t)
-                            emitted[i] += 1
-                            if t != eos:
-                                r.stream_put(t)
-                            if t == eos or emitted[i] >= budget[i]:
-                                stream_done[i] = True
-                                break
+            def work():
+                engine = ContinuousEngine(
+                    self.gen, slots=self.max_batch,
+                    # chunk = admission + SSE cadence, so cap it for latency
+                    # (same 16-token bound the window batcher used)
+                    chunk=min(self.chunk, 16),
+                    stop_tokens=(self.tok.eos_id,))
 
-                def row_done(i, tokens, row_stats):
-                    # from the worker thread, the moment row i stops: a
-                    # 1-token request doesn't wait for a 128-token peer
-                    r = batch[i]
-                    loop.call_soon_threadsafe(
-                        lambda: r.future.done()
-                        or r.future.set_result((tokens, row_stats)))
-                    if r.stream_put is not None:
-                        r.stream_put(None)  # end-of-stream sentinel
+                def feed():
+                    if self._solo_waiting > 0:
+                        # a solo request (seeded / over-long prompt) is
+                        # queued on the device lock: stop admitting so the
+                        # engine drains and the (FIFO-fair) lock hands over
+                        # — sustained batchable traffic must not starve it
+                        return None
+                    while self._queue:
+                        r = self._queue.popleft()
+                        if r.cancel.is_set():
+                            continue  # waiter already cancelled its future
+                        handed.append(r)
+                        return self._slot_request(r, loop)
+                    return None
 
-                has_stream = any(r.stream_put is not None for r in batch)
-                return self.gen.generate_batch(
-                    [r.ids for r in batch],
-                    [r.n_predict for r in batch],
-                    [r.sample for r in batch],
-                    # streaming rows see tokens at chunk granularity, so cap
-                    # their batches at the latency-friendly 16; pure
-                    # throughput batches ride the full LLM_CHUNK
-                    chunk=min(self.chunk, 16) if has_stream else self.chunk,
-                    stop_tokens=(self.tok.eos_id,),
-                    on_chunk=on_chunk if has_stream else None,
-                    on_row_done=row_done,
-                    cancel_check=lambda: all(r.cancel.is_set() for r in batch))
+                return engine.run(feed)
 
             def fail(exc):
-                for r in batch:
+                # a failed engine run must strand neither its admitted
+                # waiters (handed, futures not yet resolved) nor the queue
+                while self._queue:
+                    handed.append(self._queue.popleft())
+                for r in handed:
                     if not r.future.done():
                         r.future.set_exception(exc)
                     if r.stream_put is not None:
-                        r.stream_put(None)  # unblock SSE handlers (q.get)
+                        r.stream_put(None)
 
             try:
-                outs, stats = await self._run_on_device(work)
+                stats = await self._run_on_device(work)
             except asyncio.CancelledError:
-                # server shutdown: fail the waiters, then let the
-                # cancellation propagate so this task actually exits
                 fail(RuntimeError("server shutting down"))
                 raise
-            except Exception as e:  # fan the error out to every waiter
+            except Exception as e:
                 fail(e)
                 continue
-            log.info("batched completion: %d slots, %d gen tok, %.1f tok/s",
-                     stats["batch"], stats["generated_tokens"],
-                     stats["tokens_per_s"])
-            for r, out in zip(batch, outs):
-                if not r.future.done():
-                    r.future.set_result((out, stats))
+            finally:
+                if self._queue:
+                    # engine yielded with work left (solo preemption):
+                    # re-enter after the lock's FIFO queue services it
+                    self._wake.set()
+            if stats["requests"]:
+                log.info("continuous run: %d requests, %d gen tok, "
+                         "%.1f tok/s aggregate", stats["requests"],
+                         stats["generated_tokens"], stats["tokens_per_s"])
 
     async def _complete_routed(self, prompt: str, n_predict: int,
                                temperature: float, top_k: int, seed):
@@ -336,9 +353,13 @@ class LLMServer:
             raise ValueError("empty prompt")
         if not self._batchable(ids, temperature, seed):
             cancel = threading.Event()
-            return await self._run_on_device(
-                lambda: self._complete(ids, n_predict, temperature, top_k,
-                                       seed, False, cancel), cancel)
+            self._solo_waiting += 1  # engine yields the lock at its next
+            try:                     # chunk boundary (FIFO-fair handover)
+                return await self._run_on_device(
+                    lambda: self._complete(ids, n_predict, temperature, top_k,
+                                           seed, False, cancel), cancel)
+            finally:
+                self._solo_waiting -= 1
         sample = SampleConfig(temperature=temperature, top_k=top_k,
                               greedy=temperature <= 0)
         out_ids, stats = await self._enqueue_completion(ids, n_predict, sample)
@@ -347,16 +368,10 @@ class LLMServer:
             stopped_eos = True
         else:
             stopped_eos = False
-        # per-request view of the shared batch step: this row's token counts
-        # and its share of the decode rate; prefill/decode wall times are the
-        # batch's (what the request actually experienced)
-        n_gen = len(out_ids) + int(stopped_eos)
-        row_stats = dict(stats)
-        row_stats["prompt_tokens"] = len(ids)
-        row_stats["generated_tokens"] = n_gen
-        row_stats["tokens_per_s"] = (n_gen / stats["decode_s"]
-                                     if stats["decode_s"] > 0 else 0.0)
-        return self.tok.decode(out_ids), row_stats, stopped_eos
+        # the continuous engine reports true PER-ROW stats (each row has its
+        # own admit→retire wall time and token counts) — no shared-batch
+        # reconstruction needed
+        return self.tok.decode(out_ids), dict(stats), stopped_eos
 
     # ------------------------------------------------------------ helpers
     def _final_payload(self, stats, stopped_eos: bool, content: str) -> dict:
@@ -521,10 +536,14 @@ class LLMServer:
             locked_task.add_done_callback(
                 lambda f: f.cancelled() or f.exception())
         else:
+            self._solo_waiting += 1  # released when the solo run finishes
             locked_task = asyncio.ensure_future(
                 self._run_on_device(worker, cancel))
             locked_task.add_done_callback(
                 lambda t: t.cancelled() or t.exception())
+            locked_task.add_done_callback(
+                lambda t: setattr(self, "_solo_waiting",
+                                  self._solo_waiting - 1))
         try:
             if fmt == "openai":
                 await send(chat_chunk({"role": "assistant", "content": ""}))
