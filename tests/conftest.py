@@ -51,12 +51,22 @@ def pytest_configure(config):
         # backend (its sharding tests assume 8 virtual devices): an explicit
         # command-line -m narrows WITHIN the tpu tier; anything else —
         # including addopts' default "-m 'not slow'" — becomes plain "tpu".
-        cli_m = any(a == "-m" or (a.startswith("-m") and
-                                  not a.startswith("--"))
-                    for a in config.invocation_params.args)  # incl. -mEXPR
+        import shlex
+
+        def has_m(args):
+            return any(a == "-m" or (a.startswith("-m") and
+                                     not a.startswith("--"))
+                       for a in args)  # incl. the -mEXPR glued form
+
+        # a marker expression is user-provided if it came from the command
+        # line OR from PYTEST_ADDOPTS (parsed, not substring-matched — a
+        # stray --maxfail must not count, and an explicit "-m 'not slow'"
+        # must be honored even though it equals the ini default; ADVICE r3)
+        cli_m = has_m(config.invocation_params.args)
+        env_m = has_m(shlex.split(os.environ.get("PYTEST_ADDOPTS", "")))
         user = config.option.markexpr
         config.option.markexpr = (f"({user}) and tpu"
-                                  if cli_m and user else "tpu")
+                                  if (cli_m or env_m) and user else "tpu")
 
 
 def pytest_collection_modifyitems(config, items):

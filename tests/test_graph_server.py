@@ -252,6 +252,101 @@ def test_back_to_back_prompts_pipeline_through_worker(server):
     _run(scenario())
 
 
+def test_queued_prompts_batch_through_one_dispatch(tmp_path):
+    """Queue-depth > 1: two compatible prompts (same shape/steps/cfg/
+    sampler, different prompt+seed) submitted through the REAL client's
+    graphs fuse into ONE batched device program (generate_many_async), and
+    each row matches the prompt's solo output exactly."""
+    import threading
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.models.wan import WanConfig, WanPipeline
+    from tpustack.serving.graph_server import GraphServer, WanRuntime
+
+    pipe = WanPipeline(WanConfig.tiny())
+    rt = WanRuntime(models_dir=str(tmp_path / "m"),
+                    output_dir=str(tmp_path / "o"), pipeline=pipe)
+    srv = GraphServer(runtime=rt)
+    # stop the auto-started worker so both prompts are QUEUED before any
+    # dispatch — deterministic queue depth 2
+    srv._queue.put(None)
+    srv._worker.join(timeout=30)
+
+    calls = {"many": 0, "solo": 0}
+    real_many, real_solo = pipe.generate_many_async, pipe.generate_async
+
+    def spy_many(items, **kw):
+        calls["many"] += 1
+        assert len(items) == 2
+        return real_many(items, **kw)
+
+    def spy_solo(*a, **kw):
+        calls["solo"] += 1
+        return real_solo(*a, **kw)
+
+    pipe.generate_many_async, pipe.generate_async = spy_many, spy_solo
+
+    async def submit(http, graph):
+        r = await http.post("/prompt", json={"prompt": graph,
+                                             "client_id": "t"})
+        assert r.status == 200, await r.text()
+        return (await r.json())["prompt_id"]
+
+    async def scenario():
+        http = TestClient(TestServer(srv.build_app()))
+        await http.start_server()
+        try:
+            pa = await submit(http, _tiny_graph(prompt="a red panda", seed=5,
+                                                save_webp=False,
+                                                save_images=True))
+            pb = await submit(http, _tiny_graph(prompt="a blue robot", seed=9,
+                                                save_webp=False,
+                                                save_images=True))
+            # both queued; NOW run one worker pass
+            srv._worker = threading.Thread(target=srv._work, daemon=True)
+            srv._worker.start()
+            hists = {}
+            for pid in (pa, pb):
+                for _ in range(600):
+                    r = await http.get(f"/history/{pid}")
+                    h = await r.json()
+                    if pid in h and h[pid]["status"]["completed"]:
+                        hists[pid] = h[pid]
+                        break
+                    await asyncio.sleep(0.2)
+            return pa, pb, hists
+        finally:
+            await http.close()
+
+    try:
+        pa, pb, hists = _run(scenario())
+    finally:
+        pipe.generate_many_async, pipe.generate_async = real_many, real_solo
+        srv.shutdown()
+
+    assert calls["many"] == 1 and calls["solo"] == 0, calls
+    for pid in (pa, pb):
+        assert hists[pid]["status"]["status_str"] == "success", hists[pid]
+    # row parity: each batched row equals the solo generation for that
+    # (prompt, seed) — batching must be output-invisible
+    files = {pid: sorted(f["filename"] for k in hists[pid]["outputs"].values()
+                         for f in k["images"])
+             for pid in (pa, pb)}
+    from PIL import Image
+
+    solo_a, _ = pipe.generate("a red panda", negative_prompt="blurry",
+                              frames=5, steps=1, guidance_scale=6.0, seed=5,
+                              width=32, height=32, sampler="uni_pc")
+    first_png = os.path.join(rt.output_dir, files[pa][0])
+    got = np.asarray(Image.open(first_png))
+    # batching reorders a few XLA fusions; a float wobble may cross one
+    # uint8 rounding boundary — same tolerance family as the dp attestation
+    d = np.abs(got.astype(np.int16) - solo_a[0, 0].astype(np.int16))
+    assert d.max() <= 2 and float(np.percentile(d, 99)) == 0, (
+        f"batched row diverged from solo (max {d.max()})")
+
+
 def test_graph_failure_surfaces_in_history(server):
     """Node-level errors must land in status.messages, not crash the worker
     (the client raises them as 'Generation failed: …')."""

@@ -100,7 +100,11 @@ class SD15Config:
         """Toy widths for tests/debug servers; same code path as sd15()."""
         return cls(
             text=CLIPTextConfig(
-                vocab_size=1000, hidden_size=64, intermediate_size=128,
+                # ≥ the vendored BPE's 6514 ids: the tiny text tower accepts
+                # the real tokenizer, so tiny pipelines (tests, dryrun
+                # attestations, verify_hw) run warning-free on the same
+                # vocab path as sd15() instead of the hash fallback
+                vocab_size=6656, hidden_size=64, intermediate_size=128,
                 num_layers=2, num_heads=4, max_length=16,
             ),
             unet=UNetConfig(

@@ -55,6 +55,10 @@ class WanPipeline:
         self.tokenizer = load_tokenizer(self.config.text.vocab_size,
                                         self.config.text.max_length)
         self.params = params if params is not None else self._random_init(seed)
+        # shape signatures this process has already compiled+run — the graph
+        # server consults this to decide whether a dispatch will block on a
+        # (multi-minute, full-size) XLA build before piling more work behind it
+        self._warm_keys = set()
 
     # ---------------------------------------------------------------- init
     def _random_init(self, seed: int) -> Dict[str, Any]:
@@ -158,10 +162,59 @@ class WanPipeline:
         key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None
                                  else seed % (2**31))
         noise = jax.random.normal(key, (batch_size, *lat_shape), jnp.float32)
-        return self._generate(self.params, jnp.asarray(ids),
-                              jnp.asarray(mask), noise, int(steps),
-                              canonical_sampler(sampler),
-                              jnp.float32(guidance_scale))
+        out = self._generate(self.params, jnp.asarray(ids),
+                             jnp.asarray(mask), noise, int(steps),
+                             canonical_sampler(sampler),
+                             jnp.float32(guidance_scale))
+        self._warm_keys.add((batch_size, lat_shape, int(steps),
+                             canonical_sampler(sampler)))
+        return out
+
+    def pixel_frame_count(self, frames: int) -> int:
+        """Decoded frame count for a requested frame count (the ComfyUI
+        floor convention) — THE definition; servers must not re-derive it."""
+        ts = self.config.vae.temporal_scale
+        lat_f = max(0, int(frames) - 1) // ts + 1
+        return 1 + ts * (lat_f - 1)
+
+    def signature_key(self, *, batch_size: int, frames: int, steps: int,
+                      width: int, height: int, sampler: str):
+        """The compiled-program signature of one ``_generate`` call."""
+        return (batch_size, self._lat_shape(frames, height, width),
+                int(steps), canonical_sampler(sampler))
+
+    def is_warm(self, **kw) -> bool:
+        return self.signature_key(**kw) in self._warm_keys
+
+    def generate_many_async(self, items, *, frames: int = 16, steps: int = 25,
+                            guidance_scale: float = 6.0, width: int = 512,
+                            height: int = 320, sampler: str = "uni_pc"):
+        """B independent singleton requests (own prompt/negative/seed each)
+        fused into ONE device program — the graph server's queue-depth>1
+        batching: CFG text encode, the whole denoise loop and the VAE decode
+        stream the weights once for all B.  Items sharing a seed+prompt
+        reproduce ``generate_async``'s output row-for-row (same per-item
+        noise construction).  Returns the device array ``[B, F, H, W, 3]``.
+
+        ``items``: list of ``{"prompt", "negative_prompt", "seed"}``.
+        """
+        lat_shape = self._lat_shape(frames, height, width)
+        ids, mask = self.tokenizer(
+            [it.get("negative_prompt", "") for it in items]
+            + [it["prompt"] for it in items])
+        noise = jnp.concatenate([
+            jax.random.normal(
+                jax.random.PRNGKey(np.random.randint(0, 2**31)
+                                   if it.get("seed") is None
+                                   else it["seed"] % (2**31)),
+                (1, *lat_shape), jnp.float32)
+            for it in items])
+        out = self._generate(self.params, jnp.asarray(ids), jnp.asarray(mask),
+                             noise, int(steps), canonical_sampler(sampler),
+                             jnp.float32(guidance_scale))
+        self._warm_keys.add((len(items), lat_shape, int(steps),
+                             canonical_sampler(sampler)))
+        return out
 
     def _lat_shape(self, frames: int, height: int, width: int):
         """Latent shape for a frame count (ComfyUI floor convention) —

@@ -169,10 +169,13 @@ def dot_product_attention(
         logits = logits * jnp.asarray(scale, logits.dtype)
         if mask is not None:
             logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
-        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        probs = jax.nn.softmax(logits, axis=-1)  # f32
         if vs_b is not None:
-            probs = probs * vs_b[:, :, None, :].astype(probs.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            # apply the f32 dequant scales BEFORE the downcast: scaling after
+            # casting to bf16 would round the scales themselves and run the
+            # multiply in bf16 — avoidable error on top of int8 quantisation
+            probs = probs * vs_b[:, :, None, :]
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
     # GQA contracts grouped queries against UNEXPANDED K/V — a ``jnp.repeat``
     # would materialise K/V at h/hkv× size in HBM, which on the KV-cache
@@ -200,8 +203,8 @@ def dot_product_attention(
             # headless / per-kv-head masks broadcast over the group axis
             mask = mask[..., None, :, :]
         logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)  # f32; scales applied pre-cast
     if vs_b is not None:
-        probs = probs * vs_b[:, :, None, None, :].astype(probs.dtype)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+        probs = probs * vs_b[:, :, None, None, :]
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
     return out.reshape(b, sq, h, d)
