@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""Microbenchmark the Pallas flash kernel at the serving hot shapes.
+
+Round-4 tuning driver (VERDICT r3 #4/#5): the streaming kernel re-streams
+the K/V panel once per q-block, so its HBM traffic scales with
+``(Sq/block_q) * Sk`` — block sizes are the lever.  Shapes:
+
+- ``wan``: Wan 1.3B DiT self-attention, B=2 (CFG) x 12 heads, S=8320, D=128,
+  non-causal (reference shape ``generate_wan_t2v.py:305-312``).
+- ``prefill``: Qwen-7B chunked prefill, one 8192-token chunk attending a
+  17408-slot cache causally at offset (GQA 28q/4kv).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import itertools
+import json
+import os
+import sys
+import statistics
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--shape", default="wan", choices=["wan", "prefill"])
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--block-q", type=int, nargs="*", default=[128, 256, 512, 1024])
+    p.add_argument("--block-k", type=int, nargs="*", default=[512, 1024])
+    p.add_argument("--panel", action="store_true",
+                   help="also try the panel kernel (raise panel_max_kv)")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpustack.ops.pallas.flash_attention import flash_attention
+    from tpustack.utils.benchmark import pipelined_intervals
+
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)
+    key = jax.random.PRNGKey(0)
+
+    if args.shape == "wan":
+        b, sq, h, d, hkv = 2, 8320, 12, 128, 12
+        sk, causal, q_off, kv_len = sq, False, None, None
+        flops = 4 * b * h * sq * sk * d
+    else:
+        b, sq, h, d, hkv = 1, 8192, 28, 128, 4
+        sk = 17408
+        causal, q_off, kv_len = True, 8192, 16384
+        # valid attention pairs: rows at 8192..16383 attend their prefix
+        pairs = sum(q_off + i + 1 for i in range(sq))
+        flops = 4 * b * h * d * pairs
+
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, sq, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, sk, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, sk, hkv, d), jnp.bfloat16)
+
+    results = []
+    combos = [(bq, bk, False) for bq, bk in
+              itertools.product(args.block_q, args.block_k)]
+    if args.panel and args.shape == "wan":
+        combos.append((128, 512, True))
+
+    # Chain ITERS kernel applications (out feeds the next q) inside one jit:
+    # per-call compute is ~ms-scale while the tunnel round-trip is ~100 ms,
+    # so a single-call interval measures the tunnel, not the kernel.
+    ITERS = 20
+
+    for bq, bk, panel in combos:
+        tag = "panel" if panel else f"bq{bq}_bk{bk}"
+        try:
+            fn = functools.partial(
+                flash_attention, causal=causal, block_q=bq, block_k=bk,
+                q_offset=q_off, kv_len=kv_len,
+                panel_max_kv=(sk + 512 if panel else None))
+
+            @jax.jit
+            def chained(q0, kk, vv):
+                def body(i, acc):
+                    return fn(acc, kk, vv).astype(q0.dtype)
+                return jax.lax.fori_loop(0, ITERS, body, q0).sum()
+
+            def dispatch(seed):
+                return chained(q, k, v)
+
+            np.asarray(dispatch(0))  # compile
+            times = pipelined_intervals(dispatch, repeats=args.repeats,
+                                        warmup_min=1, warmup_max=4,
+                                        unit="call")
+            med = statistics.median(times) / ITERS
+            tf = flops / med / 1e12
+            log(f"[{tag}] {med*1e3:.2f} ms  {tf:.1f} TFLOP/s")
+            results.append({"config": tag, "ms": round(med * 1e3, 2),
+                            "tflops": round(tf, 1)})
+        except Exception as e:  # noqa: BLE001 - report and continue the sweep
+            log(f"[{tag}] FAILED: {type(e).__name__}: {str(e)[:200]}")
+            results.append({"config": tag, "error": str(e)[:120]})
+
+    print(json.dumps({"shape": args.shape, "flops_G": round(flops / 1e9, 1),
+                      "results": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

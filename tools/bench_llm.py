@@ -202,13 +202,36 @@ def main() -> int:
         steps_per_s = decode_rate / args.batch  # weights stream once per STEP
         decode_mbu = steps_per_s * weight_bytes / peak[1]
         roofline_pct = 100 * steps_per_s * (weight_bytes + kv_bytes) / peak[1]
-        prefill_mfu = (statistics.median(pre) * matmul_flops_per_tok / peak[0]
-                       if pre else None)
+        # Prefill roofline (r3 VERDICT #5): FLOPs = matmul weights touched
+        # per token PLUS causal attention (4·d_attn per valid (q,k) pair —
+        # 19% of the total at 16k, not ignorable); bytes = weights streamed
+        # once per 8k chunk + the full static KV cache read per chunk.
+        # t_min takes whichever roof binds.  NOTE: at short prompts (one
+        # sub-second chunk) prefill_s is dominated by tunnel dispatch — the
+        # dispatch-amortised measurement lives in tools/profile_prefill.py,
+        # which this accounting matches (80% at 16k on v5e).
+        P = args.prompt_tokens
+        d_attn = cfg.n_heads * cfg.head_dim
+        attn_flops = (cfg.n_layers * 4 * d_attn * (P * (P + 1) // 2)
+                      * args.batch)
+        prefill_flops = matmul_flops_per_tok * P * args.batch + attn_flops
+        from tpustack.models.llm_generate import Generator as _G
+
+        n_chunks = max(1, (P + _G.PREFILL_CHUNK - 1) // _G.PREFILL_CHUNK)
+        prefill_bytes = (weight_bytes + kv_bytes) * n_chunks
+        t_min = max(prefill_flops / peak[0], prefill_bytes / peak[1])
+        tokens_total = args.batch * P
+        prefill_mfu = (statistics.median(pre) * prefill_flops
+                       / tokens_total / peak[0] if pre else None)
+        prefill_roofline_pct = (100 * t_min * statistics.median(pre)
+                                / tokens_total if pre else None)
         log(f"[bench_llm] decode streams {weight_bytes / 1e9:.2f} GB weights "
             f"+ {kv_bytes / 1e9:.2f} GB KV per step → "
             f"{roofline_pct:.0f}% of the {peak[1] / 1e9:.0f} GB/s HBM "
             f"roofline ({100 * decode_mbu:.0f}% weights-only)"
-            + (f"; prefill ≈ {100 * prefill_mfu:.0f}% of bf16 MXU peak"
+            + (f"; prefill {prefill_roofline_pct:.0f}% of its "
+               f"{tokens_total / t_min:.0f} tok/s roofline "
+               f"({100 * prefill_mfu:.0f}% MFU)"
                if prefill_mfu is not None else ""))
 
     batch_tag = f"_batch{args.batch}" if args.batch > 1 else ""
@@ -232,6 +255,9 @@ def main() -> int:
                          if roofline_pct is not None else None),
         "prefill_mfu": (round(prefill_mfu, 4)
                         if prefill_mfu is not None else None),
+        "prefill_roofline_pct": (round(prefill_roofline_pct, 1)
+                                 if prefill_roofline_pct is not None
+                                 else None),
     }))
     return 0
 

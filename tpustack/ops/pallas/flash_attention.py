@@ -153,10 +153,17 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 
 
 # beyond this many K tokens the full per-head K/V panel stops fitting VMEM
-# comfortably (2 panels × 8k × 128 × 2B = 4 MB plus scores/accumulators) and
-# the k-streaming kernel takes over; below it the panel kernel measures
-# slightly faster (no carry rescale traffic)
-PANEL_MAX_KV = 8192
+# (2 panels × 8.7k × 128 × 2B = 4.5 MB plus the [block_q, S] fp32
+# scores/probs — ~14 MB peak at 8704) and the k-streaming kernel takes
+# over.  Below it the panel kernel wins big: its K/V panel is DMA'd once
+# per batch·head (the BlockSpec index is constant across q-blocks) while
+# the streaming kernel re-fetches every k-block for every q-block.
+# Measured on v5e at the Wan DiT shape (B·H=24, S=8320, D=128, bf16):
+# panel 6.4 ms = 132 TFLOP/s vs best-streaming 8.1 ms — and 8704 is the
+# largest 128-multiple whose panel program still compiles (block_q 256 at
+# this S already overflows VMEM).  8320 > 8192 was exactly the Wan shape,
+# which round 3 left on the streaming kernel at 48 TFLOP/s.
+PANEL_MAX_KV = 8704
 
 
 def flash_attention(
@@ -166,8 +173,8 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     q_offset=None,
     kv_len=None,
@@ -193,6 +200,15 @@ def flash_attention(
     at 30k/8k-chunks is ~15-40% of prefill, an accepted trade.)  Forces the
     streaming kernel.
 
+    ``block_q``/``block_k`` default per kernel: the panel kernel takes
+    block_q 128 (larger overflows VMEM at PANEL_MAX_KV — the [block_q, S]
+    fp32 scores dominate), the streaming kernel 1024/1024.  The streaming
+    kernel's K/V HBM traffic is ``(Sq/block_q) · Sk`` per head — every
+    q-block re-streams the panel — so big q-blocks are decisive: measured
+    on v5e at the 8k-chunk-over-17k-cache prefill shape, 1024/1024 runs
+    3.1x the default-of-r3 128/512 (123 vs 39 TFLOP/s); block 2048 is
+    within noise of 1024 and 2048/2048 fails to compile.
+
     GQA (``Hkv`` dividing ``H``) is native: the kernel grid walks q heads
     while the K/V BlockSpec index maps ``bh → bh // (H/Hkv)``, so shared
     K/V panels are DMA'd per kv-head without ever materialising the
@@ -206,6 +222,11 @@ def flash_attention(
         panel_max_kv = PANEL_MAX_KV
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    streaming = k.shape[1] > panel_max_kv or q_offset is not None or kv_len is not None
+    if block_q is None:
+        block_q = 1024 if streaming else 128
+    if block_k is None:
+        block_k = 1024 if streaming else 512
     return _flash_attention(q, k, v, causal=causal, scale=scale,
                             block_q=block_q, block_k=block_k,
                             interpret=interpret, q_offset=q_offset,

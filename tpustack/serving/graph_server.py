@@ -654,9 +654,13 @@ class GraphServer:
         known-unbatchable signatures) so cold-compile checks judge the
         batch sizes that will really run, not the pre-split group size."""
         plan = []
+        pipe = self.rt.pipeline()
         for key, members in groups.items():
             width, height, frames_n = key[0], key[1], key[2]
-            per = max(1, frames_n) * height * width
+            # budget against DECODED pixel-frames (16 requested -> 13
+            # decoded under the floor convention), the pixels that
+            # actually hit HBM — not the requested count
+            per = max(1, pipe.pixel_frame_count(frames_n)) * height * width
             max_b = max(1, self.PIXEL_BUDGET // per)
             if key in self._no_batch:
                 max_b = 1
@@ -707,13 +711,6 @@ class GraphServer:
                       "seed": s.seed} for s, _ in members],
                     frames=frames_n, steps=steps, guidance_scale=cfg,
                     width=width, height=height, sampler=sampler)
-            if int(vid.shape[1]) != members[0][1].n_frames:
-                raise GraphError(
-                    f"decoded frame count {int(vid.shape[1])} != planned "
-                    f"{members[0][1].n_frames} — frame-convention drift "
-                    "between pipeline and server")
-            for i, (_, fr) in enumerate(members):
-                fr.array = vid[i]
         except Exception as e:  # noqa: BLE001
             if len(members) > 1:
                 # batched build failed (typically compile-time HBM OOM at a
@@ -728,6 +725,24 @@ class GraphServer:
             log.exception("dispatch failed")
             for _, fr in members:
                 fr.error = e
+            return
+        # Frame-convention guard OUTSIDE the try: a drift between the
+        # pipeline's decode and the server's planned Frames is deterministic
+        # — routing it through the batched-build-failure path would
+        # blacklist the signature and re-run every member serially at full
+        # generation cost, each failing identically.  (Shape metadata is
+        # available without blocking the async dispatch.)
+        if int(vid.shape[1]) != members[0][1].n_frames:
+            err = GraphError(
+                f"decoded frame count {int(vid.shape[1])} != planned "
+                f"{members[0][1].n_frames} — frame-convention drift "
+                "between pipeline and server")
+            log.error("%s", err)
+            for _, fr in members:
+                fr.error = err
+            return
+        for i, (_, fr) in enumerate(members):
+            fr.array = vid[i]
 
     def _finalize(self, pid, entry, outputs, finish):
         """Run deferred saves (fetch + encode + write) and publish."""
