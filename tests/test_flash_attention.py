@@ -143,6 +143,19 @@ def test_auto_dispatch_long_context_always_flash():
     assert auto_impl(1, 32768, 28, 32768, True, "tpu", d=128) == "xla"
 
 
+def test_auto_dispatch_short_kv_cross_attention():
+    """Long-q/short-kv cross-attention (Wan DiT: 2560 video tokens against
+    a 512-token text panel) goes flash — the [Sq, Sk] fp32 scores round
+    trip XLA materialises scales with sq*sk, ~300 MB per block-eval in situ
+    (xprof r4).  A 77-token panel (SD15's CLIP length) stays xla: the K/V
+    panel per grid step would be too thin to be worth the kernel."""
+    from tpustack.ops.attention import auto_impl
+
+    assert auto_impl(2, 2560, 12, 512, False, "tpu", d=128) == "flash"
+    assert auto_impl(2, 4096, 8, 77, False, "tpu", d=40) == "xla"
+    assert auto_impl(2, 512, 12, 512, False, "tpu", d=128) == "xla"  # sq short
+
+
 def test_flash_via_attention_entrypoint():
     q = _rand((1, 32, 2, 16), 6)
     out = dot_product_attention(q, q, q, causal=True, impl="flash")

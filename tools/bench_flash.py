@@ -75,9 +75,16 @@ def main() -> int:
     for bq, bk, panel in combos:
         tag = "panel" if panel else f"bq{bq}_bk{bk}"
         try:
+            # non-panel rows must FORCE the streaming kernel: with
+            # PANEL_MAX_KV at 8704 the wan shape (S=8320, no q_offset)
+            # would otherwise take the panel branch for every combo,
+            # silently ignoring block_k and mislabelling the sweep.
+            # Passing kv_len=sk (semantically a no-op) selects the
+            # dynamic/streaming branch without touching block sizes.
             fn = functools.partial(
                 flash_attention, causal=causal, block_q=bq, block_k=bk,
-                q_offset=q_off, kv_len=kv_len,
+                q_offset=q_off,
+                kv_len=(kv_len if panel or kv_len is not None else sk),
                 panel_max_kv=(sk + 512 if panel else None))
 
             @jax.jit

@@ -166,13 +166,9 @@ def main() -> int:
     # chip's HBM peak over the COMPLETE per-step traffic: weights + KV reads
     # (+ the 1-position KV write, negligible).  Prefill is MXU-bound:
     # ~2·P_matmul FLOPs/token (attention excluded, a few % at these ctx).
-    PEAKS = {  # device_kind substring → (bf16 TFLOP/s, HBM GB/s)
-        "v6": (918e12, 1640e9), "v5 lite": (197e12, 819e9),
-        "v5e": (197e12, 819e9), "v5p": (459e12, 2765e9),
-        "v5": (459e12, 2765e9), "v4": (275e12, 1228e9),
-    }
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    peak = next((v for k, v in PEAKS.items() if k in kind), None)
+    from tpustack.utils.peaks import device_peaks
+
+    peak = device_peaks(jax.devices()[0])
     decode_mbu = prefill_mfu = roofline_pct = None
     if peak and not (args.batch > 1 and args.continuous):
         # continuous mode's rate is end-to-end (admissions folded in) —
@@ -215,9 +211,7 @@ def main() -> int:
         attn_flops = (cfg.n_layers * 4 * d_attn * (P * (P + 1) // 2)
                       * args.batch)
         prefill_flops = matmul_flops_per_tok * P * args.batch + attn_flops
-        from tpustack.models.llm_generate import Generator as _G
-
-        n_chunks = max(1, (P + _G.PREFILL_CHUNK - 1) // _G.PREFILL_CHUNK)
+        n_chunks = max(1, (P + gen.PREFILL_CHUNK - 1) // gen.PREFILL_CHUNK)
         prefill_bytes = (weight_bytes + kv_bytes) * n_chunks
         t_min = max(prefill_flops / peak[0], prefill_bytes / peak[1])
         tokens_total = args.batch * P

@@ -107,10 +107,10 @@ def main() -> int:
     sec = statistics.median(times)
 
     mfu = None
-    PEAKS = {"v6": 918e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
-             "v5": 459e12, "v4": 275e12}
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    peak = next((v for k, v in PEAKS.items() if k in kind), None)
+    from tpustack.utils.peaks import device_peaks
+
+    peaks = device_peaks(jax.devices()[0])
+    peak = peaks[0] if peaks else None
     if peak:
         try:
             flops = pipe.pipeline_flops(steps=args.steps, frames=args.frames,

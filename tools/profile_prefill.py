@@ -115,14 +115,10 @@ def main() -> int:
     kv_elt = 2
     kv_bytes = (cfg.n_layers * 2 * cfg.max_seq * cfg.n_kv_heads *
                 cfg.head_dim * kv_elt) * n_chunks  # full static cache/chunk
-    PEAKS = {"v6": (918e12, 1640e9), "v5 lite": (197e12, 819e9),
-             "v5e": (197e12, 819e9), "v5p": (459e12, 2765e9),
-             "v4": (275e12, 1228e9)}
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    peak = next((v for k, v in PEAKS.items() if k in kind),
-                (197e12, 819e9))
-    t_min = max(flops / peak[0], (weight_bytes + kv_bytes) / peak[1])
-    print(json.dumps({
+    from tpustack.utils.peaks import device_peaks
+
+    peak = device_peaks(jax.devices()[0])
+    out = {
         "prompt_tokens": P,
         "chunks": n_chunks,
         "median_s": round(med, 3),
@@ -131,10 +127,15 @@ def main() -> int:
         "matmul_flops_T": round(matmul_flops / 1e12, 2),
         "attn_flops_T": round(attn_flops / 1e12, 2),
         "bytes_GB": round((weight_bytes + kv_bytes) / 1e9, 2),
-        "t_min_s": round(t_min, 3),
-        "roofline_pct": round(100 * t_min / med, 1),
-        "mfu_pct": round(100 * flops / peak[0] / med, 1),
-    }))
+    }
+    if peak:  # unknown chip → omit rooflines rather than use a wrong wall
+        t_min = max(flops / peak[0], (weight_bytes + kv_bytes) / peak[1])
+        out.update({
+            "t_min_s": round(t_min, 3),
+            "roofline_pct": round(100 * t_min / med, 1),
+            "mfu_pct": round(100 * flops / peak[0] / med, 1),
+        })
+    print(json.dumps(out))
     return 0
 
 

@@ -60,17 +60,29 @@ WAN_KW = dict(frames=5, steps=2, seed=5, width=32, height=32,
 
 # (name, (B, S, Hq, Hkv, D), causal) — panel, GQA and cross-length cases the
 # CPU suite pins in interpret mode (tests/test_flash_attention.py); here the
-# same vectors go through the REAL compiled kernel on the chip.  The
-# wan_dit_s8320 case is the exact S/D shape the Wan 1.3B DiT's self-attn
-# runs at the reference serving shape (docs/PERF.md: 14.3% of device time)
-# — previously the only hot flash shape never content-checked on-chip.
+# same vectors go through the REAL compiled kernel on the chip.  The Wan
+# 1.3B DiT's self-attn at the reference serving shape (512x320x16f) runs
+# S=2560 D=128 — the r3 docs mislabelled it S=8320, which is the token
+# count of a ~49-frame video; both S/D shapes are checked (s2560 hits the
+# panel kernel, s8320 sits just under the r4 PANEL_MAX_KV of 8704).
 FLASH_CASES = [
     ("panel_causal", (2, 256, 2, 2, 32), True),
     ("panel_plain", (2, 256, 2, 2, 32), False),
     ("gqa_causal", (1, 256, 4, 2, 64), True),
     ("cross_len_causal", (1, 64, 2, 2, 32), True),  # sq < sk, bottom-aligned
-    ("wan_dit_s8320", (1, 8320, 2, 2, 128), False),  # Wan DiT hot shape
+    ("wan_dit_s2560", (1, 2560, 2, 2, 128), False),  # Wan DiT 16f hot shape
+    ("wan_dit_s8320", (1, 8320, 2, 2, 128), False),  # Wan DiT ~49f shape
+    # chunked-prefill mode (q_offset/kv_len → the k-STREAMING kernel): a
+    # 1024-row chunk at offset 2*s over a 4*s cache with kv_len 3.5*s
+    # exercises, at the real default block sizes on hardware, all four
+    # k-block kinds — interior UNMASKED (the r4 fast path the CPU suite
+    # only sees at block 32 in interpret mode), causal-diagonal masked,
+    # kv_len-boundary masked, and beyond-kv skipped
+    ("stream_chunk_causal", (1, 1024, 2, 2, 128), True),
 ]
+
+#: q_offset / kv_len for the stream_chunk case, as multiples of its s
+STREAM_CHUNK_OFFSET_X, STREAM_CHUNK_KVLEN_X = 2, 3.5
 
 # Pass thresholds.  The f32 rows run under jax.default_matmul_precision
 # "highest" (without it the MXU's default bf16-input passes make "f32"
@@ -208,12 +220,24 @@ def _flash_vectors():
     for i, (name, (b, s, hq, hkv, d), _) in enumerate(FLASH_CASES):
         ks = jax.random.split(jax.random.PRNGKey(100 + i), 3)
         sq = s
-        sk = s if "cross" not in name else 4 * s  # sq < sk, bottom-aligned
+        # cross: sq < sk, bottom-aligned; stream_chunk: q is one chunk of a
+        # 4*s cache (q_offset/kv_len passed at the call sites)
+        sk = s if ("cross" not in name and "stream" not in name) else 4 * s
         out[name] = tuple(
             np.asarray(jax.random.normal(k, shp, np.float32))
             for k, shp in zip(ks, [(b, sq, hq, d), (b, sk, hkv, d),
                                    (b, sk, hkv, d)]))
     return out
+
+
+def _stream_chunk_mask(sq: int, sk: int):
+    """XLA-reference mask for the stream_chunk case: q rows sit at global
+    positions offset + i and see cols <= their position, < kv_len."""
+    off = int(STREAM_CHUNK_OFFSET_X * sq)
+    klen = int(STREAM_CHUNK_KVLEN_X * sq)
+    rows = np.arange(sq)[:, None] + off
+    cols = np.arange(sk)[None, :]
+    return (cols <= rows) & (cols < klen), off, klen
 
 
 def phase_ref(workdir: str, families: list[str]) -> None:
@@ -307,7 +331,12 @@ def phase_ref(workdir: str, families: list[str]) -> None:
 
         for (name, _, causal), (q, k, v) in zip(FLASH_CASES,
                                                 _flash_vectors().values()):
-            ref = dot_product_attention(q, k, v, causal=causal, impl="xla")
+            if "stream" in name:
+                mask, _, _ = _stream_chunk_mask(q.shape[1], k.shape[1])
+                ref = dot_product_attention(q, k, v, mask=mask, impl="xla")
+            else:
+                ref = dot_product_attention(q, k, v, causal=causal,
+                                            impl="xla")
             out[f"flash_{name}_q"] = q
             out[f"flash_{name}_k"] = k
             out[f"flash_{name}_v"] = v
@@ -376,8 +405,20 @@ def phase_hw(workdir: str, families: list[str]) -> None:
             # the serving entry point routes to the REAL compiled kernel on
             # a tpu backend (interpret=False, flash_attention.py:207-208);
             # it also handles GQA repeat + cross-length bottom alignment
-            got = dot_product_attention(q, k, v, causal=causal, impl="flash")
-            xla = dot_product_attention(q, k, v, causal=causal, impl="xla")
+            if "stream" in name:
+                from tpustack.ops.pallas.flash_attention import \
+                    flash_attention
+
+                mask, off, klen = _stream_chunk_mask(q.shape[1], k.shape[1])
+                got = flash_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=True,
+                                      q_offset=off, kv_len=klen)
+                xla = dot_product_attention(q, k, v, mask=mask, impl="xla")
+            else:
+                got = dot_product_attention(q, k, v, causal=causal,
+                                            impl="flash")
+                xla = dot_product_attention(q, k, v, causal=causal,
+                                            impl="xla")
             out[f"flash_{name}_hw"] = np.asarray(got, np.float32)
             out[f"flash_{name}_hw_xla"] = np.asarray(xla, np.float32)
 

@@ -32,6 +32,9 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+LOG2E = 1.4426950408889634
+
+
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
                  kv_len: int, block_q: int):
     """One (batch*head, q-block) grid step: softmax(q·kᵀ)·v, fp32 accumulate.
@@ -41,6 +44,15 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
     accumulation (``preferred_element_type``); upcasting to f32 first would
     halve matmul throughput for no extra accuracy in the product.  Softmax
     statistics are fp32.
+
+    The kernel is VPU-bound at mid sizes (per score element: 512 MXU
+    flops vs ~10 VPU ops, against the machine's ~50:1 MXU:VPU ratio), so
+    the softmax phase economises VPU passes: the padding/causal mask —
+    iota, compare, select: 3 full passes over the scores — is emitted only
+    when the (static) shape actually has padding or causality, and exp goes
+    through exp2 with log2(e) folded into the static scale (same math:
+    exp(l·s - m) == exp2(l·s·log2e - m') with the max taken in the scaled
+    domain; one fewer VPU multiply per element if exp lowers to scale+exp2).
     """
     qi = pl.program_id(1)
     q = q_ref[0]                                # [block_q, D]
@@ -49,18 +61,19 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
 
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale   # [block_q, S_pad] f32
+        preferred_element_type=jnp.float32) * (scale * LOG2E)
 
     s_pad = logits.shape[-1]
-    col = jax.lax.broadcasted_iota(jnp.int32, (block_q, s_pad), 1)
-    valid = col < kv_len                              # mask K padding
-    if causal:
-        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, s_pad), 0)
-        valid = valid & (col <= row + qi * block_q)
-    logits = jnp.where(valid, logits, NEG_INF)
+    if causal or kv_len < s_pad:                # static: skip 3 VPU passes
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, s_pad), 1)
+        valid = col < kv_len                    # mask K padding
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (block_q, s_pad), 0)
+            valid = valid & (col <= row + qi * block_q)
+        logits = jnp.where(valid, logits, NEG_INF)
 
     m = jnp.max(logits, axis=-1, keepdims=True)
-    p = jnp.exp(logits - m)
+    p = jnp.exp2(logits - m)
     denom = jnp.sum(p, axis=-1, keepdims=True)        # f32
     out = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                               preferred_element_type=jnp.float32) / denom
@@ -104,27 +117,16 @@ def _attn_kernel_stream(q_ref, k_ref, v_ref, off_ref, len_ref, o_ref,
     if causal:
         needed = needed & (col0 <= off + qi * block_q + block_q - 1)
 
-    @pl.when(needed)
-    def _compute():
-        q = q_ref[0]                            # [block_q, D]
-        k = k_ref[0]                            # [block_k, D]
+    def _accumulate(logits):
+        """Online-softmax update of the (m, l, acc) carry from one block of
+        scaled logits (log2e folded into the static scale; max/exp2 run in
+        the scaled domain — same softmax, see the panel kernel docstring)."""
         v = v_ref[0]
-        logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
-
-        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + col0
-        valid = col < kv_len
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            valid = valid & (col <= row + off + qi * block_q)
-        logits = jnp.where(valid, logits, NEG_INF)
-
         m_prev = m_ref[:, :1]                   # [block_q, 1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_cur)         # rescale of prior state
-        p = jnp.exp(logits - m_cur)
+        alpha = jnp.exp2(m_prev - m_cur)        # rescale of prior state
+        p = jnp.exp2(logits - m_cur)
         l_ref[...] = jnp.broadcast_to(l_prev * alpha +
                                       jnp.sum(p, axis=-1, keepdims=True),
                                       l_ref.shape)
@@ -132,6 +134,39 @@ def _attn_kernel_stream(q_ref, k_ref, v_ref, off_ref, len_ref, o_ref,
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+
+    def _logits():
+        q = q_ref[0]                            # [block_q, D]
+        k = k_ref[0]                            # [block_k, D]
+        return jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * (scale * LOG2E)
+
+    # The masking passes (iota, compare, select — 3 VPU passes over the
+    # whole score block) are only needed on BOUNDARY blocks: those crossing
+    # kv_len, or crossing this q-block's causal diagonal band.  Interior
+    # blocks — the vast majority of a long prefill — take the unmasked
+    # branch.  Exactly one branch executes per grid step; both update the
+    # same carry.
+    boundary = col0 + block_k > kv_len
+    if causal:
+        # fully-below-diagonal test against the STRICTEST row (row 0 of the
+        # q block): every column valid for row 0 is valid for all rows
+        boundary = boundary | (col0 + block_k - 1 > off + qi * block_q)
+
+    @pl.when(needed & boundary)
+    def _compute_masked():
+        logits = _logits()
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + col0
+        valid = col < kv_len
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            valid = valid & (col <= row + off + qi * block_q)
+        _accumulate(jnp.where(valid, logits, NEG_INF))
+
+    @pl.when(needed & jnp.logical_not(boundary))
+    def _compute_unmasked():
+        _accumulate(_logits())
 
     @pl.when(ki == n_k - 1)
     def _finish():

@@ -1,0 +1,34 @@
+"""Per-chip peak rates (public spec sheets), shared by the benches.
+
+One ordered table instead of a copy in every tool (the lite variants must
+match before the plain generation name: "v5 lite" is 197 TFLOP/s while
+plain "v5"/"v5p" is 459).  ``device_peaks`` returns ``None`` for unknown
+chips so callers OMIT roofline numbers rather than computing them against
+the wrong wall.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+#: (device_kind substring, (bf16 matmul FLOP/s, HBM bytes/s)); ordered —
+#: first substring match wins
+PEAKS = (
+    ("v6 lite", (918e12, 1640e9)),  # v6e (Trillium)
+    ("v6e", (918e12, 1640e9)),
+    ("v5 lite", (197e12, 819e9)),   # v5e
+    ("v5e", (197e12, 819e9)),
+    ("v5p", (459e12, 2765e9)),
+    ("v5", (459e12, 2765e9)),       # after the lite variants: v5 == v5p
+    ("v4", (275e12, 1228e9)),
+)
+
+
+def device_peaks(device) -> Optional[Tuple[float, float]]:
+    """``(bf16 FLOP/s, HBM bytes/s)`` for a PJRT device, or None if the
+    device_kind is not recognised (callers should then skip rooflines)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in PEAKS:
+        if sub in kind:
+            return peak
+    return None
