@@ -411,3 +411,52 @@ def test_client_gallery(tmp_path):
     html = (tmp_path / "index.html").read_text()
     assert '<img src="a.webp"' in html
     assert '<video controls src="b.webm"' in html
+
+
+def test_frame_convention_drift_does_not_blacklist(tmp_path):
+    """A pipeline decoding a DIFFERENT frame count than the server planned
+    is a deterministic bug, not a transient batched-build failure: the
+    guard must set each member's error directly — NOT add the signature to
+    _no_batch and re-dispatch every member serially (each retry would fail
+    identically at full generation cost)."""
+    import types
+
+    import numpy as _np
+
+    from tpustack.serving.graph_server import (Conditioning, Frames,
+                                               GraphError, GraphServer,
+                                               LatentSpec, SampleSpec,
+                                               WanRuntime)
+    from tpustack.models.wan import WanConfig, WanPipeline
+
+    pipe = WanPipeline(WanConfig.tiny())
+    rt = WanRuntime(models_dir=str(tmp_path / "m"),
+                    output_dir=str(tmp_path / "o"), pipeline=pipe)
+    srv = GraphServer(runtime=rt)
+    srv._queue.put(None)
+    srv._worker.join(timeout=30)
+
+    calls = {"solo": 0}
+
+    def drifted(*a, **kw):
+        # one frame too many vs the planned pixel_frame_count
+        calls["solo"] += 1
+        return _np.zeros((1, pipe.pixel_frame_count(5) + 1, 32, 32, 3),
+                         _np.uint8)
+
+    pipe.generate_async = drifted
+    try:
+        spec = SampleSpec(
+            latent=LatentSpec(width=32, height=32, frames=5, batch_size=1),
+            positive=Conditioning("a"), negative=Conditioning("b"),
+            seed=1, steps=1, cfg=6.0, sampler_name="uni_pc", denoise=1.0)
+        fr = Frames(n_frames=pipe.pixel_frame_count(5))
+        key = srv._spec_key(spec)
+        srv._dispatch_one(key, [(spec, fr)])
+    finally:
+        srv.shutdown()
+
+    assert isinstance(fr.error, GraphError), fr.error
+    assert "frame-convention drift" in str(fr.error)
+    assert key not in srv._no_batch  # deterministic drift must not blacklist
+    assert calls["solo"] == 1  # and must not trigger serial re-dispatch

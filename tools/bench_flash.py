@@ -67,10 +67,15 @@ def main() -> int:
     if args.panel and args.shape == "wan":
         combos.append((128, 512, True))
 
-    # Chain ITERS kernel applications (out feeds the next q) inside one jit:
+    # Chain kernel applications (out feeds the next q) inside one jit:
     # per-call compute is ~ms-scale while the tunnel round-trip is ~100 ms,
-    # so a single-call interval measures the tunnel, not the kernel.
-    ITERS = 20
+    # so a single-call interval measures the tunnel, not the kernel.  The
+    # chain must total well past the RTT or the measurement is floored at
+    # RTT/iters and block-size effects vanish (this bit round 4: S=2560
+    # sweeps read ~2 ms/call whatever the config; in-situ xprof said
+    # 0.6 ms).  Start from a FLOPs guess at 30 TFLOP/s and re-scale once
+    # from the first measured config so every config runs >= ~400 ms.
+    iters = max(8, int(0.4 / max(flops / 30e12, 1e-4)))
 
     for bq, bk, panel in combos:
         tag = "panel" if panel else f"bq{bq}_bk{bk}"
@@ -87,20 +92,30 @@ def main() -> int:
                 kv_len=(kv_len if panel or kv_len is not None else sk),
                 panel_max_kv=(sk + 512 if panel else None))
 
-            @jax.jit
-            def chained(q0, kk, vv):
+            n_it = iters
+
+            @functools.partial(jax.jit, static_argnums=(3,))
+            def chained(q0, kk, vv, n):
                 def body(i, acc):
                     return fn(acc, kk, vv).astype(q0.dtype)
-                return jax.lax.fori_loop(0, ITERS, body, q0).sum()
+                return jax.lax.fori_loop(0, n, body, q0).sum()
 
             def dispatch(seed):
-                return chained(q, k, v)
+                return chained(q, k, v, n_it)
 
             np.asarray(dispatch(0))  # compile
             times = pipelined_intervals(dispatch, repeats=args.repeats,
                                         warmup_min=1, warmup_max=4,
                                         unit="call")
-            med = statistics.median(times) / ITERS
+            med = statistics.median(times) / n_it
+            if med * n_it < 0.25:  # still RTT-floored: rescale and re-run
+                n_it = max(n_it, int(0.4 / med))
+                iters = n_it  # persist for the remaining configs
+                np.asarray(dispatch(0))
+                times = pipelined_intervals(dispatch, repeats=args.repeats,
+                                            warmup_min=1, warmup_max=4,
+                                            unit="call")
+                med = statistics.median(times) / n_it
             tf = flops / med / 1e12
             log(f"[{tag}] {med*1e3:.2f} ms  {tf:.1f} TFLOP/s")
             results.append({"config": tag, "ms": round(med * 1e3, 2),

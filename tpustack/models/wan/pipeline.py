@@ -16,6 +16,7 @@ Frame counts follow ComfyUI's floor convention: requesting 16 frames yields
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -31,7 +32,8 @@ from tpustack.models.wan.scheduler import (FlowSchedule, canonical_sampler,
 from tpustack.models.wan.tokenizer import load_tokenizer
 from tpustack.models.wan.umt5 import UMT5Encoder
 from tpustack.models.wan.vae3d import VAE3DDecoder, VAE3DEncoder
-from tpustack.models.wan.wanvae import WanVAEDecoder, WanVAEEncoder
+from tpustack.models.wan.wanvae import (WanVAEDecoder, WanVAEDecoderStream,
+                                        WanVAEEncoder, init_decode_caches)
 from tpustack.utils import get_logger
 
 log = get_logger("models.wan.pipeline")
@@ -49,6 +51,9 @@ class WanPipeline:
         if self.config.vae.arch == "wan":  # checkpoint-mapped Wan 2.1 arch
             self.vae_decoder = WanVAEDecoder(self.config.vae, dtype=dtype)
             self.vae_encoder = WanVAEEncoder(self.config.vae, dtype=dtype)
+            # streaming twin (same param tree) for long-video decode
+            self.vae_decoder_stream = WanVAEDecoderStream(self.config.vae,
+                                                          dtype=dtype)
         else:  # "tpu": this package's own design (no checkpoint format)
             self.vae_decoder = VAE3DDecoder(self.config.vae, dtype=dtype)
             self.vae_encoder = VAE3DEncoder(self.config.vae, dtype=dtype)
@@ -79,10 +84,9 @@ class WanPipeline:
                 "vae_encoder": vae_e}
 
     # ------------------------------------------------------------ compiled fn
-    @functools.partial(jax.jit, static_argnums=(0, 5, 6))
-    def _generate(self, params, ids, mask, noise, num_steps: int,
-                  sampler: str, guidance_scale):
-        """``ids``/``mask`` are ``[2B, L]`` — uncond rows then cond rows."""
+    def _denoise_body(self, params, ids, mask, noise, num_steps: int,
+                      sampler: str, guidance_scale):
+        """Traced denoise: text encode + CFG flow-matching loop → latents."""
         c = self.config
         sched: FlowSchedule = make_flow_schedule(num_steps, c.flow_shift)
         context = self.text_encoder.apply({"params": params["text_encoder"]},
@@ -107,15 +111,83 @@ class WanPipeline:
                 return heun_step(i, x, v, v_next, sched)
             return euler_step(i, x, v, sched)
 
-        x = jax.lax.fori_loop(0, num_steps, body, noise)
+        return jax.lax.fori_loop(0, num_steps, body, noise)
 
+    @staticmethod
+    def _to_uint8(frames):
+        frames = jnp.clip((frames.astype(jnp.float32) + 1.0) * 127.5,
+                          0.0, 255.0)
+        return jnp.round(frames).astype(jnp.uint8)
+
+    @functools.partial(jax.jit, static_argnums=(0, 5, 6))
+    def _generate(self, params, ids, mask, noise, num_steps: int,
+                  sampler: str, guidance_scale):
+        """``ids``/``mask`` are ``[2B, L]`` — uncond rows then cond rows.
+        One fused program: denoise + full-sequence VAE decode (the fast
+        path; long videos use ``_generate_latents`` + streaming decode)."""
+        c = self.config
+        x = self._denoise_body(params, ids, mask, noise, num_steps, sampler,
+                               guidance_scale)
         if c.vae.arch == "wan":  # decoder owns de-normalization + conv2
             frames = self.vae_decoder.apply({"params": params["vae_decoder"]}, x)
         else:
             frames = self.vae_decoder.apply(
                 {"params": params["vae_decoder"]}, x / c.vae.scaling_factor)
-        frames = jnp.clip((frames.astype(jnp.float32) + 1.0) * 127.5, 0.0, 255.0)
-        return jnp.round(frames).astype(jnp.uint8)
+        return self._to_uint8(frames)
+
+    @functools.partial(jax.jit, static_argnums=(0, 5, 6))
+    def _generate_latents(self, params, ids, mask, noise, num_steps: int,
+                          sampler: str, guidance_scale):
+        return self._denoise_body(params, ids, mask, noise, num_steps,
+                                  sampler, guidance_scale)
+
+    @functools.partial(jax.jit, static_argnums=(0, 4), donate_argnums=(3,))
+    def _decode_stream_chunk(self, params, z_chunk, caches, first: bool):
+        # caches donated: old and new history must not be live together —
+        # the whole point of streaming is bounded decode memory
+        frames, caches = self.vae_decoder_stream.apply(
+            {"params": params["vae_decoder"]}, z_chunk, caches, first)
+        return self._to_uint8(frames), caches
+
+    #: stream the VAE decode (bounded memory) when a batch row's decoded
+    #: pixel-frame volume exceeds this — the full-sequence decoder's
+    #: activation maps scale with F*H*W and a 49-frame 512x320 video
+    #: (8.0M px-frames) measured 23.9 GB > 16 GB HBM, while the 16-frame
+    #: default (2.1M) comfortably fits fused
+    STREAM_DECODE_PIXELS = int(os.environ.get("WAN_VAE_STREAM_PIXELS",
+                                              str(3_000_000)))
+    #: latent frames per streamed decode chunk.  2 is the measured default:
+    #: a 49-frame 512x320 decode fits beside the full serving weights at
+    #: chunk 2 on a 16 GB v5e; chunk 4's final-stage maps still OOM there
+    STREAM_DECODE_CHUNK = int(os.environ.get("WAN_VAE_STREAM_CHUNK", "2"))
+
+    def _use_stream_decode(self, lat_shape, height: int, width: int) -> bool:
+        f_lat = lat_shape[0]
+        if self.config.vae.arch != "wan" or f_lat < 2:
+            return False
+        px = (1 + self.config.vae.temporal_scale * (f_lat - 1)) * height * width
+        return px > self.STREAM_DECODE_PIXELS
+
+    def _decode_streaming(self, x):
+        """Host loop over latent-frame chunks of the streaming decoder —
+        exact (per-conv 2-frame causal history), memory bounded by the
+        chunk size.  Chunks dispatch async back-to-back; the concatenated
+        uint8 video is returned as a device array like ``_generate``'s."""
+        b, t = x.shape[0], x.shape[1]
+        chunk = max(2, self.STREAM_DECODE_CHUNK)
+        caches = init_decode_caches(self.config.vae, b, x.shape[2], x.shape[3],
+                                    dtype=self.config.compute_dtype)
+        outs = []
+        lo = 0
+        while lo < t:
+            n = min(chunk, t - lo)
+            if lo == 0 and n < 2:
+                raise ValueError("streaming decode needs >= 2 latent frames")
+            frames, caches = self._decode_stream_chunk(
+                self.params, x[:, lo:lo + n], caches, lo == 0)
+            outs.append(frames)
+            lo += n
+        return jnp.concatenate(outs, axis=1)
 
     # ---------------------------------------------------------------- public
     def generate(
@@ -162,13 +234,23 @@ class WanPipeline:
         key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None
                                  else seed % (2**31))
         noise = jax.random.normal(key, (batch_size, *lat_shape), jnp.float32)
-        out = self._generate(self.params, jnp.asarray(ids),
-                             jnp.asarray(mask), noise, int(steps),
-                             canonical_sampler(sampler),
-                             jnp.float32(guidance_scale))
+        out = self._run(jnp.asarray(ids), jnp.asarray(mask), noise,
+                        int(steps), canonical_sampler(sampler),
+                        jnp.float32(guidance_scale), height, width)
         self._warm_keys.add((batch_size, lat_shape, int(steps),
                              canonical_sampler(sampler)))
         return out
+
+    def _run(self, ids, mask, noise, steps: int, sampler: str,
+             guidance_scale, height: int, width: int):
+        """Denoise + decode, choosing fused or streaming decode by the
+        decoded pixel-frame volume (``_use_stream_decode``)."""
+        if self._use_stream_decode(noise.shape[1:], height, width):
+            x = self._generate_latents(self.params, ids, mask, noise, steps,
+                                       sampler, guidance_scale)
+            return self._decode_streaming(x)
+        return self._generate(self.params, ids, mask, noise, steps, sampler,
+                              guidance_scale)
 
     def pixel_frame_count(self, frames: int) -> int:
         """Decoded frame count for a requested frame count (the ComfyUI
@@ -209,9 +291,9 @@ class WanPipeline:
                                    else it["seed"] % (2**31)),
                 (1, *lat_shape), jnp.float32)
             for it in items])
-        out = self._generate(self.params, jnp.asarray(ids), jnp.asarray(mask),
-                             noise, int(steps), canonical_sampler(sampler),
-                             jnp.float32(guidance_scale))
+        out = self._run(jnp.asarray(ids), jnp.asarray(mask), noise,
+                        int(steps), canonical_sampler(sampler),
+                        jnp.float32(guidance_scale), height, width)
         self._warm_keys.add((len(items), lat_shape, int(steps),
                              canonical_sampler(sampler)))
         return out

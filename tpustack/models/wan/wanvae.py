@@ -50,7 +50,8 @@ from tpustack.models.wan.config import (WAN21_LATENT_MEAN, WAN21_LATENT_STD,
                                         WanVAEConfig)
 
 __all__ = ["WAN21_LATENT_MEAN", "WAN21_LATENT_STD", "WanVAEDecoder",
-           "WanVAEEncoder", "latent_stats", "normalize_latents"]
+           "WanVAEDecoderStream", "WanVAEEncoder", "init_decode_caches",
+           "latent_stats", "normalize_latents"]
 
 
 def latent_stats(cfg: WanVAEConfig):
@@ -283,3 +284,185 @@ def normalize_latents(cfg: WanVAEConfig, mu):
         return mu
     mean, std = stats
     return ((mu.astype(jnp.float32) - mean) / std).astype(mu.dtype)
+
+
+# --------------------------------------------------------------- streaming
+# Temporally-chunked decode.  The full-sequence decoder above is the fast
+# path, but its activation maps scale with the PIXEL frame count (a 49-frame
+# 512x320 video wants ~24 GB of HBM for the final up-stages — measured OOM
+# on a 16 GB v5e).  The decoder is temporally CAUSAL, so upstream's
+# streaming execution (2-frame ``feat_cache`` per temporal conv) computes
+# bit-identical values with memory bounded by the chunk size; overlap-and-
+# discard chunking is NOT viable instead — the stacked kernel-3 convs give
+# the decoder a temporal receptive field of ~20+ latent frames, more than a
+# typical whole video.  These modules are the streaming twins of the ones
+# above: SAME submodule names in the SAME instantiation order, so
+# ``params["vae_decoder"]`` applies to either unchanged (the checkpoint
+# mapping is shared), and chunk 0 with zero caches reproduces the causal
+# left-padding exactly.  Exactness vs the fused decoder is pinned by
+# ``tests/test_wanvae_stream.py``.
+
+
+class WanCausalConv3dStream(nn.Module):
+    """Streaming twin of :class:`WanCausalConv3d`: the caller supplies the
+    ``kt - 1`` input frames of history (zeros on the first chunk — exactly
+    the causal left pad) and receives the updated history."""
+
+    features: int
+    kernel: Tuple[int, int, int] = (3, 3, 3)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, cache):
+        kt, kh, kw = self.kernel
+        if kt > 1:
+            x = jnp.concatenate([cache.astype(x.dtype), x], axis=1)
+        pad = [(0, 0), ((kh - 1) // 2, (kh - 1) // 2),
+               ((kw - 1) // 2, (kw - 1) // 2)]
+        y = nn.Conv(self.features, self.kernel, strides=self.stride,
+                    padding=pad, dtype=self.dtype)(x)
+        return y, (x[:, -(kt - 1):] if kt > 1 else None)
+
+
+class WanResBlockStream(nn.Module):
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, c1, c2):
+        h = WanRMSNorm(name="norm_1")(x)
+        h, c1 = WanCausalConv3dStream(self.features, dtype=self.dtype,
+                                      name="conv_1")(nn.silu(h), c1)
+        h = WanRMSNorm(name="norm_2")(h)
+        h, c2 = WanCausalConv3dStream(self.features, dtype=self.dtype,
+                                      name="conv_2")(nn.silu(h), c2)
+        if x.shape[-1] != self.features:
+            x, _ = WanCausalConv3dStream(self.features, kernel=(1, 1, 1),
+                                         dtype=self.dtype, name="skip")(x, None)
+        return x + h, c1, c2
+
+
+class WanResampleStream(nn.Module):
+    """Streaming twin of :class:`WanResample` (decoder modes only).
+
+    ``first`` (static): this chunk starts at global frame 0, whose 'Rep'
+    bypass skips the up3d time conv entirely; the tail stream then starts
+    with zero history (the caller's zero-initialised cache).  Interior
+    chunks feed every frame through the time conv with carried history.
+    """
+
+    mode: str  # "up2d" | "up3d"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, tcache, first: bool):
+        b, f, hh, ww, c = x.shape
+        if self.mode == "up3d":
+            tc = WanCausalConv3dStream(2 * c, kernel=(3, 1, 1),
+                                       dtype=self.dtype, name="time_conv")
+            if first:
+                head, tail = x[:, :1], x[:, 1:]
+                y, tcache = tc(tail, tcache)
+                pair = jnp.stack([y[..., :c], y[..., c:]], axis=2)
+                x = jnp.concatenate(
+                    [head, pair.reshape(b, 2 * (f - 1), hh, ww, c)], axis=1)
+            else:
+                y, tcache = tc(x, tcache)
+                pair = jnp.stack([y[..., :c], y[..., c:]], axis=2)
+                x = pair.reshape(b, 2 * f, hh, ww, c)
+        x = _nearest_up2x(x)
+        bb, ff = x.shape[0], x.shape[1]
+        x = x.reshape(bb * ff, *x.shape[2:])
+        x = nn.Conv(c // 2, (3, 3), padding=[(1, 1), (1, 1)],
+                    dtype=self.dtype, name="conv")(x)
+        return x.reshape(bb, ff, *x.shape[1:]), tcache
+
+
+class WanVAEDecoderStream(nn.Module):
+    """Chunked twin of :class:`WanVAEDecoder`: ``(z chunk, caches, first)``
+    -> ``(frames chunk, caches)``.  Caches come from
+    :func:`init_decode_caches`; chunk 0 must carry >= 2 latent frames (the
+    frame-0 'Rep' bypass plus a non-empty tail stream)."""
+
+    cfg: WanVAEConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, caches, first: bool):
+        c = self.cfg
+        new = dict(caches)
+        stats = latent_stats(c)
+        if stats is not None:
+            mean, std = stats
+            z = (z.astype(jnp.float32) * std + mean).astype(z.dtype)
+        z, _ = WanCausalConv3dStream(c.z_channels, kernel=(1, 1, 1),
+                                     dtype=self.dtype, name="conv_z")(z, None)
+        mults = [c.channel_mults[-1]] + list(reversed(c.channel_mults))
+        dims = [c.base_channels * m for m in mults]
+        up3d = tuple(reversed(c.temporal_downsample))
+        h, new["conv_in"] = WanCausalConv3dStream(
+            dims[0], dtype=self.dtype, name="conv_in")(z, caches["conv_in"])
+        h, new["mid_res_0/1"], new["mid_res_0/2"] = WanResBlockStream(
+            dims[0], dtype=self.dtype, name="mid_res_0")(
+            h, caches["mid_res_0/1"], caches["mid_res_0/2"])
+        h = WanAttnBlock(dtype=self.dtype, name="mid_attn")(h)
+        h, new["mid_res_1/1"], new["mid_res_1/2"] = WanResBlockStream(
+            dims[0], dtype=self.dtype, name="mid_res_1")(
+            h, caches["mid_res_1/1"], caches["mid_res_1/2"])
+        n = 0
+        for i, out_dim in enumerate(dims[1:]):
+            for _ in range(c.num_res_blocks + 1):
+                h, new[f"up_{n}/1"], new[f"up_{n}/2"] = WanResBlockStream(
+                    out_dim, dtype=self.dtype, name=f"up_{n}")(
+                    h, caches[f"up_{n}/1"], caches[f"up_{n}/2"])
+                n += 1
+            if i < len(c.channel_mults) - 1:
+                mode = "up3d" if up3d[i] else "up2d"
+                key = f"up_{n}/t"
+                h, tc = WanResampleStream(mode, dtype=self.dtype,
+                                          name=f"up_{n}")(
+                    h, caches.get(key), first)
+                if mode == "up3d":
+                    new[key] = tc
+                n += 1
+        h = WanRMSNorm(name="head_norm")(h)
+        h, new["head_conv"] = WanCausalConv3dStream(
+            3, dtype=self.dtype, name="head_conv")(nn.silu(h),
+                                                   caches["head_conv"])
+        return h, new
+
+
+def init_decode_caches(cfg: WanVAEConfig, b: int, h_lat: int, w_lat: int,
+                       dtype=jnp.float32):
+    """Zero history for every temporal conv in the streaming decoder, keyed
+    as :class:`WanVAEDecoderStream` expects.  Shapes walk the decoder's
+    stage structure: spatial resolution doubles after every resample; the
+    up3d time conv caches its INPUT (stage channels, pre-upsample
+    resolution)."""
+    mults = [cfg.channel_mults[-1]] + list(reversed(cfg.channel_mults))
+    dims = [cfg.base_channels * m for m in mults]
+    up3d = tuple(reversed(cfg.temporal_downsample))
+    z2 = lambda hh, ww, ch: jnp.zeros((b, 2, hh, ww, ch), dtype)
+    hh, ww = h_lat, w_lat
+    caches = {"conv_in": z2(hh, ww, cfg.z_channels),
+              "mid_res_0/1": z2(hh, ww, dims[0]),
+              "mid_res_0/2": z2(hh, ww, dims[0]),
+              "mid_res_1/1": z2(hh, ww, dims[0]),
+              "mid_res_1/2": z2(hh, ww, dims[0])}
+    n = 0
+    ch = dims[0]
+    for i, out_dim in enumerate(dims[1:]):
+        for _ in range(cfg.num_res_blocks + 1):
+            caches[f"up_{n}/1"] = z2(hh, ww, ch)      # conv_1 input channels
+            caches[f"up_{n}/2"] = z2(hh, ww, out_dim)
+            ch = out_dim
+            n += 1
+        if i < len(cfg.channel_mults) - 1:
+            if up3d[i]:
+                caches[f"up_{n}/t"] = z2(hh, ww, ch)
+            hh, ww = 2 * hh, 2 * ww
+            ch = ch // 2  # resample halves channels
+            n += 1
+    caches["head_conv"] = z2(hh, ww, ch)
+    return caches
