@@ -149,11 +149,12 @@ class WanPipeline:
             {"params": params["vae_decoder"]}, z_chunk, caches, first)
         return self._to_uint8(frames), caches
 
-    #: stream the VAE decode (bounded memory) when a batch row's decoded
-    #: pixel-frame volume exceeds this — the full-sequence decoder's
-    #: activation maps scale with F*H*W and a 49-frame 512x320 video
-    #: (8.0M px-frames) measured 23.9 GB > 16 GB HBM, while the 16-frame
-    #: default (2.1M) comfortably fits fused
+    #: stream the VAE decode (bounded memory) when the BATCH's decoded
+    #: pixel-frame volume (B·F·H·W) exceeds this — the full-sequence
+    #: decoder's activation maps scale with the whole batch: a 49-frame
+    #: 512x320 video (8.0M px-frames) measured 23.9 GB > 16 GB HBM, while
+    #: one 16-frame default row (2.1M) comfortably fits fused; two such
+    #: rows (4.2M) stream
     STREAM_DECODE_PIXELS = int(os.environ.get("WAN_VAE_STREAM_PIXELS",
                                               str(3_000_000)))
     #: latent frames per streamed decode chunk.  2 is the measured default:
@@ -161,11 +162,15 @@ class WanPipeline:
     #: chunk 2 on a 16 GB v5e; chunk 4's final-stage maps still OOM there
     STREAM_DECODE_CHUNK = int(os.environ.get("WAN_VAE_STREAM_CHUNK", "2"))
 
-    def _use_stream_decode(self, lat_shape, height: int, width: int) -> bool:
-        f_lat = lat_shape[0]
+    def _use_stream_decode(self, noise_shape, height: int, width: int) -> bool:
+        b, f_lat = noise_shape[0], noise_shape[1]
         if self.config.vae.arch != "wan" or f_lat < 2:
             return False
-        px = (1 + self.config.vae.temporal_scale * (f_lat - 1)) * height * width
+        # the fused decoder's activation maps scale with B*F*H*W, so the
+        # threshold compares the WHOLE batch's decoded volume — N rows each
+        # just under the solo threshold would otherwise OOM exactly like one
+        # oversized row
+        px = b * (1 + self.config.vae.temporal_scale * (f_lat - 1)) * height * width
         return px > self.STREAM_DECODE_PIXELS
 
     def _decode_streaming(self, x):
@@ -245,7 +250,7 @@ class WanPipeline:
              guidance_scale, height: int, width: int):
         """Denoise + decode, choosing fused or streaming decode by the
         decoded pixel-frame volume (``_use_stream_decode``)."""
-        if self._use_stream_decode(noise.shape[1:], height, width):
+        if self._use_stream_decode(noise.shape, height, width):
             x = self._generate_latents(self.params, ids, mask, noise, steps,
                                        sampler, guidance_scale)
             return self._decode_streaming(x)
@@ -272,11 +277,15 @@ class WanPipeline:
                             guidance_scale: float = 6.0, width: int = 512,
                             height: int = 320, sampler: str = "uni_pc"):
         """B independent singleton requests (own prompt/negative/seed each)
-        fused into ONE device program — the graph server's queue-depth>1
-        batching: CFG text encode, the whole denoise loop and the VAE decode
-        stream the weights once for all B.  Items sharing a seed+prompt
-        reproduce ``generate_async``'s output row-for-row (same per-item
-        noise construction).  Returns the device array ``[B, F, H, W, 3]``.
+        fused batch-wide — the graph server's queue-depth>1 batching: CFG
+        text encode and the whole denoise loop stream the weights once for
+        all B in one device program; the VAE decode joins that program while
+        the batch's decoded volume fits ``STREAM_DECODE_PIXELS``, else it
+        runs as the chunked streaming decoder (still batched per chunk —
+        B·F·H·W activation maps are exactly what the threshold bounds).
+        Items sharing a seed+prompt reproduce ``generate_async``'s output
+        row-for-row (same per-item noise construction).  Returns the device
+        array ``[B, F, H, W, 3]``.
 
         ``items``: list of ``{"prompt", "negative_prompt", "seed"}``.
         """

@@ -391,6 +391,11 @@ class WanVAEDecoderStream(nn.Module):
     @nn.compact
     def __call__(self, z, caches, first: bool):
         c = self.cfg
+        if first and z.shape[1] < 2:
+            raise ValueError(
+                f"chunk 0 needs >= 2 latent frames (got {z.shape[1]}): the "
+                "frame-0 'Rep' bypass leaves the up3d time conv an empty "
+                "tail stream")
         new = dict(caches)
         stats = latent_stats(c)
         if stats is not None:

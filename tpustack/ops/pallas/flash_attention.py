@@ -257,7 +257,13 @@ def flash_attention(
         panel_max_kv = PANEL_MAX_KV
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    streaming = k.shape[1] > panel_max_kv or q_offset is not None or kv_len is not None
+    # ONE kernel decision, made here and passed down: the block defaults
+    # below and the pallas_call branch in _flash_attention must agree (a
+    # panel program handed the streaming default block_q=1024 would overflow
+    # VMEM), so _flash_attention takes `streaming` as the verdict instead of
+    # re-deriving it.
+    streaming = (k.shape[1] > panel_max_kv or q_offset is not None
+                 or kv_len is not None)
     if block_q is None:
         block_q = 1024 if streaming else 128
     if block_k is None:
@@ -265,14 +271,15 @@ def flash_attention(
     return _flash_attention(q, k, v, causal=causal, scale=scale,
                             block_q=block_q, block_k=block_k,
                             interpret=interpret, q_offset=q_offset,
-                            kv_len=kv_len, panel_max_kv=panel_max_kv)
+                            kv_len=kv_len, streaming=streaming,
+                            panel_max_kv=panel_max_kv)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret",
-                                             "panel_max_kv"))
+                                             "streaming", "panel_max_kv"))
 def _flash_attention(q, k, v, *, causal, scale, block_q, block_k, interpret,
-                     q_offset, kv_len, panel_max_kv):
+                     q_offset, kv_len, streaming, panel_max_kv):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     hkv = k.shape[2]
@@ -281,7 +288,6 @@ def _flash_attention(q, k, v, *, causal, scale, block_q, block_k, interpret,
     g = h // hkv
     if scale is None:
         scale = d ** -0.5
-    dynamic = q_offset is not None or kv_len is not None
 
     bq = min(block_q, max(8, sq))
     # fold heads into batch; [B*H(q) / B*Hkv(kv), S, D]
@@ -293,7 +299,7 @@ def _flash_attention(q, k, v, *, causal, scale, block_q, block_k, interpret,
     # grid index bh = bi*h + hi → its K/V panel row is bh // g
     # = bi*hkv + hi//g, matching jnp.repeat(kv, g, axis=2) head expansion
 
-    if sk <= panel_max_kv and not dynamic:
+    if not streaming:
         kf = _pad_to(kf, 1, 128)
         vf = _pad_to(vf, 1, 128)
         sk_pad = kf.shape[1]
