@@ -39,7 +39,8 @@ def build_graph(*, prompt, negative, seed, width, height, frames, steps, cfg,
                 sampler, scheduler, denoise, unet_name=DEFAULT_UNET,
                 clip_name=DEFAULT_CLIP, vae_name=DEFAULT_VAE,
                 filename_prefix="wan_t2v", fps_webm=24, fps_webp=16,
-                save_webm=False, save_webp=False, save_images=False):
+                save_webm=False, save_webp=False, save_images=False,
+                batch_size=1):
     """ComfyUI-style {id: {class_type, inputs}} graph, same wiring as the
     reference workflow (UNET/CLIP/VAE loaders → encode ×2 → empty latent →
     KSampler → VAEDecode → save nodes)."""
@@ -56,7 +57,7 @@ def build_graph(*, prompt, negative, seed, width, height, frames, steps, cfg,
                 "inputs": {"clip": ["clip", 0], "text": negative}},
         "latent": {"class_type": "EmptyHunyuanLatentVideo",
                    "inputs": {"width": width, "height": height,
-                              "length": frames, "batch_size": 1}},
+                              "length": frames, "batch_size": batch_size}},
         "sample": {"class_type": "KSampler",
                    "inputs": {"model": ["unet", 0], "positive": ["pos", 0],
                               "negative": ["neg", 0],
@@ -248,6 +249,10 @@ def main(argv=None):
     ap.add_argument("--unet", default=DEFAULT_UNET)
     ap.add_argument("--clip", default=DEFAULT_CLIP)
     ap.add_argument("--vae", default=DEFAULT_VAE)
+    ap.add_argument("--batch-size", type=int, default=1,
+                    help="In-graph latent batch (EmptyHunyuanLatentVideo "
+                         "batch_size): one graph yields B videos stacked "
+                         "along the frame axis, row i seeded seed+i.")
     args = ap.parse_args(argv)
 
     want_webm = args.mode == "video" and args.format in ("webm", "both")
@@ -292,7 +297,8 @@ def main(argv=None):
                 scheduler=args.scheduler, denoise=args.denoise,
                 unet_name=args.unet, clip_name=args.clip, vae_name=args.vae,
                 filename_prefix=prefix, save_webm=want_webm,
-                save_webp=want_webp, save_images=want_images)
+                save_webp=want_webp, save_images=want_images,
+                batch_size=args.batch_size)
             print(f"[{i}/{args.count}] queueing (seed={seed})...")
             pid = submit(args.server_url, graph, client_id)
             entry = wait_for_result(args.server_url, pid)

@@ -171,6 +171,64 @@ def test_e2e_webp_and_image_graphs(server):
     _run(scenario())
 
 
+def test_in_graph_batch_size_rows_equal_solo(server):
+    """r5 (VERDICT #8): one REAL-client graph with ``batch_size: 2``
+    returns 2 videos (stacked along the frame axis, ComfyUI batch
+    semantics) and each row equals the solo run at its derived seed
+    (row i = seed + i) — the documented convention."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    kw = dict(frames=5, save_webp=False, save_images=True, seed=11,
+              steps=1)
+
+    async def fetch_pngs(http, graph):
+        _, entry = await _submit_and_wait(http, graph)
+        files = client_mod.result_files(entry)
+        outs = []
+        for f in files:
+            r = await http.get("/view", params={
+                "filename": f["filename"], "subfolder": "", "type": "output"})
+            assert r.status == 200
+            outs.append(await r.read())
+        return outs
+
+    async def scenario():
+        http = TestClient(TestServer(server.build_app()))
+        await http.start_server()
+        try:
+            batched = await fetch_pngs(http, _tiny_graph(batch_size=2, **kw))
+            solo_a = await fetch_pngs(http, _tiny_graph(batch_size=1, **kw))
+            solo_b = await fetch_pngs(
+                http, _tiny_graph(batch_size=1, **dict(kw, seed=12)))
+            return batched, solo_a, solo_b
+        finally:
+            await http.close()
+
+    batched, solo_a, solo_b = _run(scenario())
+    # 5 requested frames at tiny temporal_scale → n decoded frames per row;
+    # the batched graph yields both rows' stills in order
+    assert len(batched) == len(solo_a) + len(solo_b), (
+        f"batch of 2 gave {len(batched)} frames, solo runs "
+        f"{len(solo_a)}+{len(solo_b)}")
+
+    import io
+
+    from PIL import Image
+
+    def arrays(pngs):
+        return [np.asarray(Image.open(io.BytesIO(b)), np.int16) for b in pngs]
+
+    # batching reorders a few XLA fusions; a float wobble may cross one
+    # uint8 level (same bar as the queue-batching row-parity test)
+    for name, got, want in (("row 0", arrays(batched[:len(solo_a)]),
+                             arrays(solo_a)),
+                            ("row 1", arrays(batched[len(solo_a):]),
+                             arrays(solo_b))):
+        for g, w in zip(got, want):
+            d = np.abs(g - w).max()
+            assert d <= 1, f"{name} diverged from its solo run (max {d})"
+
+
 def test_back_to_back_prompts_pipeline_through_worker(server):
     """Exercises the worker's overlap branch (prompt k+1 dispatched before
     prompt k's deferred saves run): submit three prompts at once, all must

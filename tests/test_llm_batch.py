@@ -244,9 +244,11 @@ def test_server_batched_streaming_coalesces(gen):
         assert final is not None and final["tokens_predicted"] <= 6
 
 
-def test_server_seeded_sampling_stays_solo(gen):
-    """A seeded non-greedy request must bypass the batcher (reproducibility
-    would otherwise depend on batch composition)."""
+def test_server_seeded_sampling_batches_and_reproduces(gen):
+    """r5: seeded non-greedy requests go through the continuous engine
+    (per-slot PRNG streams make them admission-timing independent) — the
+    r4 solo carve-out is gone, and the same (prompt, seed) posted twice
+    returns identical content even with a concurrent peer in the batch."""
     import asyncio
 
     from aiohttp.test_utils import TestClient, TestServer
@@ -256,27 +258,37 @@ def test_server_seeded_sampling_stays_solo(gen):
 
     server = LLMServer(generator=gen, tokenizer=ByteTokenizer(512),
                        model_name="tiny-test", max_batch=4)
-    real_cont = gen._decode_scan_cont
-    gen._decode_scan_cont = lambda *a, **kw: (_ for _ in ()).throw(
-        AssertionError("seeded request must not be batched"))
+    real_solo = gen.generate_fused
+    gen.generate_fused = lambda *a, **kw: (_ for _ in ()).throw(
+        AssertionError("seeded request must ride the continuous engine"))
 
     async def scenario():
         client = TestClient(TestServer(server.build_app()))
         await client.start_server()
         try:
-            r = await client.post("/completion", json={
-                "prompt": "hello", "n_predict": 4, "seed": 7,
-                "temperature": 0.9})
-            assert r.status == 200
-            return await r.json()
+            seeded = {"prompt": "hello", "n_predict": 6, "seed": 7,
+                      "temperature": 0.9}
+            # run 1: alone; run 2: alongside a greedy peer — content must
+            # not change with batch composition
+            r1 = await client.post("/completion", json=seeded)
+            assert r1.status == 200
+            j1 = await r1.json()
+            peer = client.post("/completion", json={
+                "prompt": "peer request", "n_predict": 12, "temperature": 0})
+            again = client.post("/completion", json=seeded)
+            rp, r2 = await asyncio.gather(peer, again)
+            assert rp.status == 200 and r2.status == 200
+            return j1, await r2.json()
         finally:
             await client.close()
 
     try:
-        j = asyncio.new_event_loop().run_until_complete(scenario())
+        j1, j2 = asyncio.new_event_loop().run_until_complete(scenario())
     finally:
-        gen._decode_scan_cont = real_cont
-    assert j["tokens_predicted"] <= 4
+        gen.generate_fused = real_solo
+    assert j1["tokens_predicted"] <= 6
+    assert j1["content"] == j2["content"], (
+        "seeded output changed with batch composition")
 
 
 @pytest.mark.slow

@@ -124,6 +124,75 @@ def test_engine_per_row_budget_not_shared(gen):
     assert len(results[1][0]) == 30
 
 
+def test_engine_seeded_sampling_admission_invariance(gen):
+    """r5 (VERDICT #4): a SEEDED non-greedy request's output is identical
+    whether it runs alone, with peers from the start, or is admitted
+    mid-run — per-slot PRNG streams keyed by the request seed."""
+    SEEDED = dict(ids=[5, 6, 7, 8], max_new=8, seed=1234,
+                  sample=SampleConfig(temperature=1.2, top_k=8))
+
+    def run_seeded(extra_requests):
+        eng = ContinuousEngine(gen, slots=4, chunk=4)
+        results = {}
+        queue = [SlotRequest(on_done=lambda t, s: results.__setitem__(0, t),
+                             **SEEDED)]
+        queue += [SlotRequest(ids=r["ids"], max_new=r["max_new"],
+                              sample=GREEDY) for r in extra_requests]
+        eng.run(lambda: queue.pop(0) if queue else None)
+        return results[0]
+
+    def run_admitted_mid_run():
+        # a greedy peer starts first; the seeded request joins chunks later
+        eng = ContinuousEngine(gen, slots=4, chunk=4)
+        state = {"fed_peer": False, "late": None}
+        results = {}
+
+        def peer_tokens(toks):
+            if state["fed_peer"] is True:   # arm the late joiner once
+                state["late"] = SlotRequest(
+                    on_done=lambda t, s: results.__setitem__("late", t),
+                    **SEEDED)
+                state["fed_peer"] = "armed"
+
+        def feed():
+            if not state["fed_peer"]:
+                state["fed_peer"] = True
+                return SlotRequest(ids=[9, 10], max_new=20, sample=GREEDY,
+                                   on_tokens=peer_tokens)
+            if state["late"] is not None:
+                late, state["late"] = state["late"], None
+                return late
+            return None
+
+        eng.run(feed)
+        return results["late"]
+
+    out_alone = run_seeded([])
+    out_peers = run_seeded([{"ids": [9, 10], "max_new": 12},
+                            {"ids": [11, 12, 13], "max_new": 3}])
+    out_late = run_admitted_mid_run()
+    assert out_alone == out_peers, "seeded output changed with batch peers"
+    assert out_alone == out_late, "seeded output changed with admission timing"
+    assert len(out_alone) == 8
+
+
+def test_engine_long_prompt_admits_into_slots(gen):
+    """r5 (VERDICT #4): prompts longer than ctx/2 are slot citizens (each
+    slot owns a full max_seq line) — they decode alongside short peers and
+    both match their solo outputs."""
+    long_p = list(range(1, 41))       # 40 of max_seq 64 > ctx/2
+    short_p = [5, 6, 7]
+    solo_long = gen.generate_fused(long_p, max_new_tokens=6, sample=GREEDY,
+                                   stop_tokens=(2,), chunk=4)[0]
+    solo_short = gen.generate_fused(short_p, max_new_tokens=6, sample=GREEDY,
+                                    stop_tokens=(2,), chunk=4)[0]
+    eng = ContinuousEngine(gen, slots=2, chunk=4, stop_tokens=(2,))
+    results, _ = _run(eng, [{"ids": long_p, "max_new": 6},
+                            {"ids": short_p, "max_new": 6}])
+    assert results[0][0] == solo_long
+    assert results[1][0] == solo_short
+
+
 def test_engine_mixed_sampling(gen):
     """A temperature row rides along; the greedy peer stays exact."""
     eng = ContinuousEngine(gen, slots=2, chunk=4)

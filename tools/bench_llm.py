@@ -121,6 +121,8 @@ def main() -> int:
             return None, {"prefill_s": float("inf"),  # folded into wall time
                           "decode_s": stats["wall_s"],
                           "generated_tokens": stats["generated_tokens"],
+                          "steady_tokens_per_s": stats.get(
+                              "steady_tokens_per_s"),
                           "tokens_per_s": stats["tokens_per_s"]}
 
         loop = None
@@ -143,13 +145,16 @@ def main() -> int:
     if loop is not None:
         loop(0)
 
-    pre, dec, dec_loop = [], [], []
+    pre, dec, dec_loop, steady = [], [], [], []
     for i in range(args.repeats):
         _, stats = fused(i + 1)
         if math.isfinite(stats["prefill_s"]):  # --continuous folds prefill
             pre.append(args.batch * args.prompt_tokens / stats["prefill_s"])
         dec.append(stats["tokens_per_s"])
         extra = ""
+        if stats.get("steady_tokens_per_s"):
+            steady.append(stats["steady_tokens_per_s"])
+            extra = f", steady decode {steady[-1]:.1f} tok/s"
         if loop is not None:
             _, lstats = loop(i + 1)
             dec_loop.append(lstats["tokens_per_s"])
@@ -169,7 +174,7 @@ def main() -> int:
     from tpustack.utils.peaks import device_peaks
 
     peak = device_peaks(jax.devices()[0])
-    decode_mbu = prefill_mfu = roofline_pct = None
+    decode_mbu = prefill_mfu = roofline_pct = prefill_roofline_pct = None
     if peak and not (args.batch > 1 and args.continuous):
         # continuous mode's rate is end-to-end (admissions folded in) —
         # dividing it by per-step bytes would understate the roofline; the
@@ -237,6 +242,8 @@ def main() -> int:
                   f"{kv_tag}{batch_tag}{mode_tag}_tokens_per_sec",
         "value": round(statistics.median(dec), 2),
         "unit": "tokens/s/chip",
+        "steady_decode_tokens_per_sec": (round(statistics.median(steady), 2)
+                                         if steady else None),
         "prefill_tokens_per_sec": (round(statistics.median(pre), 1)
                                    if pre else None),
         "per_token_loop_tokens_per_sec": (round(statistics.median(dec_loop), 2)

@@ -140,7 +140,7 @@ def main() -> int:
         result["content_check"] = bench._content_check(
             log, families="wan,flash", workdir="verify_hw_wan",
             out=os.path.join(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))), "HWVERIFY_wan_r04.json"))
+                os.path.abspath(__file__))), "HWVERIFY_wan_r05.json"))
     print(json.dumps(result))
     return 0
 

@@ -16,37 +16,56 @@ static-shape rules:
   persistent KV cache ``[B, max_seq]``.  Idle slots decode garbage at
   position 0 — decode streams the weights once per step regardless of how
   many slots are live, so an idle slot costs almost nothing.
-- **Per-slot contiguous cache lines**: row i writes at ``cur[i]`` (the [B]
-  vector-index scatter path in ``LlamaAttention``), attends ``[0, cur[i]]``
-  with true RoPE positions.  No shared prompt bucket: every row's budget is
-  its own ``max_seq - len(prompt)``, unlike ``generate_batch``'s
-  longest-peer bucket.
-- **Admission at chunk boundaries**: a joining request runs the normal B=1
-  (possibly chunked long-context) prefill, its KV line is spliced into the
-  slot cache (``_insert_cache_row``), and its first sampled token overrides
-  that slot's lane in the chain's carry — all device-side updates, so the
-  depth-2 pipelined chunk chain NEVER drains for an admission.  In-flight
-  chunks dispatched before admission stay valid for every other slot (rows
-  are independent); the new slot's lanes in those chunks are garbage the
-  host ignores via per-dispatch snapshots.
+- **Per-slot contiguous cache lines**: row i decodes at its own frontier
+  ``cur[i]``, attends ``[0, cur[i]]`` with true RoPE positions.  No shared
+  prompt bucket: every row's budget is its own ``max_seq - len(prompt)``,
+  unlike ``generate_batch``'s longest-peer bucket.
+- **Chunk-local K/V accumulation**: within a decode chunk the main cache is
+  FROZEN — each step's K/V land in a small per-layer ``[B, chunk]`` buffer
+  at the uniform step index, attention merges {cache prefix} ∪ {buffer}
+  with an exact streaming-softmax split, and the buffer flushes into the
+  per-row cache lines once per chunk (``Generator._decode_scan_cont``).
+  The r4 one-hot write-back rewrote the whole cache every step (~2x KV
+  traffic for concurrent long-context decodes); write-back now amortises
+  by the chunk length, so concurrent deep decodes stay KV-read-bound.
+- **Overlapped admission at chunk boundaries**: a joining request's prefill
+  (normal, possibly chunked long-context), KV-line splice
+  (``_insert_cache_rows``), first-token sampling, and slot activation
+  (``_slot_activate``) are ALL device-side dispatches — the host never
+  syncs on them, so the depth-``depth`` pipelined chunk chain keeps
+  flowing while prefill is still in flight.  The host picks up the first
+  tokens (one tiny [n]-int32 fetch) at the next natural sync point, or as
+  soon as the device reports them ready.  In-flight chunks dispatched
+  before admission stay valid for every other slot (rows are independent);
+  the new slot's lanes in those chunks are garbage the host ignores via
+  per-dispatch snapshots.
+- **Per-slot PRNG streams**: each request's sampling chain is seeded from
+  its own ``seed`` (or a fresh random one) and advanced once per generated
+  token, so sampled output — like greedy — is a pure function of (request,
+  seed): independent of admission timing and batch composition.  That is
+  what lets the server put seeded-sampled requests in slots instead of the
+  r4 solo carve-out.
 - **Retirement at fetch**: a row hitting EOS/budget is answered immediately
   (``on_done``) and its slot parked (``active=0``, ``cur=0``) then reused.
 
 Safety of the fetch-lag overshoot (host retires up to ``depth`` chunks after
 the device computed them): ``cur`` clamps at ``max_seq - 1``, a parked slot
-freezes at position 0, and a reassigned slot's prefill + contiguous decode
-overwrite every position its mask will ever attend — stale garbage is
-unreachable by construction.
+freezes at position 0, overshoot steps are clipped out of the chunk-flush
+window (never written to the cache at all), and a reassigned slot's prefill
++ contiguous decode overwrite every position its mask will ever attend.
 
-Measured (v5e, Qwen-7B int8+int8KV, 8x(128 prompt + 128 new), ctx 2048):
-steady-state decode 645 tok/s aggregate — identical to the static batcher's
-scan — and 441 tok/s end-to-end vs the static path's ~483, the ~9% being
-the admission tax of slot semantics (per-wave inline prefill + splice).
-Known trade-off: the per-row one-hot cache write adds a full cache
-write-back pass per step; negligible at ctx ≤ 4k next to the weight
-stream, but concurrent ~32k-context decodes would roughly double KV
-traffic — the future fix is chunk-local K/V accumulation merged via
-streaming softmax, not scatter (7x slower on TPU, measured).
+Measured (v5e, Qwen-7B int8+int8KV, ``tools/bench_llm.py --continuous`` —
+the numbers BASELINE.md quotes for batched serving, since this engine IS
+the served path):
+
+- 8x(128 prompt + 512 new), ctx 2048: **687 tok/s end-to-end, 736 tok/s
+  steady aggregate decode** — vs the static batcher's 630 decode-phase /
+  ~371 e2e same-session (the r4 engine measured 441 e2e: +9% admission tax
+  then; the r5 engine's zero-sync admissions + chunk-local K/V turned that
+  into a 17% steady-state LEAD over the static path).
+- 2x(16384 prompt + 96 new), ctx 32768: **143.8 tok/s steady = 92% of
+  2x the solo-row rate** (78.1 tok/s) — the long-context write-back cliff
+  the r4 docstring predicted ("would roughly double KV traffic") is gone.
 """
 
 from __future__ import annotations
@@ -75,6 +94,9 @@ class SlotRequest:
     includes a terminal stop token if one was generated).  ``on_done(tokens,
     stats)``: called exactly once when the row retires.  ``cancelled()``:
     polled at chunk boundaries — True retires the row without further decode.
+    ``seed``: sampling PRNG seed — a seeded non-greedy request reproduces
+    its output exactly regardless of admission timing / batch peers (per-
+    slot key chains); None draws a fresh random seed.
     """
 
     ids: List[int]
@@ -83,11 +105,12 @@ class SlotRequest:
     on_tokens: Optional[Callable[[List[int]], None]] = None
     on_done: Optional[Callable[[List[int], Dict], None]] = None
     cancelled: Callable[[], bool] = lambda: False
+    seed: Optional[int] = None
 
 
 class _Slot:
     __slots__ = ("req", "out", "budget", "gen_id", "t0", "prefill_s",
-                 "dispatched", "done")
+                 "dispatched", "done", "pending")
 
     def __init__(self):
         self.req: Optional[SlotRequest] = None
@@ -98,6 +121,20 @@ class _Slot:
         self.prefill_s = 0.0
         self.dispatched = 0  # decode steps dispatched for this occupancy
         self.done = True
+        self.pending = False  # admission dispatched, firsts not yet fetched
+
+
+class _PendingWave:
+    """One dispatched-but-unresolved admission group: the device is (or
+    soon will be) holding the group's first tokens; ``resolve`` fetches
+    them and completes the host-side bookkeeping."""
+
+    __slots__ = ("rows", "firsts_dev", "t0")
+
+    def __init__(self, rows, firsts_dev, t0):
+        self.rows = rows            # [(slot_idx, req, budget)]
+        self.firsts_dev = firsts_dev
+        self.t0 = t0
 
 
 class ContinuousEngine:
@@ -116,6 +153,7 @@ class ContinuousEngine:
         self.stop_tokens = stop_tokens
         self.depth = depth
         self._to_park: List[int] = []  # retirements awaiting a fused park
+        self._pending: List[_PendingWave] = []
         self._retired_tokens = 0
 
     # ------------------------------------------------------------ device state
@@ -129,18 +167,18 @@ class ContinuousEngine:
             "temp": jnp.zeros((self.B,), jnp.float32),
             "topk": jnp.zeros((self.B,), jnp.int32),
             "greedy": jnp.ones((self.B,), jnp.bool_),
-            "key": jax.random.PRNGKey(np.random.randint(0, 2**31)),
+            "keys": jnp.zeros((self.B, 2), jnp.uint32),
         }
 
     # ---------------------------------------------------------------- admission
-    def _admit_many(self, state, slots: List[_Slot],
-                    waves: List[Tuple[int, SlotRequest]], gen_ctr: int):
-        """Admit several requests in ONE wave: a single batched prefill
-        (the same program the static batcher used), one fused cache splice,
-        one fused slot-state update, one host sync for the first tokens.
-        Mid-run singles take the same path with n=1."""
-        from tpustack.models.llama import init_kv_caches
-
+    def _admit_dispatch(self, state, slots: List[_Slot],
+                        waves: List[Tuple[int, SlotRequest]], gen_ctr: int):
+        """Dispatch admissions WITHOUT any host sync: per prompt-bucket
+        group, one batched prefill (the static batcher's program), one
+        fused cache splice, one device-side first-token sample + slot
+        activation.  The chunk chain keeps flowing behind these — the host
+        resolves the first tokens later (``_resolve``).  Mid-run singles
+        take the same path with n=1."""
         g, c = self.gen, self.gen.cfg
         t0 = time.time()
         valid: List[Tuple[int, SlotRequest, int]] = []  # (slot, req, budget)
@@ -148,7 +186,7 @@ class ContinuousEngine:
             s = slots[i]
             s.req, s.out, s.dispatched = req, [], 0
             s.gen_id = gen_ctr = gen_ctr + 1
-            s.t0, s.done = t0, False
+            s.t0, s.done, s.pending = t0, False, False
             s.prefill_s = 0.0  # else a zero-budget retire below reports the
             # slot's PREVIOUS occupant's prefill time
             n_prompt = len(req.ids)
@@ -167,67 +205,118 @@ class ContinuousEngine:
         if not valid:
             return gen_ctr
 
-        n = len(valid)
-        bucket = g._bucket(max(len(r.ids) for _, r, _ in valid))
-        tokens = np.zeros((n, bucket), np.int32)
-        for j, (_, r, _) in enumerate(valid):
-            tokens[j, :len(r.ids)] = r.ids
-        lengths = jnp.asarray([len(r.ids) for _, r, _ in valid], jnp.int32)
-        row_caches = init_kv_caches(c, n, dtype=g.cache_dtype)
-        if bucket > g.PREFILL_CHUNK:
-            logits, row_caches = g._prefill_long(tokens, lengths, row_caches)
-        else:
-            logits, row_caches = g._prefill(g.params, jnp.asarray(tokens),
-                                            lengths, row_caches)
-        slot_ids = jnp.asarray([i for i, _, _ in valid], jnp.int32)
-        state["caches"] = g._insert_cache_rows(
-            state["caches"], row_caches, slot_ids, n, bucket)
-        # first tokens sampled ON DEVICE (one dispatch), then ONE tiny
-        # [n]-int32 fetch — never the [n, vocab] logits themselves
-        firsts = [int(t) for t in np.asarray(g._sample_logits_jit(
-            logits, jax.random.PRNGKey(np.random.randint(0, 2**31)),
-            jnp.asarray([r.sample.temperature for _, r, _ in valid],
-                        jnp.float32),
-            jnp.asarray([r.sample.top_k for _, r, _ in valid], jnp.int32),
-            jnp.asarray([r.sample.greedy for _, r, _ in valid], jnp.bool_)))]
-        t_prefill = time.time() - t0
-        mask = np.zeros((self.B,), bool)
-        new_cur = np.zeros((self.B,), np.int32)
-        new_first = np.zeros((self.B, 1), np.int32)
-        new_temp = np.zeros((self.B,), np.float32)
-        new_topk = np.zeros((self.B,), np.int32)
-        new_greedy = np.zeros((self.B,), bool)
-        live_after = self._live(slots)
-        for (i, r, budget), first in zip(valid, firsts):
-            s = slots[i]
-            s.prefill_s = t_prefill
-            s.out = [first]
-            if r.on_tokens is not None:
-                r.on_tokens([first])
-            if first in self.stop_tokens or budget <= 1:
-                self._retire(state, slots, i, live_after, park=False)
-                continue
-            mask[i] = True
-            new_cur[i] = len(r.ids)
-            new_first[i] = first
-            new_temp[i] = r.sample.temperature
-            new_topk[i] = r.sample.top_k
-            new_greedy[i] = r.sample.greedy
-        if mask.any():
+        # group by prefill bucket: a 16-token prompt must not pay a 16k
+        # peer's padded prefill (the engine admits ANY prompt that fits ctx
+        # — long prompts included — so buckets can differ wildly in a wave)
+        groups: Dict[int, List[Tuple[int, SlotRequest, int]]] = {}
+        for row in valid:
+            groups.setdefault(g._bucket(len(row[1].ids)), []).append(row)
+
+        for bucket, rows in sorted(groups.items()):
+            n = len(rows)
+            tokens = np.zeros((n, bucket), np.int32)
+            for j, (_, r, _) in enumerate(rows):
+                tokens[j, :len(r.ids)] = r.ids
+            lengths = jnp.asarray([len(r.ids) for _, r, _ in rows], jnp.int32)
+            row_caches = init_kv_caches(c, n, dtype=g.cache_dtype)
+            if bucket > g.PREFILL_CHUNK:
+                logits, row_caches = g._prefill_long(tokens, lengths,
+                                                     row_caches)
+            else:
+                logits, row_caches = g._prefill(g.params, jnp.asarray(tokens),
+                                                lengths, row_caches)
+            slot_ids = jnp.asarray([i for i, _, _ in rows], jnp.int32)
+            state["caches"] = g._insert_cache_rows(
+                state["caches"], row_caches, slot_ids, n, bucket)
+            seeds = jnp.asarray(
+                [r.seed if r.seed is not None else np.random.randint(0, 2**31)
+                 for _, r, _ in rows], jnp.uint32)
+            firsts, row_keys = g._admit_sample_jit(
+                logits, seeds,
+                jnp.asarray([r.sample.temperature for _, r, _ in rows],
+                            jnp.float32),
+                jnp.asarray([r.sample.top_k for _, r, _ in rows], jnp.int32),
+                jnp.asarray([r.sample.greedy for _, r, _ in rows],
+                            jnp.bool_))
             (state["cur"], state["active"], state["first"], state["temp"],
-             state["topk"], state["greedy"]) = g._slot_update(
+             state["topk"], state["greedy"], state["keys"]) = g._slot_activate(
                 state["cur"], state["active"], state["first"], state["temp"],
-                state["topk"], state["greedy"], jnp.asarray(mask),
-                jnp.asarray(new_cur), jnp.asarray(mask, jnp.int32),
-                jnp.asarray(new_first), jnp.asarray(new_temp),
-                jnp.asarray(new_topk), jnp.asarray(new_greedy))
+                state["topk"], state["greedy"], state["keys"], slot_ids,
+                lengths, firsts,
+                jnp.asarray([r.sample.temperature for _, r, _ in rows],
+                            jnp.float32),
+                jnp.asarray([r.sample.top_k for _, r, _ in rows], jnp.int32),
+                jnp.asarray([r.sample.greedy for _, r, _ in rows],
+                            jnp.bool_),
+                row_keys)
+            for i, _, _ in rows:
+                slots[i].pending = True
+            self._pending.append(_PendingWave(rows, firsts, t0))
         return gen_ctr
+
+    def _resolve(self, state, slots: List[_Slot], wave: _PendingWave):
+        """Host-side completion of a dispatched admission: fetch the n
+        first tokens (ready, or blocks until prefill lands), report them,
+        and retire rows that already ended (stop-token first, budget 1).
+        ``prefill_s`` is wall time from dispatch to resolution — with
+        overlap this is the request's true time-to-first-token."""
+        firsts = [int(t) for t in np.asarray(wave.firsts_dev)]
+        t_first = time.time() - wave.t0
+        live = self._live(slots)
+        for (i, req, budget), first in zip(wave.rows, firsts):
+            s = slots[i]
+            if s.req is not req:  # impossible today (pending slots can't be
+                continue          # reassigned); guard against future edits
+            s.pending = False
+            s.prefill_s = t_first
+            s.out = [first]
+            if req.on_tokens is not None:
+                req.on_tokens([first])
+            if first in self.stop_tokens or budget <= 1 or req.cancelled():
+                s.done = True
+                self._retire(state, slots, i, live)
+
+    def _resolve_pending(self, state, slots, only_ready: bool = False,
+                         needed_slots=None):
+        """Resolve dispatched admissions.
+
+        ``only_ready``: non-blocking fast path — resolve waves whose first
+        tokens already landed (SSE first-token latency doesn't wait for
+        the next chain fetch), EXCEPT that waves containing a row no
+        future chunk will ever carry (budget 1: ``dispatch_ok`` is false
+        from birth, so no snapshot will force a resolve) are treated as
+        must-resolve, or that client would wait for the whole busy period.
+
+        ``needed_slots``: when given (the fetch-boundary call), ONLY waves
+        touching those slots — or urgent ones — resolve blockingly; a
+        freshly dispatched long-prompt admission's prefill must not stall
+        delivery of tokens that are already fetched for everyone else."""
+        if not self._pending:
+            return
+        remaining = []
+        for wave in self._pending:
+            urgent = any(budget <= 1 for _, _, budget in wave.rows)
+            if needed_slots is not None:
+                must = urgent or any(i in needed_slots
+                                     for i, _, _ in wave.rows)
+            elif only_ready:
+                try:
+                    must = urgent or wave.firsts_dev.is_ready()
+                except AttributeError:  # older jax.Array without is_ready
+                    must = urgent
+            else:
+                must = True
+            if must:
+                self._resolve(state, slots, wave)
+            else:
+                remaining.append(wave)
+        self._pending = remaining
 
     def _retire(self, state, slots: List[_Slot], i: int, batch_size: int,
                 park: bool = True):
         s = slots[i]
         req, out = s.req, s.out
-        s.req, s.done = None, True
+        s.req, s.done, s.pending = None, True, False
         self._retired_tokens += len(out)  # incl. the admission-sampled first
         if park:
             # coalesced: applied in ONE _slot_update before the next dispatch
@@ -267,7 +356,8 @@ class ContinuousEngine:
 
     # --------------------------------------------------------------------- run
     def run(self, feed: Callable[[], Optional[SlotRequest]]) -> Dict:
-        """Decode loop: admit → keep ``depth`` chunks in flight → fetch →
+        """Decode loop: admit (dispatch-only) → keep ``depth`` chunks in
+        flight → fetch (resolving admissions at the fetch boundary) →
         retire/admit → repeat, until idle and ``feed()`` is empty."""
         g, c = self.gen, self.gen.cfg
         state = self._fresh_state()
@@ -276,8 +366,13 @@ class ContinuousEngine:
         gen_ctr = 0
         t_start = time.time()
         admitted = 0
-        self._to_park: List[int] = []
+        self._to_park = []
+        self._pending = []
         self._retired_tokens = 0  # per-run total, counted at _retire
+        # (wall time, tokens consumed so far) at each block fetch: the
+        # steady-state decode rate is the slope between the first and last
+        # marks — what the bench reports alongside end-to-end tokens/s
+        fetch_marks: List[Tuple[float, int]] = []
 
         def admit_free() -> None:
             nonlocal gen_ctr, admitted
@@ -291,7 +386,7 @@ class ContinuousEngine:
                 admitted += 1
                 wave.append((i, req))
             if wave:
-                gen_ctr = self._admit_many(state, slots, wave, gen_ctr)
+                gen_ctr = self._admit_dispatch(state, slots, wave, gen_ctr)
 
         def dispatch_ok(s: _Slot) -> bool:
             # this row still wants tokens the chain hasn't covered (budget
@@ -306,29 +401,47 @@ class ContinuousEngine:
             admit_free()
             if self._live(slots) == 0:
                 break
+            # deliver first tokens the moment the device has them (non-
+            # blocking) — streaming clients see them before the next chunk
+            self._resolve_pending(state, slots, only_ready=True)
             while len(chain) < self.depth and any(
                     dispatch_ok(s) for s in slots):
                 snapshot = [(i, s.gen_id, s.dispatched)
                             for i, s in enumerate(slots) if dispatch_ok(s)]
-                toks, last, state["cur"], state["caches"], state["key"] = (
-                    g._decode_scan_cont(
-                        g.params, state["first"], state["cur"],
-                        state["active"], state["caches"], state["key"],
-                        state["temp"], state["topk"], state["greedy"],
-                        self.chunk))
+                (toks, last, state["cur"], state["caches"],
+                 state["keys"]) = g._decode_scan_cont(
+                    g.params, state["first"], state["cur"],
+                    state["active"], state["caches"], state["keys"],
+                    state["temp"], state["topk"], state["greedy"],
+                    self.chunk)
                 state["first"] = last
                 for i, _, _ in snapshot:
                     slots[i].dispatched += self.chunk
                 chain.append((toks, snapshot))
             if not chain:
-                # every live row is done-but-unparked or out of budget —
-                # loop re-enters retire bookkeeping via empty fetch below
+                # every live row is pending-resolution, done-but-unparked,
+                # or out of budget: resolve (blocking — their retires need
+                # first tokens), then re-enter retire bookkeeping
+                self._resolve_pending(state, slots)
                 for i, s in enumerate(slots):
                     if s.req is not None and (s.done or not dispatch_ok(s)):
                         self._retire(state, slots, i, self._live(slots))
                 continue
             block, snapshot = chain.popleft()
+            pending_here = {i for i, _, _ in snapshot if slots[i].pending}
+            if pending_here or self._pending:
+                # this block may carry decode steps for rows whose first
+                # token the host hasn't picked up yet — resolve exactly
+                # those waves (their prefill precedes this block in device
+                # order, so that cannot block longer than the block fetch
+                # itself); waves for OTHER slots (e.g. a long-prompt
+                # admission dispatched this iteration) stay pending so
+                # already-computed tokens are never stalled behind them
+                self._resolve_pending(state, slots,
+                                      needed_slots=pending_here)
             block = np.asarray(block)
+            fetch_marks.append((time.time(), self._retired_tokens + sum(
+                len(s.out) for s in slots if s.req is not None)))
             live = self._live(slots)
             for i, gid, offset in snapshot:
                 s = slots[i]
@@ -358,4 +471,8 @@ class ContinuousEngine:
         stats = {"requests": admitted, "generated_tokens": n_tok,
                  "wall_s": dt,
                  "tokens_per_s": n_tok / dt if dt > 0 else 0.0}
+        if len(fetch_marks) >= 2:
+            (t0m, c0), (t1m, c1) = fetch_marks[0], fetch_marks[-1]
+            if t1m > t0m:
+                stats["steady_tokens_per_s"] = (c1 - c0) / (t1m - t0m)
         return stats

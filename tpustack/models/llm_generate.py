@@ -40,6 +40,16 @@ class SampleConfig:
     greedy: bool = False
 
 
+def _advance_keys(keys):
+    """Advance per-row PRNG chains ``[B, 2]`` one step: returns
+    ``(step_keys [B, 2], next_keys [B, 2])``.  Row i's chain is seeded at
+    admission from its request seed and advanced once per generated token,
+    so the k-th token of a request always draws from the same key no
+    matter when the request was admitted or who its batch peers are."""
+    split = jax.vmap(jax.random.split)(keys)          # [B, 2, 2]
+    return split[:, 0], split[:, 1]
+
+
 class Generator:
     """Holds params + compiled prefill/decode programs."""
 
@@ -189,18 +199,17 @@ class Generator:
             lo += n
         return out, caches
 
-    def _sample_from_logits(self, logits, key, temperature, top_k, greedy):
-        """``[B, V]`` fp32 logits → ``[B]`` int32 token (traced; shared by the
-        single-step and fused-scan decoders so they sample identically).
+    def _topk_scaled(self, logits, temperature, top_k):
+        """Shared temperature/top-k filter: ``[B, V]`` f32 logits →
+        ``[B, V]`` scaled logits with sub-threshold entries at -inf.
 
-        ``temperature``/``top_k``/``greedy`` may be scalars or per-row
-        ``[B]`` arrays — batched serving mixes requests with different
-        sampling settings in one device step."""
+        ``temperature``/``top_k`` may be scalars or per-row ``[B]`` arrays —
+        batched serving mixes requests with different sampling settings in
+        one device step."""
         b = logits.shape[0]
         col = lambda x: jnp.broadcast_to(
             jnp.atleast_1d(jnp.asarray(x)), (b,))[:, None]  # [B, 1]
-        temp, tk, gr = col(temperature), col(top_k), col(greedy)
-
+        temp, tk = col(temperature), col(top_k)
         scaled = logits / jnp.maximum(temp, 1e-4)
         # top-k with a traced k: take a static top-64 slate (descending),
         # threshold at the clamp(top_k)-th value per row; top_k<=0 disables.
@@ -209,10 +218,33 @@ class Generator:
         idx = jnp.clip(tk - 1, 0, slate - 1).astype(jnp.int32)
         kth = jnp.take_along_axis(topv, idx, axis=1)
         thresh = jnp.where(tk > 0, kth, -jnp.inf)
-        scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
-        sampled = jax.random.categorical(key, scaled, axis=-1)
+        return jnp.where(scaled >= thresh, scaled, -jnp.inf)
 
-        next_tok = jnp.where(gr[:, 0], jnp.argmax(logits, axis=-1), sampled)
+    def _sample_from_logits(self, logits, key, temperature, top_k, greedy):
+        """``[B, V]`` fp32 logits → ``[B]`` int32 token (traced; shared by the
+        single-step and fused-scan decoders so they sample identically).
+        ONE key draws the whole batch — the solo/static-batch chains."""
+        b = logits.shape[0]
+        gr = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(greedy)), (b,))
+        scaled = self._topk_scaled(logits, temperature, top_k)
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+        next_tok = jnp.where(gr, jnp.argmax(logits, axis=-1), sampled)
+        return next_tok.astype(jnp.int32)
+
+    def _sample_from_logits_perrow(self, logits, keys, temperature, top_k,
+                                   greedy):
+        """``[B, V]`` fp32 logits + PER-ROW keys ``[B, 2]`` → ``[B]`` tokens.
+
+        Each row draws from its own PRNG stream, so a sampled row's output
+        is a function of (its seed, its token index) ONLY — independent of
+        batch composition and admission timing.  This is what lets the
+        server admit seeded-sampled requests into continuous-batching slots
+        (greedy rows ignore the key entirely)."""
+        b = logits.shape[0]
+        gr = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(greedy)), (b,))
+        scaled = self._topk_scaled(logits, temperature, top_k)
+        sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+        next_tok = jnp.where(gr, jnp.argmax(logits, axis=-1), sampled)
         return next_tok.astype(jnp.int32)
 
     def _decode_logits(self, params, token, index, caches):
@@ -370,41 +402,86 @@ class Generator:
     #
     # A third decode layout for the CONTINUOUS batcher
     # (tpustack.models.llm_continuous): B persistent slots, each with its own
-    # CONTIGUOUS cache line — row i writes at cur[i] (a [B] vector index, the
-    # per-row scatter path in LlamaAttention), attends [0, cur[i]] and takes
-    # RoPE position cur[i], exactly the solo decoder's layout per row.  Slots
-    # join (B=1 prefill inserted via _insert_cache_row) and retire at chunk
-    # boundaries without touching their peers; parked slots idle at position
-    # 0 (active=0 freezes cur) until reassigned.  Greedy rows are therefore
-    # bit-compatible with the solo path regardless of batch composition.
+    # CONTIGUOUS cache line — row i decodes at its own frontier cur[i],
+    # attends [0, cur[i]] and takes RoPE position cur[i], exactly the solo
+    # decoder's layout per row.  Slots join (B=1 prefill inserted via
+    # _insert_cache_rows) and retire at chunk boundaries without touching
+    # their peers; parked slots idle at position 0 (active=0 freezes cur)
+    # until reassigned.  Per-row K/V land in a small chunk-local buffer
+    # (the main cache stays FROZEN within a chunk; one flush per chunk), so
+    # a row's attention math depends only on its own prompt/seed — greedy
+    # rows are token-identical to the solo path in practice (the chunk-
+    # boundary softmax split changes fp summation ORDER only, never the
+    # attended set), and sampled rows draw from per-slot PRNG streams, so
+    # ALL rows are deterministic in (request, seed) regardless of admission
+    # timing or batch composition.
 
     @functools.partial(jax.jit, static_argnums=(0, 10), donate_argnums=(5,))
-    def _decode_scan_cont(self, params, first_tok, cur, active, caches, key,
+    def _decode_scan_cont(self, params, first_tok, cur, active, caches, keys,
                           temperature, top_k, greedy, n_steps: int):
         """``n_steps`` continuous-slot decode iterations in ONE dispatch.
 
-        ``cur [B]``: per-slot write/attention frontier (advances only where
-        ``active``); clamped at max_seq-1 so host-side fetch lag can never
-        write out of bounds (a retiring row's overshoot steps rewrite its own
-        final cache slot, which its accepted tokens never attend)."""
+        ``cur [B]``: per-slot frontier at chunk START (``cur0``) — advances
+        only where ``active``, clamped at max_seq-1.  ``keys [B, 2]``:
+        per-slot PRNG streams (see ``_sample_from_logits_perrow``).
+
+        The main KV cache is read-only for the whole chunk: step t writes
+        its K/V at the UNIFORM index t of per-layer chunk buffers
+        (``init_chunk_bufs``, scan-internal) and attention merges
+        {cache [0, cur0[i])} ∪ {buffer [0, t]} with an exact streaming-
+        softmax split (LlamaAttention chunk mode).  After the scan the
+        buffers flush into each row's cache line at [cur0[i], cur_end[i])
+        in ONE gather+select pass — per-step cache write-back traffic
+        (which would ~double KV bytes for concurrent long-context decodes)
+        amortises by the chunk length.  Overshoot steps past max_seq-1 are
+        clipped out of the flush window entirely, so a retiring row's
+        speculative garbage is never written to the cache at all."""
+        from tpustack.models.llama import init_chunk_bufs
+
         S = self.cfg.max_seq
+        B = first_tok.shape[0]
+        cur0 = cur
+        bufs0 = init_chunk_bufs(self.cfg, B, n_steps, dtype=self.cache_dtype)
 
-        def step(carry, _):
-            tok, cur, caches, key = carry
-            positions = cur[:, None]
-            valid = (jnp.arange(S)[None, :] <= cur[:, None])[:, None, None, :]
-            logits, caches = self.model.apply(
-                {"params": params}, tok, positions, caches, cur, valid)
-            step_key, key = jax.random.split(key)
-            nxt = self._sample_from_logits(
-                logits[:, -1].astype(jnp.float32), step_key, temperature,
+        def step(carry, t):
+            tok, bufs, keys = carry
+            cur_t = jnp.minimum(cur0 + t * active, S - 1)
+            merged = [dict(c, **bf) for c, bf in zip(caches, bufs)]
+            logits, merged = self.model.apply(
+                {"params": params}, tok, cur_t[:, None], merged, (cur0, t),
+                None)
+            bufs = [{k: d[k] for k in bf} for d, bf in zip(merged, bufs)]
+            step_keys, keys = _advance_keys(keys)
+            nxt = self._sample_from_logits_perrow(
+                logits[:, -1].astype(jnp.float32), step_keys, temperature,
                 top_k, greedy)
-            cur = jnp.minimum(cur + active, S - 1)
-            return (nxt[:, None], cur, caches, key), nxt
+            return (nxt[:, None], bufs, keys), nxt
 
-        (last, cur, caches, key), toks = jax.lax.scan(
-            step, (first_tok, cur, caches, key), None, length=n_steps)
-        return toks.T, last, cur, caches, key
+        (last, bufs, keys), toks = jax.lax.scan(
+            step, (first_tok, bufs0, keys), jnp.arange(n_steps))
+        cur_end = jnp.minimum(cur0 + n_steps * active, S - 1)
+
+        # flush: one linear pass per cache tensor — gather each row's chunk
+        # K/V at (position - cur0) and select it inside [cur0, cur_end)
+        ar = jnp.arange(S)[None, :]
+        window = (ar >= cur0[:, None]) & (ar < cur_end[:, None])    # [B, S]
+        idx = jnp.clip(ar - cur0[:, None], 0, n_steps - 1).astype(jnp.int32)
+
+        def flush(cache, buf):
+            out = dict(cache)
+            for bk, mk in (("ck", "k"), ("cv", "v"),
+                           ("ck_scale", "k_scale"), ("cv_scale", "v_scale")):
+                if bk not in buf:
+                    continue
+                tail = (1,) * (cache[mk].ndim - 2)
+                g = jnp.take_along_axis(buf[bk], idx.reshape(B, S, *tail),
+                                        axis=1)
+                out[mk] = jnp.where(window.reshape(B, S, *tail),
+                                    g.astype(cache[mk].dtype), cache[mk])
+            return out
+
+        caches = [flush(c, bf) for c, bf in zip(caches, bufs)]
+        return toks.T, last, cur_end, caches, keys
 
     @functools.partial(jax.jit, static_argnums=(0, 4, 5), donate_argnums=(1,))
     def _insert_cache_rows(self, slot_caches, row_caches, slot_ids,
@@ -427,13 +504,37 @@ class Generator:
         return jax.tree.map(ins, slot_caches, row_caches)
 
     @functools.partial(jax.jit, static_argnums=(0,))
-    def _sample_logits_jit(self, logits, key, temperature, top_k, greedy):
-        """One-dispatch device sampling of prefill logits ([n, V] → [n]).
-        The continuous engine fetches only the n int32 tokens — fetching the
-        logits themselves for host sampling costs ~1 s per admission wave at
-        150k vocab over a tunnelled link (measured)."""
-        return self._sample_from_logits(logits, key, temperature, top_k,
-                                        greedy)
+    def _admit_sample_jit(self, logits, seeds, temperature, top_k, greedy):
+        """Device-side admission sampling: prefill logits ``[n, V]`` +
+        per-request ``seeds [n]`` → (first tokens ``[n]``, per-slot key
+        chains ``[n, 2]``).  No host value is needed to build this — the
+        engine dispatches it and keeps going; the n int32 tokens are
+        fetched at the next natural sync point (fetching the [n, V] logits
+        for host sampling costs ~1 s per admission wave at 150k vocab over
+        a tunnelled link, measured)."""
+        base = jax.vmap(jax.random.PRNGKey)(seeds)          # [n, 2]
+        first_keys, next_keys = _advance_keys(base)
+        firsts = self._sample_from_logits_perrow(
+            logits, first_keys, temperature, top_k, greedy)
+        return firsts, next_keys
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+    def _slot_activate(self, cur, active, first, temp, topk, greedy, keys,
+                       slot_ids, n_cur, n_first, n_temp, n_topk, n_greedy,
+                       n_keys):
+        """Scatter n admitted rows into the B-slot state arrays in ONE
+        dispatch.  Entirely device-valued (``n_first``/``n_keys`` come
+        straight from ``_admit_sample_jit``), so admission never syncs the
+        host — the decode chain keeps flowing while prefill+activation are
+        still in flight."""
+        return (cur.at[slot_ids].set(n_cur),
+                active.at[slot_ids].set(1),
+                first.at[slot_ids].set(n_first[:, None]),
+                temp.at[slot_ids].set(n_temp),
+                topk.at[slot_ids].set(n_topk),
+                greedy.at[slot_ids].set(n_greedy),
+                keys.at[slot_ids].set(n_keys))
 
     @functools.partial(jax.jit, static_argnums=(0,),
                        donate_argnums=(1, 2, 3, 4, 5, 6))
@@ -441,8 +542,10 @@ class Generator:
                      new_cur, new_active, new_first, new_temp, new_topk,
                      new_greedy):
         """Apply per-slot state changes for the slots selected by ``mask``
-        ([B] bool) in ONE dispatch — admissions and retirements coalesce
-        their updates instead of paying a tunnel round-trip per array."""
+        ([B] bool) in ONE dispatch — retirements coalesce their parks
+        instead of paying a tunnel round-trip per array.  (Slot PRNG keys
+        are left alone: a parked slot's key chain is dead state that
+        ``_slot_activate`` overwrites at reassignment.)"""
         pick = lambda a, b: jnp.where(mask, b, a)
         return (pick(cur, new_cur), pick(active, new_active),
                 jnp.where(mask[:, None], new_first, first),

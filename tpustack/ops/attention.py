@@ -215,3 +215,86 @@ def dot_product_attention(
         probs = probs * vs_b[:, :, None, None, :]
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
     return out.reshape(b, sq, h, d)
+
+
+NEG_INF = -1e30
+
+
+def dot_product_attention_partial(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: jax.Array,
+    scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+):
+    """Attention over a PARTIAL key set, returning the online-softmax carry
+    instead of a normalised output: ``(acc [B,Sq,H,D] f32 unnormalised,
+    m [B,Sq,H] f32 row max, l [B,Sq,H] f32 denominator)``.
+
+    Two partials over disjoint key sets merge exactly into full attention
+    via :func:`merge_attention_partials` — the same decomposition the flash
+    kernels use across k-blocks, here at the XLA level so the continuous
+    decode step can attend {frozen main cache} ∪ {chunk-local K/V buffer}
+    without rewriting the whole cache every step (the one-hot write-back
+    this replaces doubled decode KV traffic; see LlamaAttention).
+
+    ``mask [B, Sq, Sk]`` (True = attend; required — a partial with no mask
+    is just ``dot_product_attention``).  GQA K/V stay unexpanded like the
+    main path.  A fully-masked row yields ``m = NEG_INF, l = 0, acc = 0``
+    — merging handles it as long as the OTHER partial has a valid key
+    (decode always attends its own freshly-written position).
+    """
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if scale is None:
+        scale = d ** -0.5
+    sk = k.shape[1]
+    g = h // hkv
+    if k_scale is not None or v_scale is not None:
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+    ks_b = (jnp.transpose(k_scale, (0, 2, 1))
+            if k_scale is not None else None)  # [B, Hkv, Sk]
+    vs_b = (jnp.transpose(v_scale, (0, 2, 1))
+            if v_scale is not None else None)
+
+    q5 = q.reshape(b, sq, hkv, g, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
+                        preferred_element_type=jnp.float32)
+    if ks_b is not None:
+        logits = logits * ks_b[:, :, None, None, :].astype(logits.dtype)
+    logits = logits * jnp.asarray(scale, logits.dtype)
+    logits = jnp.where(mask[:, None, None, :, :], logits,
+                       jnp.asarray(NEG_INF, logits.dtype))
+    m = jnp.max(logits, axis=-1)                      # [B, Hkv, G, Sq]
+    p = jnp.exp(logits - m[..., None])
+    p = jnp.where(logits <= NEG_INF, 0.0, p)          # all-masked row: l = 0
+    l = jnp.sum(p, axis=-1)
+    if vs_b is not None:
+        p = p * vs_b[:, :, None, None, :]
+    acc = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    to_bqh = lambda x: x.transpose(0, 3, 1, 2).reshape(b, sq, h)
+    return acc.reshape(b, sq, h, d), to_bqh(m), to_bqh(l)
+
+
+def merge_attention_partials(p1, p2, out_dtype) -> jax.Array:
+    """Merge two :func:`dot_product_attention_partial` carries over disjoint
+    key sets into the full attention output ``[B, Sq, H, D]``.
+
+    Exact softmax decomposition: with the shared max ``m = max(m1, m2)``
+    the rescaled exponentials equal the one-pass values, so the merge
+    differs from single-pass attention only in summation order."""
+    a1, m1, l1 = p1
+    a2, m2, l2 = p2
+    m = jnp.maximum(m1, m2)
+    w1 = jnp.exp(m1 - m)[..., None]
+    w2 = jnp.exp(m2 - m)[..., None]
+    denom = l1[..., None] * w1 + l2[..., None] * w2
+    return ((a1 * w1 + a2 * w2) /
+            jnp.maximum(denom, 1e-30)).astype(out_dtype)

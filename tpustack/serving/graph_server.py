@@ -123,6 +123,28 @@ class Frames:
         return self.array
 
 
+class _ConcatFrames(Frames):
+    """ComfyUI batched-latent semantics: a ``batch_size`` B latent decodes
+    to the B videos stacked along the frame axis (ComfyUI's IMAGE batch),
+    so SaveAnimatedWEBP writes one B*F-frame animation and SaveImage writes
+    B*F stills.  Each row is its own late-bound :class:`Frames` (its own
+    seed, its own lane of a batched dispatch) — rows are row-equal to solo
+    runs of (seed + row index); the concat is deferred to first fetch."""
+
+    def __init__(self, rows):
+        super().__init__(n_frames=sum(r.frame_count for r in rows))
+        self.rows = rows
+
+    def numpy(self) -> np.ndarray:
+        if self.array is None:
+            errs = [r.error for r in self.rows if r.error is not None]
+            if errs:
+                raise GraphError(f"sampling failed: {errs[0]}")
+            self.array = np.concatenate([r.numpy() for r in self.rows],
+                                        axis=0)
+        return self.array
+
+
 @dataclass
 class OutputFile:
     filename: str
@@ -303,11 +325,9 @@ class GraphExecutor:
         denoise = float(inputs.get("denoise", 1.0))
         if denoise != 1.0:
             raise GraphError("partial denoise (img2vid) not supported yet")
-        if latent.batch_size != 1:
-            # refuse rather than silently discard items 1..B-1 after paying
-            # the full fused-generate cost for all of them
-            raise GraphError("batch_size > 1 not supported yet; submit one "
-                             "graph per seed (the batch client does this)")
+        if not 1 <= latent.batch_size <= 16:
+            raise GraphError(
+                f"batch_size {latent.batch_size} out of range [1, 16]")
         return (SampleSpec(latent=latent, positive=pos, negative=neg,
                            seed=int(inputs.get("seed", 0)),
                            steps=int(inputs.get("steps", 25)),
@@ -315,29 +335,59 @@ class GraphExecutor:
                            sampler_name=str(inputs.get("sampler_name", "uni_pc")),
                            denoise=denoise),)
 
+    @staticmethod
+    def _expand_rows(spec: SampleSpec) -> List[SampleSpec]:
+        """A ``batch_size`` B KSampler spec is B independent rows with seeds
+        ``seed + i`` — each row-equal to a solo graph at that seed (the
+        documented batch convention; the pipeline's ``generate_many_async``
+        builds per-item noise, so fused rows reproduce solo runs exactly)."""
+        import dataclasses as _dc
+
+        if spec.latent.batch_size == 1:
+            return [spec]
+        solo_latent = _dc.replace(spec.latent, batch_size=1)
+        return [_dc.replace(spec, latent=solo_latent, seed=spec.seed + i)
+                for i in range(spec.latent.batch_size)]
+
     def node_VAEDecode(self, inputs, ctx):
         spec = inputs.get("samples")
         if not isinstance(spec, SampleSpec):
             raise GraphError("VAEDecode samples must come from KSampler")
+        rows = self._expand_rows(spec)
         hook = ctx.get("sample_hook")
         if hook is not None:
-            # worker queue-batching: record the spec, return a late-bound
-            # Frames the worker fills from one batched dispatch
-            return (hook(spec),)
+            # worker queue-batching: record each row's spec, return
+            # late-bound Frames the worker fills from batched dispatches
+            frames = [hook(r) for r in rows]
+            return (frames[0] if len(frames) == 1
+                    else _ConcatFrames(frames),)
         pipe = self.rt.pipeline()
-        log.info("Sampling: %dx%d f=%d steps=%d cfg=%.1f sampler=%s seed=%d",
+        t0 = time.time()
+        log.info("Sampling%s: %dx%d f=%d steps=%d cfg=%.1f sampler=%s "
+                 "seed=%d", f" BATCH of {len(rows)}" if len(rows) > 1 else "",
                  spec.latent.width, spec.latent.height, spec.latent.frames,
                  spec.steps, spec.cfg, spec.sampler_name, spec.seed)
-        t0 = time.time()
-        vid_dev = pipe.generate_async(
-            spec.positive.text, negative_prompt=spec.negative.text,
-            frames=spec.latent.frames, steps=spec.steps,
-            guidance_scale=spec.cfg, seed=spec.seed,
-            width=spec.latent.width, height=spec.latent.height,
-            sampler=spec.sampler_name, batch_size=spec.latent.batch_size)
+        if len(rows) == 1:
+            vid_dev = pipe.generate_async(
+                spec.positive.text, negative_prompt=spec.negative.text,
+                frames=spec.latent.frames, steps=spec.steps,
+                guidance_scale=spec.cfg, seed=spec.seed,
+                width=spec.latent.width, height=spec.latent.height,
+                sampler=spec.sampler_name)
+        else:
+            # ONE fused dispatch for all B rows (weights stream once);
+            # per-item noise keeps each row equal to its solo run
+            vid_dev = pipe.generate_many_async(
+                [{"prompt": r.positive.text,
+                  "negative_prompt": r.negative.text, "seed": r.seed}
+                 for r in rows],
+                frames=spec.latent.frames, steps=spec.steps,
+                guidance_scale=spec.cfg, width=spec.latent.width,
+                height=spec.latent.height, sampler=spec.sampler_name)
         log.info("Dispatched %s in %.2fs (async; save nodes fetch)",
                  tuple(vid_dev.shape), time.time() - t0)
-        return (Frames(array=vid_dev[0]),)
+        out = [Frames(array=vid_dev[i]) for i in range(len(rows))]
+        return (out[0] if len(out) == 1 else _ConcatFrames(out),)
 
     # -- save nodes
     def _out_path(self, prefix: str, ext: str, counter: int) -> Tuple[str, str]:

@@ -115,18 +115,23 @@ class _PendingCompletion:
 
     ``stream_put``: optional callable — set for streaming requests; the
     batch loop feeds it each of the row's tokens as chunks complete (and
-    ``None`` once the row is done), chunk-granular SSE."""
+    ``None`` once the row is done), chunk-granular SSE.  ``seed``: sampling
+    seed forwarded to the engine's per-slot PRNG stream (seeded output is
+    admission-timing independent, so seeded requests batch like any
+    other)."""
 
     __slots__ = ("ids", "n_predict", "sample", "future", "cancel",
-                 "stream_put")
+                 "stream_put", "seed")
 
-    def __init__(self, ids, n_predict, sample, future, stream_put=None):
+    def __init__(self, ids, n_predict, sample, future, stream_put=None,
+                 seed=None):
         self.ids = ids
         self.n_predict = n_predict
         self.sample = sample
         self.future = future
         self.cancel = threading.Event()
         self.stream_put = stream_put
+        self.seed = seed
 
 
 class LLMServer:
@@ -144,9 +149,16 @@ class LLMServer:
     each row's context budget is its own ``max_seq - len(prompt)`` (no
     shared longest-peer bucket).
 
-    Kept solo (the one-at-a-time path): seeded non-greedy requests
-    (reproducibility would depend on admission timing) and prompts longer
-    than half the context (they'd monopolize the slot cache).
+    EVERY request batches (llama.cpp parity): seeded non-greedy requests
+    ride per-slot PRNG streams, so their output depends only on (prompt,
+    seed) — never on admission timing or batch peers — and long prompts
+    admit like any other (each slot owns a full ``max_seq`` cache line;
+    admission prefills are bucket-grouped so a short prompt never pays a
+    long peer's padding, and they overlap the running decode chain).  The
+    one long-prompt cost that remains is physical: a K-token admission
+    prefill occupies the chip for its duration, so in-flight peers see
+    that as added latency — exactly llama.cpp's behavior on one GPU.  The
+    solo path survives only for ``LLM_MAX_BATCH=1`` deployments.
     """
 
     def __init__(self, generator=None, tokenizer=None, model_name: str = "tpustack",
@@ -213,15 +225,12 @@ class LLMServer:
 
     # ------------------------------------------------- slot micro-batching
     def _batchable(self, ids, temperature, seed) -> bool:
-        """Solo when batching would change semantics or starve peers:
-        seeded sampling (result would depend on batch composition; greedy
-        is deterministic in any batch) and prompts whose bucket would eat
-        more than half the shared context budget."""
-        if self.max_batch <= 1:
-            return False
-        if seed is not None and temperature > 0:
-            return False
-        return self.gen._bucket(len(ids)) <= self.gen.cfg.max_seq // 2
+        """All requests batch: per-slot PRNG streams make seeded sampling
+        admission-timing independent, and per-slot cache lines give every
+        prompt its own full-context budget — the r4 solo carve-outs
+        (seeded sampling, prompts > ctx/2) are gone.  Solo only when
+        batching is disabled outright."""
+        return self.max_batch > 1
 
     async def _enqueue_raw(self, req: _PendingCompletion) -> None:
         if self._wake is None:
@@ -233,9 +242,10 @@ class LLMServer:
         self._queue.append(req)
         self._wake.set()
 
-    async def _enqueue_completion(self, ids, n_predict, sample):
+    async def _enqueue_completion(self, ids, n_predict, sample, seed=None):
         loop = asyncio.get_running_loop()
-        req = _PendingCompletion(ids, n_predict, sample, loop.create_future())
+        req = _PendingCompletion(ids, n_predict, sample, loop.create_future(),
+                                 seed=seed)
         await self._enqueue_raw(req)
         try:
             return await req.future
@@ -270,7 +280,7 @@ class LLMServer:
 
         return SlotRequest(ids=r.ids, max_new=r.n_predict, sample=r.sample,
                            on_tokens=on_tokens, on_done=on_done,
-                           cancelled=r.cancel.is_set)
+                           cancelled=r.cancel.is_set, seed=r.seed)
 
     async def _batch_loop(self):
         """Run the continuous engine whenever requests are queued: the
@@ -362,7 +372,8 @@ class LLMServer:
                 self._solo_waiting -= 1
         sample = SampleConfig(temperature=temperature, top_k=top_k,
                               greedy=temperature <= 0)
-        out_ids, stats = await self._enqueue_completion(ids, n_predict, sample)
+        out_ids, stats = await self._enqueue_completion(ids, n_predict, sample,
+                                                        seed=seed)
         if out_ids and out_ids[-1] == self.tok.eos_id:
             out_ids = out_ids[:-1]
             stopped_eos = True
@@ -463,7 +474,8 @@ class LLMServer:
                 SampleConfig(temperature=temperature, top_k=top_k,
                              greedy=temperature <= 0),
                 loop.create_future(),
-                stream_put=lambda t: loop.call_soon_threadsafe(q.put_nowait, t))
+                stream_put=lambda t: loop.call_soon_threadsafe(q.put_nowait, t),
+                seed=seed)
             cancel = req.cancel
         else:
             cancel = threading.Event()
