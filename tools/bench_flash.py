@@ -26,7 +26,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--shape", default="wan", choices=["wan", "prefill"])
+    p.add_argument("--shape", default="wan",
+                   choices=["wan", "wan16f", "prefill"])
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--block-q", type=int, nargs="*", default=[128, 256, 512, 1024])
     p.add_argument("--block-k", type=int, nargs="*", default=[512, 1024])
@@ -48,6 +49,13 @@ def main() -> int:
         b, sq, h, d, hkv = 2, 8320, 12, 128, 12
         sk, causal, q_off, kv_len = sq, False, None, None
         flops = 4 * b * h * sq * sk * d
+    elif args.shape == "wan16f":
+        # the 512x320x16f serving hot shape: S=2560 — PANEL-kernel block_q
+        # sweep (in-situ xprof r5: the panel runs ~132 TFLOP/s here at the
+        # default block_q 128 while the surrounding matmuls do 172-192)
+        b, sq, h, d, hkv = 2, 2560, 12, 128, 12
+        sk, causal, q_off, kv_len = sq, False, None, None
+        flops = 4 * b * h * sq * sk * d
     else:
         b, sq, h, d, hkv = 1, 8192, 28, 128, 4
         sk = 17408
@@ -62,10 +70,14 @@ def main() -> int:
     v = jax.random.normal(ks[2], (b, sk, hkv, d), jnp.bfloat16)
 
     results = []
-    combos = [(bq, bk, False) for bq, bk in
-              itertools.product(args.block_q, args.block_k)]
-    if args.panel and args.shape == "wan":
-        combos.append((128, 512, True))
+    if args.shape == "wan16f":
+        # sweep the PANEL kernel's block_q (block_k unused there)
+        combos = [(bq, 512, True) for bq in args.block_q]
+    else:
+        combos = [(bq, bk, False) for bq, bk in
+                  itertools.product(args.block_q, args.block_k)]
+        if args.panel and args.shape == "wan":
+            combos.append((128, 512, True))
 
     # Chain kernel applications (out feeds the next q) inside one jit:
     # per-call compute is ~ms-scale while the tunnel round-trip is ~100 ms,

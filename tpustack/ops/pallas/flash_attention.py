@@ -55,13 +55,18 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
     domain; one fewer VPU multiply per element if exp lowers to scale+exp2).
     """
     qi = pl.program_id(1)
-    q = q_ref[0]                                # [block_q, D]
+    # fold the softmax scale (with log2e) into the q TILE, not the scores:
+    # the tile is [block_q, D] (~16k elements) while the scores are
+    # [block_q, S] (~20x more at serving shapes) — in a VPU-bound kernel
+    # that one full score pass is measurable.  bf16 q x scalar rounds at
+    # bf16 grain, the same order as the input rounding itself.
+    q = q_ref[0] * jnp.asarray(scale * LOG2E, q_ref.dtype)  # [block_q, D]
     k = k_ref[0]                                # [S_pad, D]
     v = v_ref[0]
 
     logits = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * (scale * LOG2E)
+        preferred_element_type=jnp.float32)
 
     s_pad = logits.shape[-1]
     if causal or kv_len < s_pad:                # static: skip 3 VPU passes
@@ -136,11 +141,13 @@ def _attn_kernel_stream(q_ref, k_ref, v_ref, off_ref, len_ref, o_ref,
         m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
 
     def _logits():
-        q = q_ref[0]                            # [block_q, D]
+        # scale folded into the q tile (see _attn_kernel): one fewer full
+        # VPU pass over every [block_q, block_k] score block
+        q = q_ref[0] * jnp.asarray(scale * LOG2E, q_ref.dtype)
         k = k_ref[0]                            # [block_k, D]
         return jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * (scale * LOG2E)
+            preferred_element_type=jnp.float32)
 
     # The masking passes (iota, compare, select — 3 VPU passes over the
     # whole score block) are only needed on BOUNDARY blocks: those crossing
@@ -265,7 +272,15 @@ def flash_attention(
     streaming = (k.shape[1] > panel_max_kv or q_offset is not None
                  or kv_len is not None)
     if block_q is None:
-        block_q = 1024 if streaming else 128
+        # Panel kernel: block_q 256 wins ~8% over 128 at serving shapes
+        # (v5e, S=2560 D=128: 154 vs 143 TFLOP/s with the folded q scale —
+        # more MXU work per grid step against the same VPU softmax setup),
+        # but its [block_q, S] f32 scores + K/V panels stop fitting VMEM as
+        # S approaches PANEL_MAX_KV (256 at 8704 fails to compile,
+        # measured r4) — stay at 128 beyond the 6144 bound, which is
+        # compile-verified on-chip across the range (4608/5120/6144 all
+        # build and match block_q=128 exactly at D=128).
+        block_q = 1024 if streaming else (256 if k.shape[1] <= 6144 else 128)
     if block_k is None:
         block_k = 1024 if streaming else 512
     return _flash_attention(q, k, v, causal=causal, scale=scale,

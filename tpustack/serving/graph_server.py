@@ -123,6 +123,18 @@ class Frames:
         return self.array
 
 
+#: max summed pixel-frames (B * frames * H * W) per BATCHED dispatch —
+#: shared by the worker's _dispatch_plan and the hookless execute path.
+#: Measured on one v5e: batching wins where per-dispatch overhead dominates
+#: (64x64x5f pair: 1.3-1.4x cheaper than 2x serial) but the denoise is
+#: COMPUTE-bound at larger shapes, where fusing buys nothing and XLA
+#: schedules the doubled batch slightly worse (256x256x9f pair: 0.9x) —
+#: and a full-size 512x320x16f pair does not even fit HBM (B=2 wants
+#: 17.06 GB of 15.75).  Default admits only the overhead-dominated small
+#: shapes; env override for experimentation.
+PIXEL_BUDGET = int(os.environ.get("WAN_BATCH_PIXEL_BUDGET", "150000"))
+
+
 class _ConcatFrames(Frames):
     """ComfyUI batched-latent semantics: a ``batch_size`` B latent decodes
     to the B videos stacked along the frame axis (ComfyUI's IMAGE batch),
@@ -367,26 +379,36 @@ class GraphExecutor:
                  "seed=%d", f" BATCH of {len(rows)}" if len(rows) > 1 else "",
                  spec.latent.width, spec.latent.height, spec.latent.frames,
                  spec.steps, spec.cfg, spec.sampler_name, spec.seed)
-        if len(rows) == 1:
-            vid_dev = pipe.generate_async(
-                spec.positive.text, negative_prompt=spec.negative.text,
-                frames=spec.latent.frames, steps=spec.steps,
-                guidance_scale=spec.cfg, seed=spec.seed,
-                width=spec.latent.width, height=spec.latent.height,
-                sampler=spec.sampler_name)
-        else:
-            # ONE fused dispatch for all B rows (weights stream once);
-            # per-item noise keeps each row equal to its solo run
-            vid_dev = pipe.generate_many_async(
-                [{"prompt": r.positive.text,
-                  "negative_prompt": r.negative.text, "seed": r.seed}
-                 for r in rows],
-                frames=spec.latent.frames, steps=spec.steps,
-                guidance_scale=spec.cfg, width=spec.latent.width,
-                height=spec.latent.height, sampler=spec.sampler_name)
-        log.info("Dispatched %s in %.2fs (async; save nodes fetch)",
-                 tuple(vid_dev.shape), time.time() - t0)
-        out = [Frames(array=vid_dev[i]) for i in range(len(rows))]
+        # the same pixel-frame budget the worker's _dispatch_plan applies:
+        # a full-size (512x320x16f) pair wants ~17 GB of HBM fused, so rows
+        # chunk to at most max_b per dispatch (weights still stream once
+        # per chunk; rows stay solo-equal either way)
+        per = max(1, pipe.pixel_frame_count(spec.latent.frames)) \
+            * spec.latent.height * spec.latent.width
+        max_b = max(1, PIXEL_BUDGET // per)
+        out = []
+        for lo in range(0, len(rows), max_b):
+            chunk = rows[lo:lo + max_b]
+            if len(chunk) == 1:
+                vid_dev = pipe.generate_async(
+                    chunk[0].positive.text,
+                    negative_prompt=chunk[0].negative.text,
+                    frames=spec.latent.frames, steps=spec.steps,
+                    guidance_scale=spec.cfg, seed=chunk[0].seed,
+                    width=spec.latent.width, height=spec.latent.height,
+                    sampler=spec.sampler_name)
+            else:
+                vid_dev = pipe.generate_many_async(
+                    [{"prompt": r.positive.text,
+                      "negative_prompt": r.negative.text, "seed": r.seed}
+                     for r in chunk],
+                    frames=spec.latent.frames, steps=spec.steps,
+                    guidance_scale=spec.cfg, width=spec.latent.width,
+                    height=spec.latent.height, sampler=spec.sampler_name)
+            out.extend(Frames(array=vid_dev[i]) for i in range(len(chunk)))
+        log.info("Dispatched %d row(s) in %d chunk(s) in %.2fs (async; "
+                 "save nodes fetch)", len(out),
+                 (len(rows) + max_b - 1) // max_b, time.time() - t0)
         return (out[0] if len(out) == 1 else _ConcatFrames(out),)
 
     # -- save nodes
@@ -711,7 +733,7 @@ class GraphServer:
             # decoded under the floor convention), the pixels that
             # actually hit HBM — not the requested count
             per = max(1, pipe.pixel_frame_count(frames_n)) * height * width
-            max_b = max(1, self.PIXEL_BUDGET // per)
+            max_b = max(1, PIXEL_BUDGET // per)
             if key in self._no_batch:
                 max_b = 1
             for lo in range(0, len(members), max_b):
@@ -727,15 +749,6 @@ class GraphServer:
             width=key[0], height=key[1], sampler=key[5])
             for key, chunk in plan)
 
-    #: max summed pixel-frames (B * frames * H * W) per BATCHED dispatch.
-    #: Measured on one v5e: batching wins where per-dispatch overhead
-    #: dominates (64x64x5f pair: 1.3-1.4x cheaper than 2x serial) but the
-    #: denoise is COMPUTE-bound at larger shapes, where fusing buys nothing
-    #: and XLA schedules the doubled batch slightly worse (256x256x9f pair:
-    #: 0.9x) — and a full-size 512x320x16f pair does not even fit HBM
-    #: (B=2 wants 17.06 GB of 15.75).  Default admits only the
-    #: overhead-dominated small shapes; env override for experimentation.
-    PIXEL_BUDGET = int(os.environ.get("WAN_BATCH_PIXEL_BUDGET", "150000"))
 
     def _dispatch_one(self, key, members) -> None:
         width, height, frames_n, steps, cfg, sampler = key

@@ -224,12 +224,13 @@ class LLMServer:
             raise
 
     # ------------------------------------------------- slot micro-batching
-    def _batchable(self, ids, temperature, seed) -> bool:
+    def _batchable(self) -> bool:
         """All requests batch: per-slot PRNG streams make seeded sampling
         admission-timing independent, and per-slot cache lines give every
-        prompt its own full-context budget — the r4 solo carve-outs
-        (seeded sampling, prompts > ctx/2) are gone.  Solo only when
-        batching is disabled outright."""
+        prompt its own full-context budget — the r4 per-request carve-outs
+        (seeded sampling, prompts > ctx/2) are gone, so this no longer
+        inspects the request.  Solo only when batching is disabled
+        outright (``LLM_MAX_BATCH=1``)."""
         return self.max_batch > 1
 
     async def _enqueue_raw(self, req: _PendingCompletion) -> None:
@@ -361,7 +362,7 @@ class LLMServer:
         ids = self.tok.encode(prompt)
         if not ids:  # reject here, not inside a batch where peers would 400
             raise ValueError("empty prompt")
-        if not self._batchable(ids, temperature, seed):
+        if not self._batchable():
             cancel = threading.Event()
             self._solo_waiting += 1  # engine yields the lock at its next
             try:                     # chunk boundary (FIFO-fair handover)
@@ -464,7 +465,7 @@ class LLMServer:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
-        batched = self._batchable(ids, temperature, seed)
+        batched = self._batchable()
         if batched:
             # concurrent streams coalesce into ONE batched decode; tokens
             # arrive per fused chunk (coarser cadence than the solo path's
