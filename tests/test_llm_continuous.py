@@ -176,6 +176,42 @@ def test_engine_seeded_sampling_admission_invariance(gen):
     assert len(out_alone) == 8
 
 
+def test_engine_budget_one_request_mid_run(gen):
+    """r5 review: a max_new=1 request admitted while a peer is decoding
+    never enters a chunk snapshot (nothing to dispatch), so it must be
+    resolved via the urgent path — it gets its single token and retires
+    while the peer keeps decoding to completion."""
+    state = {"fed_peer": False, "late": None}
+    results = {}
+
+    def peer_tokens(toks):
+        if state["fed_peer"] is True:
+            state["late"] = SlotRequest(
+                ids=[30, 31], max_new=1, sample=GREEDY,
+                on_done=lambda t, s: results.__setitem__("one", t))
+            state["fed_peer"] = "armed"
+
+    def feed():
+        if not state["fed_peer"]:
+            state["fed_peer"] = True
+            return SlotRequest(
+                ids=[5, 6, 7], max_new=24, sample=GREEDY,
+                on_tokens=peer_tokens,
+                on_done=lambda t, s: results.__setitem__("peer", t))
+        if state["late"] is not None:
+            late, state["late"] = state["late"], None
+            return late
+        return None
+
+    eng = ContinuousEngine(gen, slots=4, chunk=4)
+    eng.run(feed)
+    assert len(results["one"]) == 1
+    assert len(results["peer"]) == 24
+    solo = gen.generate_fused([30, 31], max_new_tokens=1, sample=GREEDY,
+                              chunk=4)[0]
+    assert results["one"] == solo
+
+
 def test_engine_long_prompt_admits_into_slots(gen):
     """r5 (VERDICT #4): prompts longer than ctx/2 are slot citizens (each
     slot owns a full max_seq line) — they decode alongside short peers and

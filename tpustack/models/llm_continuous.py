@@ -28,17 +28,18 @@ static-shape rules:
   The r4 one-hot write-back rewrote the whole cache every step (~2x KV
   traffic for concurrent long-context decodes); write-back now amortises
   by the chunk length, so concurrent deep decodes stay KV-read-bound.
-- **Overlapped admission at chunk boundaries**: a joining request's prefill
-  (normal, possibly chunked long-context), KV-line splice
-  (``_insert_cache_rows``), first-token sampling, and slot activation
-  (``_slot_activate``) are ALL device-side dispatches — the host never
-  syncs on them, so the depth-``depth`` pipelined chunk chain keeps
-  flowing while prefill is still in flight.  The host picks up the first
-  tokens (one tiny [n]-int32 fetch) at the next natural sync point, or as
-  soon as the device reports them ready.  In-flight chunks dispatched
-  before admission stay valid for every other slot (rows are independent);
-  the new slot's lanes in those chunks are garbage the host ignores via
-  per-dispatch snapshots.
+- **Overlapped one-dispatch admission at chunk boundaries**: a joining
+  wave's fresh row caches, prefill, KV-line splice, first-token sampling
+  and slot activation run as ONE fused device program
+  (``Generator._admit_fused``; prompts longer than PREFILL_CHUNK keep the
+  multi-dispatch sequence around the host-driven chunk loop) — the host
+  never syncs on admission, so the depth-``depth`` pipelined chunk chain
+  keeps flowing while prefill is still in flight.  The host picks up the
+  first tokens (one tiny [n]-int32 fetch) at the next natural sync point,
+  or as soon as the device reports them ready.  In-flight chunks
+  dispatched before admission stay valid for every other slot (rows are
+  independent); the new slot's lanes in those chunks are garbage the host
+  ignores via per-dispatch snapshots.
 - **Per-slot PRNG streams**: each request's sampling chain is seeded from
   its own ``seed`` (or a fresh random one) and advanced once per generated
   token, so sampled output — like greedy — is a pure function of (request,
@@ -58,11 +59,15 @@ Measured (v5e, Qwen-7B int8+int8KV, ``tools/bench_llm.py --continuous`` —
 the numbers BASELINE.md quotes for batched serving, since this engine IS
 the served path):
 
-- 8x(128 prompt + 512 new), ctx 2048: **687 tok/s end-to-end, 736 tok/s
-  steady aggregate decode** — vs the static batcher's 630 decode-phase /
-  ~371 e2e same-session (the r4 engine measured 441 e2e: +9% admission tax
-  then; the r5 engine's zero-sync admissions + chunk-local K/V turned that
-  into a 17% steady-state LEAD over the static path).
+- 8x(128 prompt + 512 new), ctx 2048: **647-694 tok/s end-to-end,
+  730-751 tok/s steady aggregate decode** (128-new short generations:
+  444-543 e2e) — vs the static batcher's 630 decode-phase / ~371 e2e
+  same-session (the r4 engine measured 441 e2e: +9% admission tax then;
+  the r5 engine's one-dispatch admissions + chunk-local K/V turned that
+  into a 17% steady-state LEAD over the static path).  Residual e2e
+  spread is the dev tunnel's RTT on the remaining round-trips; steady
+  decode (the slope between the first and last block fetches) is the
+  tunnel-robust figure.
 - 2x(16384 prompt + 96 new), ctx 32768: **143.8 tok/s steady = 92% of
   2x the solo-row rate** (78.1 tok/s) — the long-context write-back cliff
   the r4 docstring predicted ("would roughly double KV traffic") is gone.
@@ -174,11 +179,13 @@ class ContinuousEngine:
     def _admit_dispatch(self, state, slots: List[_Slot],
                         waves: List[Tuple[int, SlotRequest]], gen_ctr: int):
         """Dispatch admissions WITHOUT any host sync: per prompt-bucket
-        group, one batched prefill (the static batcher's program), one
-        fused cache splice, one device-side first-token sample + slot
-        activation.  The chunk chain keeps flowing behind these — the host
-        resolves the first tokens later (``_resolve``).  Mid-run singles
-        take the same path with n=1."""
+        group, ONE fused device program covering row caches + prefill +
+        cache splice + first-token sample + slot activation
+        (``_admit_fused``; prompts beyond PREFILL_CHUNK run the host-
+        driven chunked prefill plus the same splice/sample/activate
+        dispatches).  The chunk chain keeps flowing behind these — the
+        host resolves the first tokens later (``_resolve``).  Mid-run
+        singles take the same path with n=1."""
         g, c = self.gen, self.gen.cfg
         t0 = time.time()
         valid: List[Tuple[int, SlotRequest, int]] = []  # (slot, req, budget)
@@ -218,37 +225,43 @@ class ContinuousEngine:
             for j, (_, r, _) in enumerate(rows):
                 tokens[j, :len(r.ids)] = r.ids
             lengths = jnp.asarray([len(r.ids) for _, r, _ in rows], jnp.int32)
-            row_caches = init_kv_caches(c, n, dtype=g.cache_dtype)
-            if bucket > g.PREFILL_CHUNK:
-                logits, row_caches = g._prefill_long(tokens, lengths,
-                                                     row_caches)
-            else:
-                logits, row_caches = g._prefill(g.params, jnp.asarray(tokens),
-                                                lengths, row_caches)
             slot_ids = jnp.asarray([i for i, _, _ in rows], jnp.int32)
-            state["caches"] = g._insert_cache_rows(
-                state["caches"], row_caches, slot_ids, n, bucket)
             seeds = jnp.asarray(
                 [r.seed if r.seed is not None else np.random.randint(0, 2**31)
                  for _, r, _ in rows], jnp.uint32)
-            firsts, row_keys = g._admit_sample_jit(
-                logits, seeds,
-                jnp.asarray([r.sample.temperature for _, r, _ in rows],
-                            jnp.float32),
-                jnp.asarray([r.sample.top_k for _, r, _ in rows], jnp.int32),
-                jnp.asarray([r.sample.greedy for _, r, _ in rows],
-                            jnp.bool_))
-            (state["cur"], state["active"], state["first"], state["temp"],
-             state["topk"], state["greedy"], state["keys"]) = g._slot_activate(
-                state["cur"], state["active"], state["first"], state["temp"],
-                state["topk"], state["greedy"], state["keys"], slot_ids,
-                lengths, firsts,
-                jnp.asarray([r.sample.temperature for _, r, _ in rows],
-                            jnp.float32),
-                jnp.asarray([r.sample.top_k for _, r, _ in rows], jnp.int32),
-                jnp.asarray([r.sample.greedy for _, r, _ in rows],
-                            jnp.bool_),
-                row_keys)
+            temp_r = jnp.asarray([r.sample.temperature for _, r, _ in rows],
+                                 jnp.float32)
+            topk_r = jnp.asarray([r.sample.top_k for _, r, _ in rows],
+                                 jnp.int32)
+            greedy_r = jnp.asarray([r.sample.greedy for _, r, _ in rows],
+                                   jnp.bool_)
+            if bucket > g.PREFILL_CHUNK:
+                # chunked long-prompt admission: host-driven chunk loop,
+                # then the same splice/sample/activate dispatches
+                row_caches = init_kv_caches(c, n, dtype=g.cache_dtype)
+                logits, row_caches = g._prefill_long(tokens, lengths,
+                                                     row_caches)
+                state["caches"] = g._insert_cache_rows(
+                    state["caches"], row_caches, slot_ids, n, bucket)
+                firsts, row_keys = g._admit_sample_jit(
+                    logits, seeds, temp_r, topk_r, greedy_r)
+                (state["cur"], state["active"], state["first"],
+                 state["temp"], state["topk"], state["greedy"],
+                 state["keys"]) = g._slot_activate(
+                    state["cur"], state["active"], state["first"],
+                    state["temp"], state["topk"], state["greedy"],
+                    state["keys"], slot_ids, lengths, firsts, temp_r,
+                    topk_r, greedy_r, row_keys)
+            else:
+                # the common case: prefill + splice + sample + activation
+                # in ONE dispatch (each dispatch pays a tunnel RTT)
+                (state["caches"], firsts, state["cur"], state["active"],
+                 state["first"], state["temp"], state["topk"],
+                 state["greedy"], state["keys"]) = g._admit_fused(
+                    g.params, jnp.asarray(tokens), state["caches"], lengths,
+                    slot_ids, seeds, state["cur"], state["active"],
+                    state["first"], state["temp"], state["topk"],
+                    state["greedy"], state["keys"], temp_r, topk_r, greedy_r)
             for i, _, _ in rows:
                 slots[i].pending = True
             self._pending.append(_PendingWave(rows, firsts, t0))

@@ -483,13 +483,13 @@ class Generator:
         caches = [flush(c, bf) for c, bf in zip(caches, bufs)]
         return toks.T, last, cur_end, caches, keys
 
-    @functools.partial(jax.jit, static_argnums=(0, 4, 5), donate_argnums=(1,))
-    def _insert_cache_rows(self, slot_caches, row_caches, slot_ids,
-                           n: int, bucket: int):
-        """Copy positions ``[0, bucket)`` of an n-row prefill cache into the
-        slot rows ``slot_ids[j]`` (all layers, K/V and int8 scales alike) —
-        ONE dispatch per admission wave, not one per tensor per row.  One
-        compiled program per (n, bucket)."""
+    @staticmethod
+    def _splice_rows(slot_caches, row_caches, slot_ids, n: int, bucket: int):
+        """Traced body: copy positions ``[0, bucket)`` of an n-row prefill
+        cache into the slot rows ``slot_ids[j]`` (all layers, K/V and int8
+        scales alike).  Shared by ``_insert_cache_rows`` (the chunked
+        long-prompt admission path) and ``_admit_fused`` — one source of
+        truth for the scatter."""
 
         def ins(dst, src):
             src = jax.lax.slice_in_dim(src, 0, bucket, axis=1)
@@ -503,6 +503,65 @@ class Generator:
 
         return jax.tree.map(ins, slot_caches, row_caches)
 
+    def _first_sample(self, logits, seeds, temperature, top_k, greedy):
+        """Traced body: per-request key-chain init from seeds + first-token
+        sample.  Shared by ``_admit_sample_jit`` and ``_admit_fused``."""
+        base = jax.vmap(jax.random.PRNGKey)(seeds)          # [n, 2]
+        first_keys, next_keys = _advance_keys(base)
+        firsts = self._sample_from_logits_perrow(
+            logits, first_keys, temperature, top_k, greedy)
+        return firsts, next_keys
+
+    @staticmethod
+    def _activate_rows(cur, active, first, temp, topk, greedy, keys,
+                       slot_ids, n_cur, n_first, n_temp, n_topk, n_greedy,
+                       n_keys):
+        """Traced body: scatter n admitted rows into the B-slot state
+        arrays.  Shared by ``_slot_activate`` and ``_admit_fused``."""
+        return (cur.at[slot_ids].set(n_cur),
+                active.at[slot_ids].set(1),
+                first.at[slot_ids].set(n_first[:, None]),
+                temp.at[slot_ids].set(n_temp),
+                topk.at[slot_ids].set(n_topk),
+                greedy.at[slot_ids].set(n_greedy),
+                keys.at[slot_ids].set(n_keys))
+
+    @functools.partial(jax.jit, static_argnums=(0, 4, 5), donate_argnums=(1,))
+    def _insert_cache_rows(self, slot_caches, row_caches, slot_ids,
+                           n: int, bucket: int):
+        """One dispatch per (chunked-admission) wave — see _splice_rows."""
+        return self._splice_rows(slot_caches, row_caches, slot_ids, n, bucket)
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(3, 7, 8, 9, 10, 11, 12, 13))
+    def _admit_fused(self, params, tokens, slot_caches, lengths, slot_ids,
+                     seeds, cur, active, first, temp, topk, greedy, keys,
+                     temp_r, topk_r, greedy_r):
+        """ONE-dispatch admission for a same-bucket wave (bucket ≤
+        PREFILL_CHUNK): fresh row caches created in-graph → batched
+        prefill → splice into the slot cache rows → per-request
+        first-token sample + key-chain init → slot-state activation.
+        The multi-dispatch path (``_prefill``/``_insert_cache_rows``/
+        ``_admit_sample_jit``/``_slot_activate``) remains for chunked
+        long-prompt admissions; this fused program exists because each
+        dispatch costs a host round-trip — over a tunnelled link the
+        admission's ~6 RTTs dominated short-generation end-to-end.
+
+        Returns ``(slot_caches, firsts [n], state arrays...)``."""
+        n, bucket = tokens.shape
+        row_caches = init_kv_caches(self.cfg, n, dtype=self.cache_dtype)
+        positions = jnp.broadcast_to(jnp.arange(bucket), (n, bucket))
+        logits, row_caches = self.model.apply(
+            {"params": params}, tokens, positions, row_caches, 0, None,
+            lengths - 1)
+        slot_caches = self._splice_rows(slot_caches, row_caches, slot_ids,
+                                        n, bucket)
+        firsts, next_keys = self._first_sample(logits[:, 0], seeds, temp_r,
+                                               topk_r, greedy_r)
+        return (slot_caches, firsts) + self._activate_rows(
+            cur, active, first, temp, topk, greedy, keys, slot_ids,
+            lengths, firsts, temp_r, topk_r, greedy_r, next_keys)
+
     @functools.partial(jax.jit, static_argnums=(0,))
     def _admit_sample_jit(self, logits, seeds, temperature, top_k, greedy):
         """Device-side admission sampling: prefill logits ``[n, V]`` +
@@ -512,11 +571,7 @@ class Generator:
         fetched at the next natural sync point (fetching the [n, V] logits
         for host sampling costs ~1 s per admission wave at 150k vocab over
         a tunnelled link, measured)."""
-        base = jax.vmap(jax.random.PRNGKey)(seeds)          # [n, 2]
-        first_keys, next_keys = _advance_keys(base)
-        firsts = self._sample_from_logits_perrow(
-            logits, first_keys, temperature, top_k, greedy)
-        return firsts, next_keys
+        return self._first_sample(logits, seeds, temperature, top_k, greedy)
 
     @functools.partial(jax.jit, static_argnums=(0,),
                        donate_argnums=(1, 2, 3, 4, 5, 6, 7))
@@ -524,17 +579,13 @@ class Generator:
                        slot_ids, n_cur, n_first, n_temp, n_topk, n_greedy,
                        n_keys):
         """Scatter n admitted rows into the B-slot state arrays in ONE
-        dispatch.  Entirely device-valued (``n_first``/``n_keys`` come
-        straight from ``_admit_sample_jit``), so admission never syncs the
-        host — the decode chain keeps flowing while prefill+activation are
-        still in flight."""
-        return (cur.at[slot_ids].set(n_cur),
-                active.at[slot_ids].set(1),
-                first.at[slot_ids].set(n_first[:, None]),
-                temp.at[slot_ids].set(n_temp),
-                topk.at[slot_ids].set(n_topk),
-                greedy.at[slot_ids].set(n_greedy),
-                keys.at[slot_ids].set(n_keys))
+        dispatch (chunked long-prompt admissions; the common path fuses
+        this into ``_admit_fused``).  Entirely device-valued, so admission
+        never syncs the host — the decode chain keeps flowing while
+        prefill+activation are still in flight.  See _activate_rows."""
+        return self._activate_rows(cur, active, first, temp, topk, greedy,
+                                   keys, slot_ids, n_cur, n_first, n_temp,
+                                   n_topk, n_greedy, n_keys)
 
     @functools.partial(jax.jit, static_argnums=(0,),
                        donate_argnums=(1, 2, 3, 4, 5, 6))
