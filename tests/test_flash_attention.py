@@ -318,3 +318,71 @@ def test_auto_dispatch_head_dim_scaling():
     assert auto_impl(6, 4096, 12, 4096, False, "tpu", d=80) == "xla"
     # SD1.5 level-1 at serving batch 8: D=80, B*H=128 → xla (measured)
     assert auto_impl(16, 1024, 8, 1024, False, "tpu", d=80) == "xla"
+
+
+# ---------------------------------------------------------------- partials
+# The XLA-level online-softmax decomposition the continuous engine's
+# chunk-local decode uses (attend {frozen cache} ∪ {chunk buffer} and merge).
+
+
+def test_attention_partials_merge_exactly():
+    """Two partials over disjoint key sets merge to the full attention
+    (shared-max decomposition: identical exp inputs, only summation order
+    differs)."""
+    from tpustack.ops.attention import (dot_product_attention_partial,
+                                        merge_attention_partials)
+
+    q = _rand((2, 1, 4, 16), 10)
+    k = _rand((2, 48, 2, 16), 11)   # GQA: 4 q heads over 2 kv heads
+    v = _rand((2, 48, 2, 16), 12)
+    split = 31                      # deliberately not a tile-friendly size
+    cols = jnp.arange(48)[None, None, :]
+    m1 = jnp.broadcast_to(cols < split, (2, 1, 48))
+    m2 = jnp.broadcast_to(cols >= split, (2, 1, 48))
+    p1 = dot_product_attention_partial(q, k, v, mask=m1)
+    p2 = dot_product_attention_partial(q, k, v, mask=m2)
+    got = merge_attention_partials(p1, p2, jnp.float32)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-6)
+
+
+def test_attention_partials_fully_masked_side():
+    """A partial whose every key is masked (l = 0, m = NEG_INF) must not
+    poison the merge — decode's first position attends only its own
+    freshly written K/V."""
+    from tpustack.ops.attention import (dot_product_attention_partial,
+                                        merge_attention_partials)
+
+    q = _rand((1, 1, 2, 16), 13)
+    k = _rand((1, 8, 2, 16), 14)
+    v = _rand((1, 8, 2, 16), 15)
+    none = jnp.zeros((1, 1, 8), bool)
+    only = jnp.ones((1, 1, 8), bool)
+    empty = dot_product_attention_partial(q, k, v, mask=none)
+    full = dot_product_attention_partial(q, k, v, mask=only)
+    got = merge_attention_partials(empty, full, jnp.float32)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-6)
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_attention_partials_int8_scales_match_main_path():
+    """Partial attention with int8 K/V + per-vector scales reproduces the
+    main path's quantised attention when merged."""
+    from tpustack.models.llama import _quantize_kv
+    from tpustack.ops.attention import (dot_product_attention_partial,
+                                        merge_attention_partials)
+
+    q = _rand((1, 1, 4, 16), 16)
+    k = _rand((1, 24, 2, 16), 17)
+    v = _rand((1, 24, 2, 16), 18)
+    kq, ks = _quantize_kv(k)
+    vq, vs = _quantize_kv(v)
+    ref = dot_product_attention(q, kq, vq, k_scale=ks, v_scale=vs)
+    cols = jnp.arange(24)[None, None, :]
+    p1 = dot_product_attention_partial(q, kq, vq, mask=cols < 10,
+                                       k_scale=ks, v_scale=vs)
+    p2 = dot_product_attention_partial(q, kq, vq, mask=cols >= 10,
+                                       k_scale=ks, v_scale=vs)
+    got = merge_attention_partials(p1, p2, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-6)

@@ -186,9 +186,10 @@ class LLMServer:
         # throughput cost of 16 vs 32 is ~4% steady aggregate (708 vs 736
         # tok/s, 7B int8 batch 8) — LLM_ENGINE_CHUNK overrides for
         # throughput-first deployments that accept the coarser cadence
-        override = os.environ.get("LLM_ENGINE_CHUNK")
-        self._engine_chunk_override = (max(1, int(override))
-                                       if override else None)
+        # 0/empty means "no override" (the LLM_BATCH_WINDOW_MS convention),
+        # not a 1-token cadence
+        override = int(os.environ.get("LLM_ENGINE_CHUNK", "0") or 0)
+        self._engine_chunk_override = override if override > 0 else None
         import collections
 
         self._queue: "collections.deque" = collections.deque()
