@@ -59,15 +59,15 @@ Measured (v5e, Qwen-7B int8+int8KV, ``tools/bench_llm.py --continuous`` —
 the numbers BASELINE.md quotes for batched serving, since this engine IS
 the served path):
 
-- 8x(128 prompt + 512 new), ctx 2048: **647-694 tok/s end-to-end,
-  730-751 tok/s steady aggregate decode** (128-new short generations:
+- 8x(128 prompt + 512 new), ctx 2048: **672-695 tok/s end-to-end,
+  753 tok/s steady aggregate decode** (128-new short generations:
   444-543 e2e) — vs the static batcher's 630 decode-phase / ~371 e2e
   same-session (the r4 engine measured 441 e2e: +9% admission tax then;
-  the r5 engine's one-dispatch admissions + chunk-local K/V turned that
-  into a 17% steady-state LEAD over the static path).  Residual e2e
-  spread is the dev tunnel's RTT on the remaining round-trips; steady
-  decode (the slope between the first and last block fetches) is the
-  tunnel-robust figure.
+  the r5 engine's one-dispatch admissions + chunk-local K/V + all-greedy
+  sampling gate turned that into a ~20% steady-state LEAD over the
+  static path).  Residual e2e spread is the dev tunnel's RTT on the
+  remaining round-trips; steady decode (the slope between the first and
+  last block fetches) is the tunnel-robust figure.
 - 2x(16384 prompt + 96 new), ctx 32768: **143.8 tok/s steady = 92% of
   2x the solo-row rate** (78.1 tok/s) — the long-context write-back cliff
   the r4 docstring predicted ("would roughly double KV traffic") is gone.
