@@ -31,8 +31,9 @@ static-shape rules:
 - **Overlapped one-dispatch admission at chunk boundaries**: a joining
   wave's fresh row caches, prefill, KV-line splice, first-token sampling
   and slot activation run as ONE fused device program
-  (``Generator._admit_fused``; prompts longer than PREFILL_CHUNK keep the
-  multi-dispatch sequence around the host-driven chunk loop) — the host
+  (``Generator._admit_fused``; prompts longer than PREFILL_CHUNK run the
+  fused-scan chunked prefill — or a per-chunk host loop for non-multiple
+  buckets — plus the splice/sample/activate dispatches) — the host
   never syncs on admission, so the depth-``depth`` pipelined chunk chain
   keeps flowing while prefill is still in flight.  The host picks up the
   first tokens (one tiny [n]-int32 fetch) at the next natural sync point,
@@ -236,8 +237,10 @@ class ContinuousEngine:
             greedy_r = jnp.asarray([r.sample.greedy for _, r, _ in rows],
                                    jnp.bool_)
             if bucket > g.PREFILL_CHUNK:
-                # chunked long-prompt admission: host-driven chunk loop,
-                # then the same splice/sample/activate dispatches
+                # chunked long-prompt admission: one fused scan dispatch
+                # for exact-multiple buckets (16k/32k), a per-chunk host
+                # loop otherwise (_prefill_long), then the same
+                # splice/sample/activate dispatches
                 row_caches = init_kv_caches(c, n, dtype=g.cache_dtype)
                 logits, row_caches = g._prefill_long(tokens, lengths,
                                                      row_caches)
