@@ -1,0 +1,78 @@
+"""bench.py driver-artifact shape: the LLM/Wan extras folded into the one
+JSON line (VERDICT r4 #2) must keep their schema and degrade — never
+crash — when a tool fails, since the headline SD15 measurement must
+survive any extras breakage."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_llm_extras_schema(monkeypatch):
+    bench = load_bench()
+    calls = []
+
+    def fake_run(cmd, capture_output, text, timeout):
+        calls.append(cmd)
+        payload = {"metric": "m", "value": 1.0, "unit": "tok/s",
+                   "steady_decode_tokens_per_sec": 2.0,
+                   "prefill_tokens_per_sec": 3.0, "roofline_pct": 4.0,
+                   "prefill_roofline_pct": 5.0,
+                   "ignored_key": "must not leak into the artifact"}
+        return subprocess.CompletedProcess(cmd, 0,
+                                           stdout=json.dumps(payload) + "\n",
+                                           stderr="")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    out = bench._llm_extras(lambda *a: None)
+    assert set(out) == {"continuous_e2e", "prefill_8k"}
+    for sub in out.values():
+        assert sub["value"] == 1.0
+        assert sub["steady_decode_tokens_per_sec"] == 2.0
+        assert "ignored_key" not in sub
+    # the two bench_llm invocations: batch-8 continuous + the 8k prefill
+    assert any("--continuous" in c for c in calls)
+    assert any("8192" in c for c in calls)
+
+
+def test_wan_extras_schema(monkeypatch):
+    bench = load_bench()
+
+    def fake_run(cmd, capture_output, text, timeout):
+        payload = {"metric": "w", "value": 600.0, "unit": "videos/hour/chip",
+                   "seconds_per_video": 6.0, "mfu": 0.65, "extra": "drop me"}
+        return subprocess.CompletedProcess(cmd, 0,
+                                           stdout=json.dumps(payload) + "\n",
+                                           stderr="")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    out = bench._wan_extras(lambda *a: None)
+    assert out["mfu"] == 0.65 and out["seconds_per_video"] == 6.0
+    assert "extra" not in out
+
+
+def test_extras_degrade_on_tool_failure(monkeypatch):
+    """A crashing tool yields {'error': ...}, never an exception — the
+    SD15 headline must not die because an extra did."""
+    bench = load_bench()
+
+    def fake_run(cmd, capture_output, text, timeout):
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    out = bench._llm_extras(lambda *a: None)
+    assert "error" in out["continuous_e2e"] and "error" in out["prefill_8k"]
+    wan = bench._wan_extras(lambda *a: None)
+    assert "error" in wan

@@ -244,6 +244,39 @@ def test_server_batched_streaming_coalesces(gen):
         assert final is not None and final["tokens_predicted"] <= 6
 
 
+def test_server_negative_seed_is_random_not_fatal(gen):
+    """r5 review: llama.cpp clients routinely send seed=-1 ("random").
+    It must behave as an unseeded request — and an out-of-range seed must
+    never escape as an OverflowError that fails every batched peer."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    server = LLMServer(generator=gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test", max_batch=4)
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            outs = []
+            for seed in (-1, 2**40):  # llama.cpp "random" + out-of-range
+                r = await client.post("/completion", json={
+                    "prompt": "hello", "n_predict": 4, "seed": seed,
+                    "temperature": 0.9})
+                assert r.status == 200, await r.text()
+                outs.append(await r.json())
+            return outs
+        finally:
+            await client.close()
+
+    for j in asyncio.new_event_loop().run_until_complete(scenario()):
+        assert j["tokens_predicted"] <= 4
+
+
 def test_server_seeded_sampling_batches_and_reproduces(gen):
     """r5: seeded non-greedy requests go through the continuous engine
     (per-slot PRNG streams make them admission-timing independent) — the

@@ -227,8 +227,14 @@ class ContinuousEngine:
                 tokens[j, :len(r.ids)] = r.ids
             lengths = jnp.asarray([len(r.ids) for _, r, _ in rows], jnp.int32)
             slot_ids = jnp.asarray([i for i, _, _ in rows], jnp.int32)
+            # normalize into uint32 exactly like jax.random.PRNGKey wraps
+            # ints: llama.cpp clients send seed=-1 for "random" (the server
+            # maps that to None) but ANY out-of-range int must not be able
+            # to kill the run — an OverflowError here would fail every
+            # in-flight peer
             seeds = jnp.asarray(
-                [r.seed if r.seed is not None else np.random.randint(0, 2**31)
+                [(r.seed % (2**32)) if r.seed is not None
+                 else np.random.randint(0, 2**31)
                  for _, r, _ in rows], jnp.uint32)
             temp_r = jnp.asarray([r.sample.temperature for _, r, _ in rows],
                                  jnp.float32)

@@ -386,9 +386,7 @@ class GraphExecutor:
         per = max(1, pipe.pixel_frame_count(spec.latent.frames)) \
             * spec.latent.height * spec.latent.width
         max_b = max(1, PIXEL_BUDGET // per)
-        out = []
-        for lo in range(0, len(rows), max_b):
-            chunk = rows[lo:lo + max_b]
+        def dispatch(chunk):
             if len(chunk) == 1:
                 vid_dev = pipe.generate_async(
                     chunk[0].positive.text,
@@ -405,7 +403,24 @@ class GraphExecutor:
                     frames=spec.latent.frames, steps=spec.steps,
                     guidance_scale=spec.cfg, width=spec.latent.width,
                     height=spec.latent.height, sampler=spec.sampler_name)
-            out.extend(Frames(array=vid_dev[i]) for i in range(len(chunk)))
+            return [Frames(array=vid_dev[i]) for i in range(len(chunk))]
+
+        out = []
+        for lo in range(0, len(rows), max_b):
+            chunk = rows[lo:lo + max_b]
+            try:
+                out.extend(dispatch(chunk))
+            except Exception as e:  # noqa: BLE001 — same policy as the
+                # worker's _dispatch_one: a batched build failure (e.g.
+                # compile-time HBM OOM at a shape an overridden pixel
+                # budget admitted) degrades to per-row serial dispatches,
+                # not a failed graph
+                if len(chunk) == 1:
+                    raise
+                log.warning("hookless batched dispatch of %d failed (%s); "
+                            "serving rows serially", len(chunk), e)
+                for r in chunk:
+                    out.extend(dispatch([r]))
         log.info("Dispatched %d row(s) in %d chunk(s) in %.2fs (async; "
                  "save nodes fetch)", len(out),
                  (len(rows) + max_b - 1) // max_b, time.time() - t0)

@@ -62,6 +62,15 @@ def _or_default(value, default):
     return default if value is None else value
 
 
+def _normalize_seed(seed):
+    """llama.cpp request convention: a negative seed (clients routinely
+    send -1) means "draw a random one" — map it to None so the engine
+    picks a fresh seed; anything non-int is ignored likewise."""
+    if isinstance(seed, bool) or not isinstance(seed, int):
+        return None
+    return seed if seed >= 0 else None
+
+
 def _build_generator():
     import jax.numpy as jnp
 
@@ -657,7 +666,7 @@ class LLMServer:
             return web.json_response({"error": f"invalid parameter: {e}"}, status=400)
         if n_predict < 0:  # llama.cpp: -1 means "until EOS / context limit"
             n_predict = self.gen.cfg.max_seq
-        seed = body.get("seed")
+        seed = _normalize_seed(body.get("seed"))
         if body.get("stream"):
             return await self._stream(request, prompt, n_predict, temperature,
                                       top_k, seed, fmt="llamacpp")
@@ -701,11 +710,12 @@ class LLMServer:
                 {"error": {"message": f"invalid parameter: {e}"}}, status=400)
         if body.get("stream"):
             return await self._stream(request, prompt, n_predict, temperature,
-                                      40, body.get("seed"), fmt="openai")
+                                      40, _normalize_seed(body.get("seed")), fmt="openai")
 
         try:
             content, stats, stopped_eos = await self._complete_routed(
-                prompt, n_predict, temperature, 40, body.get("seed"))
+                prompt, n_predict, temperature, 40,
+                _normalize_seed(body.get("seed")))
         except ValueError as e:
             return web.json_response({"error": {"message": str(e)}}, status=400)
         return web.json_response({
