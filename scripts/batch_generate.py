@@ -30,6 +30,18 @@ DEFAULT_URL = "http://127.0.0.1:30800/generate"
 _tls = threading.local()
 
 
+def _progress_counter():
+    """Client-progress counter for the in-cluster Job's /metrics sidecar
+    (``TPUSTACK_METRICS_PORT``).  None on workstations without the tpustack
+    package — the script stays standalone-runnable."""
+    try:
+        from tpustack.obs import catalog
+
+        return catalog.build()["tpustack_batch_generate_requests_total"]
+    except ImportError:
+        return None
+
+
 def _thread_session() -> requests.Session:
     """One Session per worker thread — requests documents Session as not
     thread-safe under concurrent mutation (cookies/redirects)."""
@@ -39,12 +51,15 @@ def _thread_session() -> requests.Session:
 
 
 def _one_request(url: str, payload: dict, target: Path, name: str) -> bool:
+    counter = _progress_counter()
     try:
         resp = _thread_session().post(url, json=payload, timeout=600)
         resp.raise_for_status()
         target.write_bytes(resp.content)
         gen_time = resp.headers.get("X-Gen-Time", "?")
         print(f"    {name} done in {gen_time}")
+        if counter is not None:
+            counter.labels(outcome="ok").inc()
         return True
     except requests.exceptions.RequestException as e:
         print(f"    Request failed for {name}: {e}")
@@ -52,6 +67,8 @@ def _one_request(url: str, payload: dict, target: Path, name: str) -> bool:
     except Exception as e:
         print(f"    Unexpected error for {name}: {e}")
         traceback.print_exc()
+    if counter is not None:
+        counter.labels(outcome="failed").inc()
     return False
 
 
@@ -119,6 +136,17 @@ def main(argv: list[str]) -> int:
                         help="in-flight requests; >1 lets the server micro-"
                              "batch them across its chips (default: 1)")
     args = parser.parse_args(argv)
+
+    # TPUSTACK_METRICS_PORT (batch-generate.yaml sets 9100): expose client-
+    # side progress counters to the cluster scraper; the import is guarded
+    # because this script also runs standalone on workstations without the
+    # tpustack package installed
+    try:
+        from tpustack.obs.http import maybe_start_metrics_sidecar
+
+        maybe_start_metrics_sidecar()
+    except ImportError:
+        pass
 
     out_dir = Path(args.out_dir)
     ok = generate(args.prompt, args.steps, args.url, out_dir, args.prefix,

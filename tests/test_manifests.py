@@ -282,3 +282,101 @@ def test_ansible_playbook_shapes():
     assert "kubernetes_version" in gv and "libtpu_version" in gv
     inventory = (inst / "inventory.ini").read_text()
     assert "[masters]" in inventory and "k8s_cluster:children" in inventory
+
+
+# ---------------------------------------------------------- observability
+def _pod_template(doc):
+    if doc["kind"] == "JobSet":  # replicatedJobs[].template is a Job spec
+        return doc["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]
+    return doc["spec"]["template"]
+
+
+def test_serving_pods_carry_scrape_annotations():
+    """Every serving Deployment's pod template must be scrapeable: the
+    prometheus.io annotation trio, with the port matching the serving
+    containerPort (where /metrics actually listens)."""
+    targets = [
+        (CLUSTER / "apps" / "sd15-api" / "deployment.yaml", "sd15-api"),
+        (CLUSTER / "apps" / "llm" / "deployment.yaml", "coder-llm"),
+        (CLUSTER / "apps" / "llm" / "wan-deployment.yaml", "wan-video-gen"),
+    ]
+    for path, name in targets:
+        dep = next(d for d in _load_all(path) if d["kind"] == "Deployment")
+        assert dep["metadata"]["name"] == name
+        tmpl = dep["spec"]["template"]
+        ann = tmpl["metadata"].get("annotations", {})
+        assert ann.get("prometheus.io/scrape") == "true", f"{path}: scrape off"
+        assert ann.get("prometheus.io/path") == "/metrics", path
+        ports = [p["containerPort"]
+                 for c in tmpl["spec"]["containers"]
+                 for p in c.get("ports", [])]
+        assert int(ann["prometheus.io/port"]) in ports, (
+            f"{path}: annotation port {ann['prometheus.io/port']} not a "
+            f"containerPort {ports}")
+
+
+def test_batch_jobs_scrape_wiring():
+    """Jobs that run tpustack entrypoints expose the stdlib /metrics
+    sidecar: TPUSTACK_METRICS_PORT env and matching scrape annotations."""
+    job_files = ["batch-generate.yaml", "train-bert-v5e8.yaml",
+                 "train-resnet50.yaml", "train-sd15.yaml",
+                 "train-llama2-jobset.yaml"]
+    for name in job_files:
+        docs = _load_all(CLUSTER / "jobs" / name)
+        doc = next(d for d in docs if d["kind"] in ("Job", "JobSet"))
+        tmpl = _pod_template(doc)
+        ann = tmpl["metadata"].get("annotations", {})
+        assert ann.get("prometheus.io/scrape") == "true", f"{name}: scrape off"
+        port = ann.get("prometheus.io/port")
+        assert port, f"{name}: no scrape port"
+        env = {e["name"]: e.get("value")
+               for c in tmpl["spec"]["containers"] for e in c.get("env", [])}
+        assert env.get("TPUSTACK_METRICS_PORT") == port, (
+            f"{name}: TPUSTACK_METRICS_PORT ({env.get('TPUSTACK_METRICS_PORT')})"
+            f" must match the scrape annotation ({port})")
+
+
+def test_podmonitoring_selects_real_workloads():
+    """The GMP-flavour scrape CRs must target labels/ports that actually
+    exist on the Deployments they monitor, in the right namespace."""
+    mon = CLUSTER / "apps" / "monitoring"
+    kust = _load_all(mon / "kustomization.yaml")[0]
+    assert len(kust["resources"]) >= 3
+    deployments = {}
+    for p in [CLUSTER / "apps" / "sd15-api" / "deployment.yaml",
+              CLUSTER / "apps" / "llm" / "deployment.yaml",
+              CLUSTER / "apps" / "llm" / "wan-deployment.yaml"]:
+        for d in _load_all(p):
+            if d["kind"] == "Deployment":
+                deployments[d["metadata"]["name"]] = d
+    seen = 0
+    for res in kust["resources"]:
+        for pm in _load_all(mon / res):
+            assert pm["kind"] == "PodMonitoring", res
+            sel = pm["spec"]["selector"]["matchLabels"]
+            match = [d for d in deployments.values()
+                     if d["metadata"]["namespace"] == pm["metadata"]["namespace"]
+                     and all(d["spec"]["template"]["metadata"]["labels"].get(k) == v
+                             for k, v in sel.items())]
+            assert match, f"{res}: selector {sel} matches no Deployment"
+            port_names = {p.get("name")
+                          for c in match[0]["spec"]["template"]["spec"]["containers"]
+                          for p in c.get("ports", [])}
+            for ep in pm["spec"]["endpoints"]:
+                assert ep["path"] == "/metrics", res
+                assert ep["port"] in port_names, (
+                    f"{res}: endpoint port {ep['port']!r} is not a named "
+                    f"containerPort {port_names}")
+            seen += 1
+    assert seen >= 3
+
+
+def test_flux_monitoring_kustomization_wired():
+    """The monitoring app rides the same Flux fan-out, after its targets."""
+    path = CLUSTER / "cluster" / "flux-system" / "apps-kustomization.yaml"
+    docs = {d["metadata"]["name"]: d for d in _load_all(path)}
+    assert "monitoring" in docs
+    mon = docs["monitoring"]["spec"]
+    assert mon["path"] == "./cluster-config/apps/monitoring"
+    deps = [x["name"] for x in mon.get("dependsOn", [])]
+    assert {"sd15-api", "llm"} <= set(deps)

@@ -1,0 +1,316 @@
+"""Unit tests for tpustack.obs: metrics registry (labels, bucketing,
+exposition format, thread safety), trace spans, request-id logging, and the
+metric-name lint the tier-1 suite enforces over the catalog."""
+
+import io
+import json
+import logging
+import math
+import os
+import sys
+import threading
+
+import pytest
+
+from tpustack.obs import Registry, Trace, bind_request_id, new_request_id
+from tpustack.obs import catalog
+from tpustack.obs.metrics import CONTENT_TYPE, DEFAULT_BUCKETS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------- counters
+def test_counter_labels_and_exposition():
+    r = Registry()
+    c = r.counter("tpustack_test_total", "help text", ("server", "status"))
+    c.labels(server="llm", status="200").inc()
+    c.labels(server="llm", status="200").inc(2)
+    c.labels("sd", "500").inc()  # positional form
+    text = r.render()
+    assert "# HELP tpustack_test_total help text" in text
+    assert "# TYPE tpustack_test_total counter" in text
+    assert 'tpustack_test_total{server="llm",status="200"} 3' in text
+    assert 'tpustack_test_total{server="sd",status="500"} 1' in text
+    assert r.get_sample_value("tpustack_test_total",
+                              {"server": "llm", "status": "200"}) == 3
+
+
+def test_counter_rejects_negative_and_wrong_labels():
+    r = Registry()
+    c = r.counter("tpustack_x_total", "h", ("a",))
+    with pytest.raises(ValueError):
+        c.labels(a="1").inc(-1)
+    with pytest.raises(ValueError):
+        c.labels(b="1")
+    with pytest.raises(ValueError):
+        c.labels("1", "2")
+
+
+def test_label_value_escaping():
+    r = Registry()
+    c = r.counter("tpustack_esc_total", "h", ("p",))
+    c.labels(p='he said "hi"\nback\\slash').inc()
+    line = [l for l in r.render().splitlines() if l.startswith("tpustack_esc")][0]
+    assert r'\"hi\"' in line and r"\n" in line and r"\\slash" in line
+
+
+# ------------------------------------------------------------------ gauges
+def test_gauge_set_inc_dec():
+    r = Registry()
+    g = r.gauge("tpustack_depth_depth", "h")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value == 4
+    assert "tpustack_depth_depth 4" in r.render()
+
+
+# -------------------------------------------------------------- histograms
+def test_histogram_bucketing_cumulative_and_le_inclusive():
+    r = Registry()
+    h = r.histogram("tpustack_lat_seconds", "h", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 2.0, 100.0):
+        h.observe(v)
+    text = r.render()
+    # le is INCLUSIVE: 0.1 falls in the 0.1 bucket
+    assert 'tpustack_lat_seconds_bucket{le="0.1"} 2' in text
+    assert 'tpustack_lat_seconds_bucket{le="1"} 3' in text
+    assert 'tpustack_lat_seconds_bucket{le="10"} 4' in text
+    assert 'tpustack_lat_seconds_bucket{le="+Inf"} 5' in text
+    assert "tpustack_lat_seconds_count 5" in text
+    assert f"tpustack_lat_seconds_sum {0.05 + 0.1 + 0.5 + 2.0 + 100.0!r}" in text
+    assert r.get_sample_value("tpustack_lat_seconds_bucket", {"le": "1"}) == 3
+
+
+def test_histogram_percentiles_exact_when_samples_tracked():
+    import statistics
+
+    r = Registry()
+    h = r.histogram("tpustack_p_seconds", "h", sample_cap=100)
+    vals = [0.3, 0.1, 0.9, 0.5, 0.7]
+    for v in vals:
+        h.observe(v)
+    assert h.percentile(50) == pytest.approx(statistics.median(vals))
+    assert h.percentile(0) == pytest.approx(min(vals))
+    assert h.percentile(100) == pytest.approx(max(vals))
+    with pytest.raises(ValueError):
+        h.percentile(101)
+
+
+def test_histogram_percentile_interpolates_from_buckets():
+    r = Registry()
+    h = r.histogram("tpustack_q_seconds", "h", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5,) * 10 + (3.0,) * 10:  # no sample tracking
+        h.observe(v)
+    p50 = h.percentile(50)
+    assert 0 < p50 <= 1.0  # rank 10 sits at the first bucket's edge
+    p90 = h.percentile(90)
+    assert 2.0 < p90 <= 4.0
+
+
+def test_histogram_rejects_bad_buckets():
+    r = Registry()
+    with pytest.raises(ValueError):
+        r.histogram("tpustack_bad_seconds", "h", buckets=(2.0, 1.0))
+    with pytest.raises(ValueError):
+        r.histogram("tpustack_bad2_seconds", "h", buckets=(1.0, math.inf))
+
+
+def test_default_buckets_cover_serving_range():
+    assert DEFAULT_BUCKETS[0] <= 0.001 and DEFAULT_BUCKETS[-1] >= 300
+
+
+# ------------------------------------------------------------ thread safety
+def test_concurrent_increments_do_not_lose_updates():
+    r = Registry()
+    c = r.counter("tpustack_threads_total", "h", ("t",))
+    h = r.histogram("tpustack_threads_seconds", "h")
+    N, T = 2000, 8
+
+    def work(i):
+        for _ in range(N):
+            c.labels(t=str(i % 2)).inc()
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(r.get_sample_value("tpustack_threads_total", {"t": k})
+                for k in ("0", "1"))
+    assert total == N * T
+    assert h.count == N * T
+
+
+def test_concurrent_label_creation_single_child():
+    r = Registry()
+    g = r.gauge("tpustack_race_depth", "h", ("k",))
+    children = []
+    barrier = threading.Barrier(8)
+
+    def grab():
+        barrier.wait()
+        children.append(g.labels(k="same"))
+
+    threads = [threading.Thread(target=grab) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(ch is children[0] for ch in children)
+
+
+# -------------------------------------------------------- registry contract
+def test_registry_get_or_create_idempotent_and_type_checked():
+    r = Registry()
+    a = r.counter("tpustack_idem_total", "h", ("x",))
+    b = r.counter("tpustack_idem_total", "different help ignored", ("x",))
+    assert a is b
+    with pytest.raises(ValueError):
+        r.gauge("tpustack_idem_total", "h", ("x",))
+    with pytest.raises(ValueError):
+        r.counter("tpustack_idem_total", "h", ("y",))
+
+
+def test_collector_runs_at_render_and_failures_are_contained():
+    r = Registry()
+    g = r.gauge("tpustack_coll_depth", "h")
+    r.add_collector(lambda reg: g.set(7))
+    r.add_collector(lambda reg: 1 / 0)  # must not break the scrape
+    assert "tpustack_coll_depth 7" in r.render()
+
+
+def test_catalog_builds_and_exposition_contains_families():
+    r = Registry()
+    catalog.build(r)
+    text = r.render()
+    # sample-less families still advertise HELP/TYPE (device gauges on CPU)
+    for name in ("tpustack_device_hbm_used_bytes",
+                 "tpustack_device_hbm_limit_bytes",
+                 "tpustack_http_requests_total",
+                 "tpustack_request_phase_latency_seconds"):
+        assert f"# TYPE {name} " in text, name
+    assert "version=0.0.4" in CONTENT_TYPE
+
+
+# ------------------------------------------------------------------- trace
+def test_trace_spans_and_observe_into():
+    r = Registry()
+    h = r.histogram("tpustack_phase_seconds", "h", ("server", "phase"))
+    t = Trace(request_id="abc")
+    with t.span("prefill"):
+        pass
+    t.add("decode", 0.25)
+    t.observe_into(h, server="llm")
+    assert r.get_sample_value("tpustack_phase_seconds_count",
+                              {"server": "llm", "phase": "prefill"}) == 1
+    assert r.get_sample_value("tpustack_phase_seconds_sum",
+                              {"server": "llm", "phase": "decode"}) == 0.25
+    assert t.durations()["decode"] == 0.25
+
+
+def test_request_ids_unique_and_bindable():
+    ids = {new_request_id() for _ in range(100)}
+    assert len(ids) == 100 and all(len(i) == 12 for i in ids)
+    rid = bind_request_id()
+    from tpustack.obs.trace import current_request_id
+
+    assert current_request_id.get() == rid
+    assert bind_request_id("fixed") == "fixed"
+
+
+# ------------------------------------------------------------ logging glue
+def _capture_log_line(fmt: str, msg: str) -> str:
+    from tpustack.utils.logging import configure_logging, get_logger
+
+    old = os.environ.get("TPUSTACK_LOG_FORMAT")
+    os.environ["TPUSTACK_LOG_FORMAT"] = fmt
+    try:
+        configure_logging(force=True)
+        buf = io.StringIO()
+        logging.getLogger("tpustack").handlers[0].stream = buf
+        get_logger("test.obs").info(msg)
+        return buf.getvalue().strip()
+    finally:
+        if old is None:
+            os.environ.pop("TPUSTACK_LOG_FORMAT", None)
+        else:
+            os.environ["TPUSTACK_LOG_FORMAT"] = old
+        configure_logging(force=True)
+
+
+def test_text_log_carries_request_id():
+    bind_request_id("feedbeef0123")
+    line = _capture_log_line("text", "hello")
+    assert "[rid=feedbeef0123]" in line and "hello" in line
+
+
+def test_json_log_format():
+    bind_request_id("0123456789ab")
+    line = _capture_log_line("json", "structured %s" % "msg")
+    d = json.loads(line)
+    assert d["level"] == "INFO"
+    assert d["logger"] == "tpustack.test.obs"
+    assert d["request_id"] == "0123456789ab"
+    assert d["message"] == "structured msg"
+    assert "ts" in d
+
+
+# ----------------------------------------------------------------- the lint
+def test_metric_name_lint_passes_on_catalog():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint_metrics
+    finally:
+        sys.path.pop(0)
+    assert lint_metrics.lint() == []
+
+
+def test_metric_name_lint_catches_violations(monkeypatch):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint_metrics
+    finally:
+        sys.path.pop(0)
+    from tpustack.obs.catalog import MetricSpec
+
+    bad = (
+        MetricSpec("vllm_outsider_total", "counter", "h", unit="total"),
+        MetricSpec("tpustack_camelCase_seconds", "gauge", "h", unit="seconds"),
+        MetricSpec("tpustack_counter_missing_suffix", "counter", "h",
+                   unit="total"),
+        MetricSpec("tpustack_gauge_no_unit", "gauge", "h", unit="unit"),
+        MetricSpec("tpustack_resv_seconds", "histogram", "h", labels=("le",),
+                   unit="seconds"),
+        MetricSpec("tpustack_desc_seconds", "histogram", "h", unit="seconds",
+                   buckets=(2.0, 1.0)),
+    )
+    monkeypatch.setattr("tpustack.obs.catalog.CATALOG", bad)
+    errors = lint_metrics.lint()
+    assert len(errors) >= 6
+    joined = "\n".join(errors)
+    for frag in ("vllm_outsider_total", "camelCase", "missing_suffix",
+                 "no_unit", "reserved", "ascending"):
+        assert frag in joined, (frag, joined)
+
+
+# ------------------------------------------------------- stdlib sidecar
+def test_metrics_sidecar_serves_exposition():
+    import urllib.request
+
+    from tpustack.obs.http import start_metrics_sidecar
+
+    r = Registry()
+    r.counter("tpustack_sidecar_total", "h").inc(3)
+    srv = start_metrics_sidecar(0, r, host="127.0.0.1")  # ephemeral port
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "tpustack_sidecar_total 3" in body
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5).read()
+        assert b"ok" in health
+    finally:
+        srv.shutdown()

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Metric-name lint: keep the ``tpustack_*`` namespace coherent as it grows.
+
+Checks the catalog (``tpustack.obs.catalog.CATALOG``) — the single place
+metrics are declared — against the naming contract:
+
+- every name matches ``tpustack_<snake_case>`` (lowercase, digits, single
+  underscores; no camelCase, no double underscores, no trailing underscore);
+- counters end in ``_total`` (Prometheus convention);
+- every non-counter name ends in an approved unit token (``_seconds``,
+  ``_bytes``, ... or a count unit like ``_depth``/``_slots``/``_tokens``),
+  and the declared ``unit`` field matches that suffix;
+- label names are snake_case and never repeat a reserved name (``le``,
+  ``quantile``, anything ``__``-prefixed);
+- histogram buckets are strictly ascending and finite;
+- help strings exist; names are unique.
+
+Runs standalone (``python tools/lint_metrics.py``, exit 1 on violations)
+and inside the tier-1 suite (``tests/test_obs.py`` imports ``lint()``), so
+a nonconforming metric fails CI before it ships.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_NAME_RE = re.compile(r"^tpustack(_[a-z0-9]+)+$")
+_LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: approved trailing unit tokens.  Base units (Prometheus guidance) plus the
+#: count-style units this stack legitimately exports; extend deliberately —
+#: DON'T invent per-metric spellings of the same unit (e.g. "secs", "msec").
+UNIT_SUFFIXES = (
+    "seconds", "bytes", "ratio", "celsius", "info",
+    # count units (dimensionless gauges/histograms say what they count)
+    "depth", "slots", "tokens", "images", "requests", "entries", "prompts",
+)
+_RESERVED_LABELS = {"le", "quantile"}
+
+
+def lint() -> List[str]:
+    """Return a list of violation strings (empty = clean)."""
+    from tpustack.obs.catalog import CATALOG
+
+    errors: List[str] = []
+    seen = set()
+    for spec in CATALOG:
+        where = f"{spec.name}:"
+        if spec.name in seen:
+            errors.append(f"{where} duplicate metric name")
+        seen.add(spec.name)
+        if not _NAME_RE.match(spec.name):
+            errors.append(f"{where} not tpustack_* snake_case")
+        if spec.type not in ("counter", "gauge", "histogram"):
+            errors.append(f"{where} unknown type {spec.type!r}")
+        if not spec.help.strip():
+            errors.append(f"{where} empty help string")
+
+        if spec.type == "counter":
+            if not spec.name.endswith("_total"):
+                errors.append(f"{where} counters must end in _total")
+            if spec.unit != "total":
+                errors.append(f"{where} counter unit field must be 'total'")
+        else:
+            suffix = spec.name.rsplit("_", 1)[-1]
+            if suffix not in UNIT_SUFFIXES:
+                errors.append(
+                    f"{where} must end in a unit suffix {UNIT_SUFFIXES}, "
+                    f"got _{suffix}")
+            elif spec.unit != suffix:
+                errors.append(
+                    f"{where} declared unit {spec.unit!r} != name suffix "
+                    f"{suffix!r}")
+
+        for label in spec.labels:
+            if not _LABEL_RE.match(label) or label.startswith("__"):
+                errors.append(f"{where} bad label name {label!r}")
+            if label in _RESERVED_LABELS:
+                errors.append(f"{where} label {label!r} is reserved")
+
+        if spec.type == "histogram" and spec.buckets is not None:
+            b = list(spec.buckets)
+            if b != sorted(b) or len(set(b)) != len(b):
+                errors.append(f"{where} buckets not strictly ascending: {b}")
+            if any(x != x or x in (float("inf"), float("-inf")) for x in b):
+                errors.append(f"{where} buckets must be finite "
+                              "(+Inf is implicit)")
+        if spec.type != "histogram" and spec.buckets is not None:
+            errors.append(f"{where} buckets on a non-histogram")
+    return errors
+
+
+def main() -> int:
+    errors = lint()
+    if errors:
+        for e in errors:
+            print(f"lint_metrics: {e}", file=sys.stderr)
+        print(f"lint_metrics: {len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    from tpustack.obs.catalog import CATALOG
+
+    print(f"lint_metrics: {len(CATALOG)} metrics OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""tpustack.obs — dependency-free metrics + request tracing.
+
+The serving-stack observability layer (vLLM/TGI posture, zero new deps):
+
+- :mod:`tpustack.obs.metrics` — Counter / Gauge / Histogram with labels,
+  thread-safe, Prometheus text exposition; process-wide ``REGISTRY``.
+- :mod:`tpustack.obs.catalog` — every exported metric, declared once;
+  linted by ``tools/lint_metrics.py``.
+- :mod:`tpustack.obs.trace` — request-ids (contextvar, stamped on every
+  log line) + per-phase span timings.
+- :mod:`tpustack.obs.device` — scrape-time HBM / compile-cache collectors.
+- :mod:`tpustack.obs.http` — ``GET /metrics`` handler, aiohttp
+  instrumentation middleware, stdlib sidecar for batch jobs.
+
+See ``docs/OBSERVABILITY.md`` for the metric catalog and scrape wiring.
+"""
+
+from tpustack.obs.metrics import (CONTENT_TYPE, DEFAULT_BUCKETS, REGISTRY,
+                                  Counter, Gauge, Histogram, Registry)
+from tpustack.obs.trace import (Trace, bind_request_id, current_request_id,
+                                new_request_id)
+
+__all__ = [
+    "CONTENT_TYPE", "DEFAULT_BUCKETS", "REGISTRY", "Counter", "Gauge",
+    "Histogram", "Registry", "Trace", "bind_request_id",
+    "current_request_id", "new_request_id",
+]

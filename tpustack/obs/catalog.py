@@ -1,0 +1,150 @@
+"""The metric catalog: every metric the stack exports, declared in one place.
+
+Central declaration buys three things: the three servers share families
+(same name → same family object in the default registry) instead of
+drifting; ``tools/lint_metrics.py`` can enforce the naming contract
+(``tpustack_*``, snake_case, unit-suffixed, counters ``_total``) on the
+catalog instead of grepping call sites; and ``docs/OBSERVABILITY.md``'s
+table has a source of truth.
+
+Add new metrics HERE, then take them from the dict ``build()`` returns —
+ad-hoc ``registry.counter(...)`` calls in serving code will work (the
+registry is get-or-create) but escape the lint, so don't.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+from tpustack.obs.metrics import REGISTRY, Registry
+
+#: batch-size style buckets: micro-batchers cap out at small powers of two
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+#: token-count buckets for prompt/generation length histograms
+TOKEN_BUCKETS = (1, 8, 32, 128, 512, 2048, 8192, 32768)
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    type: str  # counter | gauge | histogram
+    help: str
+    labels: Tuple[str, ...] = ()
+    unit: str = ""  # trailing unit token, checked by tools/lint_metrics.py
+    buckets: Optional[Tuple[float, ...]] = None  # histograms only
+
+
+CATALOG: Tuple[MetricSpec, ...] = (
+    # ---- HTTP surface (all three servers; server ∈ llm|sd|graph) ----
+    MetricSpec("tpustack_http_requests_total", "counter",
+               "HTTP requests served, by endpoint and status code.",
+               ("server", "endpoint", "status"), unit="total"),
+    MetricSpec("tpustack_http_request_latency_seconds", "histogram",
+               "End-to-end HTTP request latency (ingress to last byte).",
+               ("server", "endpoint"), unit="seconds"),
+    MetricSpec("tpustack_http_in_flight_requests", "gauge",
+               "Requests currently being handled.",
+               ("server",), unit="requests"),
+    MetricSpec("tpustack_request_phase_latency_seconds", "histogram",
+               "Per-phase request latency: llm queue_wait/prefill/decode/"
+               "detokenize; sd queue_wait/batch_build/denoise_vae/"
+               "png_encode (denoise+VAE are ONE fused XLA program, not "
+               "separable); graph node_<Class> execute spans.",
+               ("server", "phase"), unit="seconds"),
+
+    # ---- LLM server (continuous batching engine) ----
+    MetricSpec("tpustack_llm_queue_depth", "gauge",
+               "Completions parked in the admission queue (not yet in a "
+               "slot).", unit="depth"),
+    MetricSpec("tpustack_llm_running_requests", "gauge",
+               "Requests admitted to engine slots and still decoding.",
+               unit="requests"),
+    MetricSpec("tpustack_llm_prompt_tokens_total", "counter",
+               "Prompt tokens prefilled.", unit="total"),
+    MetricSpec("tpustack_llm_generated_tokens_total", "counter",
+               "Tokens generated (decode output).", unit="total"),
+    MetricSpec("tpustack_llm_requests_rejected_total", "counter",
+               "Requests rejected at admission, by reason.",
+               ("reason",), unit="total"),
+    MetricSpec("tpustack_llm_batch_occupancy_slots", "histogram",
+               "Requests served per continuous-engine busy period.",
+               buckets=BATCH_BUCKETS, unit="slots"),
+    MetricSpec("tpustack_llm_prompt_length_tokens", "histogram",
+               "Prompt length distribution.",
+               buckets=TOKEN_BUCKETS, unit="tokens"),
+
+    # ---- SD server (signature-keyed micro-batcher) ----
+    MetricSpec("tpustack_sd_queue_depth", "gauge",
+               "Generate requests waiting in micro-batch groups.",
+               unit="depth"),
+    MetricSpec("tpustack_sd_batch_size_images", "histogram",
+               "Real (un-padded) images per fused dispatch.",
+               buckets=BATCH_BUCKETS, unit="images"),
+    MetricSpec("tpustack_sd_padded_slots_total", "counter",
+               "Pad rows added to reach canonical pow2/dp batch shapes — "
+               "wasted device work.", unit="total"),
+    MetricSpec("tpustack_sd_images_total", "counter",
+               "Images generated (pad rows excluded).", unit="total"),
+
+    # ---- graph (Wan video) server ----
+    MetricSpec("tpustack_graph_queue_depth", "gauge",
+               "Prompts queued for the worker (submitted, not dispatched).",
+               unit="depth"),
+    MetricSpec("tpustack_graph_prompts_total", "counter",
+               "Prompt graphs finished, by outcome "
+               "(success|error|rejected).", ("status",), unit="total"),
+    MetricSpec("tpustack_graph_node_latency_seconds", "histogram",
+               "Per-node execute time during graph resolution, by "
+               "class_type.", ("node_class",), unit="seconds"),
+    MetricSpec("tpustack_graph_batch_fallback_total", "counter",
+               "Batched dispatches that failed (typically compile-time HBM "
+               "OOM) and degraded to per-row serial dispatch.",
+               unit="total"),
+
+    # ---- batch clients (scripts/batch_generate.py via the Job sidecar) ----
+    MetricSpec("tpustack_batch_generate_requests_total", "counter",
+               "batch_generate client requests, by outcome (ok|failed).",
+               ("outcome",), unit="total"),
+
+    # ---- device / runtime (scrape-time collectors, obs.device) ----
+    MetricSpec("tpustack_device_hbm_used_bytes", "gauge",
+               "HBM bytes in use, per device "
+               "(jax.Device.memory_stats bytes_in_use).",
+               ("device",), unit="bytes"),
+    MetricSpec("tpustack_device_hbm_limit_bytes", "gauge",
+               "HBM capacity, per device "
+               "(jax.Device.memory_stats bytes_limit).",
+               ("device",), unit="bytes"),
+    MetricSpec("tpustack_compile_cache_entries", "gauge",
+               "Compiled programs in the persistent XLA cache dir.",
+               unit="entries"),
+    MetricSpec("tpustack_compile_cache_bytes", "gauge",
+               "Bytes on disk in the persistent XLA cache dir.",
+               unit="bytes"),
+    MetricSpec("tpustack_compile_cache_hits_total", "counter",
+               "Persistent-cache hits observed via jax monitoring events "
+               "(0 until the first cached compile; absent listener support "
+               "leaves it 0).", unit="total"),
+    MetricSpec("tpustack_process_start_time_seconds", "gauge",
+               "Unix time the process imported tpustack.obs.",
+               unit="seconds"),
+)
+
+
+def build(registry: Optional[Registry] = None) -> Dict[str, object]:
+    """Instantiate (get-or-create) every catalog metric in ``registry``
+    (default: the process-wide one); returns name → family."""
+    registry = registry or REGISTRY
+    out: Dict[str, object] = {}
+    for spec in CATALOG:
+        if spec.type == "counter":
+            out[spec.name] = registry.counter(spec.name, spec.help, spec.labels)
+        elif spec.type == "gauge":
+            out[spec.name] = registry.gauge(spec.name, spec.help, spec.labels)
+        elif spec.type == "histogram":
+            out[spec.name] = registry.histogram(
+                spec.name, spec.help, spec.labels, buckets=spec.buckets)
+        else:
+            raise ValueError(f"{spec.name}: unknown metric type {spec.type}")
+    return out

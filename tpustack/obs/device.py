@@ -1,0 +1,117 @@
+"""Device-level gauges: HBM occupancy and XLA compile-cache visibility.
+
+These are scrape-time collectors (``Registry.add_collector``): the truth
+lives in the JAX runtime and on disk, so it is read when Prometheus asks,
+not on a background thread.  Everything here degrades to no-op — jax
+absent, a backend whose ``memory_stats()`` returns nothing (CPU), an
+unreadable cache dir — because observability must never take a serving pod
+down.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import weakref
+from typing import Optional
+
+from tpustack.obs import catalog
+from tpustack.obs.metrics import REGISTRY, Registry
+
+# WeakSet, not id()s: a recycled id from a collected test registry must not
+# make a fresh registry skip installation
+_installed: "weakref.WeakSet[Registry]" = weakref.WeakSet()
+
+
+def install(registry: Optional[Registry] = None) -> None:
+    """Idempotently wire the device/runtime collectors into ``registry``.
+
+    Servers call this once at startup; calling again (tests, multiple
+    servers in one process) is a no-op for the same registry.
+    """
+    registry = registry or REGISTRY
+    if registry in _installed:
+        return
+    _installed.add(registry)
+    m = catalog.build(registry)
+    m["tpustack_process_start_time_seconds"].set(time.time())
+    _install_cache_hit_listener(m["tpustack_compile_cache_hits_total"])
+    registry.add_collector(_collect_device_memory)
+    registry.add_collector(_collect_compile_cache)
+
+
+def _collect_device_memory(registry: Registry) -> None:
+    """HBM bytes in use / limit per device.  TPU backends report both keys;
+    CPU returns None/{} and the families stay sample-less (HELP/TYPE only
+    in the exposition — still a valid, discoverable metric)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:
+        return
+    m = catalog.build(registry)  # get-or-create: returns existing families
+    used = m["tpustack_device_hbm_used_bytes"]
+    limit = m["tpustack_device_hbm_limit_bytes"]
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        name = f"{dev.platform}:{dev.id}"
+        if "bytes_in_use" in stats:
+            used.labels(device=name).set(stats["bytes_in_use"])
+        if "bytes_limit" in stats:
+            limit.labels(device=name).set(stats["bytes_limit"])
+
+
+def _cache_dir() -> Optional[str]:
+    env = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if env:
+        return env
+    try:
+        import jax
+
+        return jax.config.jax_compilation_cache_dir
+    except Exception:
+        return None
+
+
+def _collect_compile_cache(registry: Registry) -> None:
+    """Entry count + bytes of the persistent XLA compile cache — a restart
+    that re-pays multi-minute compiles shows up as this dropping to 0."""
+    d = _cache_dir()
+    if not d or not os.path.isdir(d):
+        return
+    entries = size = 0
+    try:
+        with os.scandir(d) as it:
+            for e in it:
+                if e.is_file():
+                    entries += 1
+                    size += e.stat().st_size
+    except OSError:
+        return
+    m = catalog.build(registry)
+    m["tpustack_compile_cache_entries"].set(entries)
+    m["tpustack_compile_cache_bytes"].set(size)
+
+
+def _install_cache_hit_listener(counter) -> None:
+    """Count persistent-compilation-cache hits via jax's monitoring events.
+
+    The event name is jax-internal but stable across the versions this repo
+    has seen; if the hook or the name is gone the counter just stays 0 —
+    documented behavior, not an error."""
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event: str, **kw) -> None:
+            if "persistent_cache_hit" in event or "cache_hits" in event:
+                counter.inc()
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        pass

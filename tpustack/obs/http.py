@@ -1,0 +1,160 @@
+"""HTTP plumbing for the metrics subsystem.
+
+Three pieces:
+
+- ``make_metrics_handler(registry)`` — an aiohttp handler serving the
+  Prometheus text exposition on ``GET /metrics`` (the three serving apps
+  mount it).
+- ``instrument(server_name, registry)`` — an aiohttp middleware that stamps
+  every request with a request-id (honouring an inbound ``X-Request-Id``),
+  binds it to the logging contextvar, counts the request into
+  ``tpustack_http_requests_total`` and observes its end-to-end latency.
+- ``start_metrics_sidecar(port, registry)`` — a stdlib ``http.server`` on a
+  daemon thread, for processes that are NOT aiohttp apps (batch Jobs,
+  trainers): set ``TPUSTACK_METRICS_PORT`` and the same registry becomes
+  scrapeable without pulling a web framework into a batch workload.
+
+The endpoint label uses the matched ROUTE template (``/history/{prompt_id}``
+not ``/history/abc123``) so label cardinality stays bounded under real
+traffic; unmatched paths all collapse into ``__unmatched__``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from tpustack.obs import catalog
+from tpustack.obs.metrics import CONTENT_TYPE, REGISTRY, Registry
+from tpustack.obs.trace import bind_request_id
+
+
+def render(registry: Optional[Registry] = None) -> str:
+    return (registry or REGISTRY).render()
+
+
+def make_metrics_handler(registry: Optional[Registry] = None):
+    from aiohttp import web
+
+    reg = registry or REGISTRY
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(text=reg.render(),
+                            headers={"Content-Type": CONTENT_TYPE})
+
+    return metrics
+
+
+def _endpoint_label(request) -> str:
+    info = request.match_info
+    route = getattr(info, "route", None)
+    resource = getattr(route, "resource", None)
+    canonical = getattr(resource, "canonical", None)
+    return canonical or "__unmatched__"
+
+
+def instrument(server_name: str, registry: Optional[Registry] = None):
+    """aiohttp middleware: request-id + request counter + latency histogram.
+
+    Latency covers the handler including streaming bodies (SSE completions
+    count their full stream duration — that IS the request latency a client
+    sees).  Exceptions count as their mapped status (HTTPException) or 500.
+    """
+    from aiohttp import web
+
+    m = catalog.build(registry)
+    requests_total = m["tpustack_http_requests_total"]
+    latency = m["tpustack_http_request_latency_seconds"]
+    in_flight = m["tpustack_http_in_flight_requests"]
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        rid = bind_request_id(request.headers.get("X-Request-Id"))
+        request["request_id"] = rid
+        endpoint = _endpoint_label(request)
+        in_flight.labels(server=server_name).inc()
+        t0 = time.perf_counter()
+        status = 500
+        try:
+            resp = await handler(request)
+            status = resp.status
+            # a StreamResponse already prepared (SSE) has flushed its
+            # headers — the handler must stamp the rid itself pre-prepare
+            # (request["request_id"]); mutating here would be a no-op
+            if not getattr(resp, "prepared", False):
+                resp.headers.setdefault("X-Request-Id", rid)
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            e.headers.setdefault("X-Request-Id", rid)
+            raise
+        finally:
+            in_flight.labels(server=server_name).dec()
+            requests_total.labels(server=server_name, endpoint=endpoint,
+                                  status=str(status)).inc()
+            latency.labels(server=server_name, endpoint=endpoint).observe(
+                time.perf_counter() - t0)
+
+    return middleware
+
+
+def start_metrics_sidecar(port: int,
+                          registry: Optional[Registry] = None,
+                          host: str = "0.0.0.0"):
+    """Serve ``GET /metrics`` (and ``/healthz``) from a daemon thread using
+    only the stdlib — batch Jobs and trainers stay aiohttp-free.  Returns
+    the ``HTTPServer`` (callers may ``.shutdown()`` it; Jobs just exit)."""
+    import http.server
+
+    reg = registry or REGISTRY
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib contract
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                body = reg.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+            elif path == "/healthz":
+                body = b'{"ok": true}\n'
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+            else:
+                body = b"not found\n"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # scrapes must not spam stdout
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name=f"tpustack-metrics-:{port}")
+    thread.start()
+    return server
+
+
+def maybe_start_metrics_sidecar(registry: Optional[Registry] = None):
+    """Honour ``TPUSTACK_METRICS_PORT``: batch-job manifests set it (plus
+    matching scrape annotations) to make non-server workloads scrapeable.
+    Unset/0 → None.  Bind failure logs and returns None — a metrics port
+    collision must never kill a training job."""
+    import os
+
+    from tpustack.utils import get_logger
+
+    port = int(os.environ.get("TPUSTACK_METRICS_PORT", "0") or 0)
+    if not port:
+        return None
+    try:
+        server = start_metrics_sidecar(port, registry)
+    except OSError as e:
+        get_logger("obs.http").warning("metrics sidecar on :%d failed: %s",
+                                       port, e)
+        return None
+    get_logger("obs.http").info("metrics sidecar serving on :%d", port)
+    return server

@@ -1,0 +1,89 @@
+"""Request tracing: request-ids on every log line + per-phase span timings.
+
+Not distributed tracing — one process, one chip.  What the stack needs is
+(a) a request-id that stitches together the log lines and metrics of one
+HTTP request across the event loop and the executor threads that do the
+device work, and (b) wall-clock spans for the phases the ISSUE of record
+cares about (LLM: queue-wait / prefill / decode / detokenize; SD:
+queue-wait / batch-build / fused denoise+VAE / PNG encode; graph: per-node
+execute), feeding the ``tpustack_request_phase_latency_seconds`` histogram.
+
+The current request-id rides a ``contextvars.ContextVar`` so the logging
+formatter (``tpustack.utils.logging``) can stamp it on every line emitted
+under the request's context without any call-site changes.  Executor
+threads spawned via ``loop.run_in_executor`` do NOT inherit the context —
+long-lived engine threads serve many requests at once, so their lines
+correctly carry the neutral ``-``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+#: the rid of the HTTP request being handled in this context ("-" outside)
+current_request_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "tpustack_request_id", default="-")
+
+
+def new_request_id() -> str:
+    """12-hex request id — short enough for log lines, unique enough for a
+    single pod's lifetime (the scope a request-id has to be unique in)."""
+    return uuid.uuid4().hex[:12]
+
+
+def bind_request_id(rid: Optional[str] = None) -> str:
+    """Set the context's request-id (generating one if not given); returns
+    it.  Call once per request at ingress — the aiohttp middleware does."""
+    rid = rid or new_request_id()
+    current_request_id.set(rid)
+    return rid
+
+
+class Trace:
+    """Phase spans for one request: ``with t.span("prefill"): ...``.
+
+    Spans are flat (phases, not a tree) and recorded as (name, seconds).
+    ``observe_into(histogram, **labels)`` flushes them into a labelled
+    histogram family — the labels identify the server, the span name
+    becomes the ``phase`` label.  ``add(name, seconds)`` records a phase
+    measured elsewhere (e.g. engine-reported prefill_s) without re-timing.
+    """
+
+    __slots__ = ("request_id", "spans", "started_at")
+
+    def __init__(self, request_id: Optional[str] = None):
+        self.request_id = request_id or current_request_id.get()
+        if self.request_id == "-":
+            self.request_id = new_request_id()
+        self.spans: List[Tuple[str, float]] = []
+        self.started_at = time.perf_counter()
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            self.spans.append((name, time.perf_counter() - t0))
+
+    def add(self, name: str, seconds: float) -> None:
+        self.spans.append((name, max(0.0, float(seconds))))
+
+    @property
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.started_at
+
+    def durations(self) -> Dict[str, float]:
+        """Phase → summed seconds (a phase may be entered repeatedly)."""
+        out: Dict[str, float] = {}
+        for name, dur in self.spans:
+            out[name] = out.get(name, 0.0) + dur
+        return out
+
+    def observe_into(self, histogram, **labels) -> None:
+        for name, dur in self.spans:
+            histogram.labels(**labels, phase=name).observe(dur)

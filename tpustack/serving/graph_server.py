@@ -45,6 +45,10 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 from aiohttp import web
 
+from tpustack.obs import Trace
+from tpustack.obs import catalog as obs_catalog
+from tpustack.obs import device as obs_device
+from tpustack.obs import http as obs_http
 from tpustack.utils import get_logger
 from tpustack.utils.image import array_to_png
 
@@ -274,8 +278,9 @@ class GraphExecutor:
     """Topologically executes a ComfyUI-style ``{id: {class_type, inputs}}``
     graph.  Node functions are methods ``node_<ClassType>``."""
 
-    def __init__(self, runtime: WanRuntime):
+    def __init__(self, runtime: WanRuntime, registry=None):
         self.rt = runtime
+        self.metrics = obs_catalog.build(registry)
         self._counter_lock = threading.Lock()
         self._counter = self._scan_counter()
 
@@ -419,6 +424,7 @@ class GraphExecutor:
                     raise
                 log.warning("hookless batched dispatch of %d failed (%s); "
                             "serving rows serially", len(chunk), e)
+                self.metrics["tpustack_graph_batch_fallback_total"].inc()
                 for r in chunk:
                     out.extend(dispatch([r]))
         log.info("Dispatched %d row(s) in %d chunk(s) in %.2fs (async; "
@@ -581,7 +587,14 @@ class GraphExecutor:
                 else:
                     inputs[key] = val
             fn = getattr(self, f"node_{node['class_type']}")
+            t0 = time.perf_counter()
             out = fn(inputs, ctx)
+            # per-node execute span; note under the worker's sample hook
+            # VAEDecode is plan-only here — its device time shows up as the
+            # dispatch/finalize phases, not in this histogram
+            self.metrics["tpustack_graph_node_latency_seconds"].labels(
+                node_class=node["class_type"]).observe(
+                time.perf_counter() - t0)
             results[nid] = out
             if out and isinstance(out[0], list) and out[0] and isinstance(out[0][0], OutputFile):
                 by_kind: Dict[str, List[Dict]] = {}
@@ -627,9 +640,12 @@ class GraphServer:
     fetch + encode overlaps k+1's sampling (the same one-in-flight pattern
     as the SD15 micro-batcher; +~15% back-to-back video throughput)."""
 
-    def __init__(self, runtime: Optional[WanRuntime] = None):
+    def __init__(self, runtime: Optional[WanRuntime] = None, registry=None):
         self.rt = runtime or WanRuntime()
-        self.executor = GraphExecutor(self.rt)
+        self._registry = registry
+        self.metrics = obs_catalog.build(registry)
+        obs_device.install(registry)
+        self.executor = GraphExecutor(self.rt, registry=registry)
         self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
         self._pending: Dict[str, Dict] = {}
         self._history: Dict[str, HistoryEntry] = {}
@@ -679,6 +695,7 @@ class GraphServer:
                     stop = True
                     break
                 pids.append(nxt)
+            self.metrics["tpustack_graph_queue_depth"].set(self._queue.qsize())
 
             # plan every graph (cheap — device work deferred to the hook)
             plans = []  # (pid, entry, outputs, finish, specs)
@@ -701,6 +718,8 @@ class GraphServer:
                                                             sample_hook=hook)
                 except Exception as e:  # noqa: BLE001 — via /history
                     log.exception("prompt %s failed", pid)
+                    self.metrics["tpustack_graph_prompts_total"].labels(
+                        status="error").inc()
                     with self._lock:
                         entry.status_str = "error"
                         entry.messages.append(f"{type(e).__name__}: {e}")
@@ -768,6 +787,7 @@ class GraphServer:
     def _dispatch_one(self, key, members) -> None:
         width, height, frames_n, steps, cfg, sampler = key
         pipe = self.rt.pipeline()
+        t0 = time.perf_counter()
         try:
             if len(members) == 1:
                 spec = members[0][0]
@@ -796,6 +816,7 @@ class GraphServer:
                 log.warning("batched dispatch of %d failed (%s); falling "
                             "back to serial for this signature",
                             len(members), e)
+                self.metrics["tpustack_graph_batch_fallback_total"].inc()
                 self._no_batch.add(key)
                 for m in members:
                     self._dispatch_one(key, [m])
@@ -821,17 +842,32 @@ class GraphServer:
             return
         for i, (_, fr) in enumerate(members):
             fr.array = vid[i]
+        # host-side dispatch span (async: device compute continues after it;
+        # the device wall time lands in the finalize span's fetch)
+        tr = Trace()
+        tr.add("dispatch", time.perf_counter() - t0)
+        tr.observe_into(self.metrics["tpustack_request_phase_latency_seconds"],
+                        server="graph")
 
     def _finalize(self, pid, entry, outputs, finish):
         """Run deferred saves (fetch + encode + write) and publish."""
+        tr = Trace()
         try:
-            finish()
+            with tr.span("finalize"):
+                finish()
+            tr.observe_into(
+                self.metrics["tpustack_request_phase_latency_seconds"],
+                server="graph")
             with self._lock:  # status_str before completed: pollers treat
                 entry.outputs = outputs       # completed+non-success as failure
                 entry.status_str = "success"
                 entry.completed = True
+            self.metrics["tpustack_graph_prompts_total"].labels(
+                status="success").inc()
         except Exception as e:  # noqa: BLE001 — surfaced via /history
             log.exception("prompt %s failed", pid)
+            self.metrics["tpustack_graph_prompts_total"].labels(
+                status="error").inc()
             with self._lock:
                 entry.status_str = "error"
                 entry.messages.append(f"{type(e).__name__}: {e}")
@@ -862,14 +898,18 @@ class GraphServer:
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid JSON"}, status=400)
         graph = body.get("prompt")
+        rejected = self.metrics["tpustack_graph_prompts_total"]
         if not isinstance(graph, dict) or not graph:
+            rejected.labels(status="rejected").inc()
             return web.json_response({"error": "missing prompt graph"}, status=400)
         for nid, node in graph.items():
             if not isinstance(node, dict):
+                rejected.labels(status="rejected").inc()
                 return web.json_response(
                     {"error": f"node {nid} must be an object"}, status=400)
             ct = node.get("class_type")
             if not hasattr(self.executor, f"node_{ct}"):
+                rejected.labels(status="rejected").inc()
                 return web.json_response(
                     {"error": f"unknown node class_type {ct!r} (node {nid})"},
                     status=400)
@@ -880,6 +920,7 @@ class GraphServer:
             self._history[pid] = entry
             self._pending[pid] = graph
         self._queue.put(pid)
+        self.metrics["tpustack_graph_queue_depth"].set(self._queue.qsize())
         return web.json_response({"prompt_id": pid, "number": len(self._history)})
 
     async def history(self, request: web.Request) -> web.Response:
@@ -904,9 +945,13 @@ class GraphServer:
         return web.json_response({"ok": True})
 
     def build_app(self) -> web.Application:
-        app = web.Application(client_max_size=4 << 20)
+        app = web.Application(
+            client_max_size=4 << 20,
+            middlewares=[obs_http.instrument("graph", self._registry)])
         app.router.add_get("/queue", self.queue_state)
         app.router.add_get("/object_info", self.object_info)
+        app.router.add_get("/metrics",
+                           obs_http.make_metrics_handler(self._registry))
         app.router.add_post("/prompt", self.submit)
         app.router.add_get("/history/{prompt_id}", self.history)
         app.router.add_get("/view", self.view)

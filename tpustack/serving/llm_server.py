@@ -48,6 +48,9 @@ from typing import Optional
 
 from aiohttp import web
 
+from tpustack.obs import catalog as obs_catalog
+from tpustack.obs import device as obs_device
+from tpustack.obs import http as obs_http
 from tpustack.utils import get_logger
 
 log = get_logger("serving.llm_server")
@@ -174,7 +177,13 @@ class LLMServer:
 
     def __init__(self, generator=None, tokenizer=None, model_name: str = "tpustack",
                  max_batch: Optional[int] = None,
-                 batch_window_ms: Optional[float] = None):
+                 batch_window_ms: Optional[float] = None,
+                 registry=None):
+        # metrics registry: tests pass a fresh Registry for isolation; the
+        # default is the process-wide one /metrics exposes
+        self._registry = registry
+        self.metrics = obs_catalog.build(registry)
+        obs_device.install(registry)
         if generator is None:
             generator, tokenizer, model_name = _build_generator()
         self.gen = generator
@@ -268,6 +277,7 @@ class LLMServer:
         # deque append is atomic — the engine thread polls this queue
         # directly at chunk boundaries (continuous admission), no window
         self._queue.append(req)
+        self.metrics["tpustack_llm_queue_depth"].set(len(self._queue))
         self._wake.set()
 
     async def _enqueue_completion(self, ids, n_predict, sample, seed=None):
@@ -295,7 +305,10 @@ class LLMServer:
                     r.stream_put(t)
 
         def on_done(tokens, row_stats):
+            self.metrics["tpustack_llm_running_requests"].dec()
             if tokens is None:  # admission-time validation failure
+                self.metrics["tpustack_llm_requests_rejected_total"].labels(
+                    reason="admission").inc()
                 exc = ValueError(row_stats.get("error", "bad request"))
                 loop.call_soon_threadsafe(
                     lambda: r.future.done() or r.future.set_exception(exc))
@@ -341,9 +354,12 @@ class LLMServer:
                         return None
                     while self._queue:
                         r = self._queue.popleft()
+                        self.metrics["tpustack_llm_queue_depth"].set(
+                            len(self._queue))
                         if r.cancel.is_set():
                             continue  # waiter already cancelled its future
                         handed.append(r)
+                        self.metrics["tpustack_llm_running_requests"].inc()
                         return self._slot_request(r, loop)
                     return None
 
@@ -369,11 +385,17 @@ class LLMServer:
                 fail(e)
                 continue
             finally:
+                # the run is over, nothing is decoding — self-heal the gauge
+                # even when the engine died mid-run (on_done never fired for
+                # some handed rows)
+                self.metrics["tpustack_llm_running_requests"].set(0)
                 if self._queue:
                     # engine yielded with work left (solo preemption):
                     # re-enter after the lock's FIFO queue services it
                     self._wake.set()
             if stats["requests"]:
+                self.metrics["tpustack_llm_batch_occupancy_slots"].observe(
+                    stats["requests"])
                 log.info("continuous run: %d requests, %d gen tok, "
                          "%.1f tok/s aggregate", stats["requests"],
                          stats["generated_tokens"], stats["tokens_per_s"])
@@ -386,16 +408,21 @@ class LLMServer:
 
         ids = self.tok.encode(prompt)
         if not ids:  # reject here, not inside a batch where peers would 400
+            self.metrics["tpustack_llm_requests_rejected_total"].labels(
+                reason="empty_prompt").inc()
             raise ValueError("empty prompt")
+        t_start = time.perf_counter()
         if not self._batchable():
             cancel = threading.Event()
             self._solo_waiting += 1  # engine yields the lock at its next
             try:                     # chunk boundary (FIFO-fair handover)
-                return await self._run_on_device(
+                content, stats, stopped_eos = await self._run_on_device(
                     lambda: self._complete(ids, n_predict, temperature, top_k,
                                            seed, False, cancel), cancel)
             finally:
                 self._solo_waiting -= 1
+            self._observe_done(len(ids), stats, time.perf_counter() - t_start)
+            return content, stats, stopped_eos
         sample = SampleConfig(temperature=temperature, top_k=top_k,
                               greedy=temperature <= 0)
         out_ids, stats = await self._enqueue_completion(ids, n_predict, sample,
@@ -408,9 +435,37 @@ class LLMServer:
         # the continuous engine reports true PER-ROW stats (each row has its
         # own admit→retire wall time and token counts) — no shared-batch
         # reconstruction needed
-        return self.tok.decode(out_ids), dict(stats), stopped_eos
+        stats = dict(stats)
+        t_detok = time.perf_counter()
+        content = self.tok.decode(out_ids)
+        stats["detokenize_s"] = time.perf_counter() - t_detok
+        self._observe_done(len(ids), stats, time.perf_counter() - t_start)
+        return content, stats, stopped_eos
 
     # ------------------------------------------------------------ helpers
+    def _observe_done(self, n_prompt: int, stats: dict, total_s: float) -> None:
+        """Fold one finished completion into the metric families: token
+        counters, prompt-length histogram, and the phase breakdown
+        (queue_wait is the wall time the device phases don't account for —
+        admission queueing, lock waits, event-loop overhead)."""
+        from tpustack.obs import Trace
+
+        m = self.metrics
+        m["tpustack_llm_prompt_tokens_total"].inc(stats.get("prompt_tokens", 0))
+        m["tpustack_llm_generated_tokens_total"].inc(
+            stats.get("generated_tokens", 0))
+        m["tpustack_llm_prompt_length_tokens"].observe(n_prompt)
+        prefill = stats.get("prefill_s", 0.0)
+        decode = stats.get("decode_s", 0.0)
+        detok = stats.get("detokenize_s", 0.0)
+        tr = Trace()
+        tr.add("queue_wait", max(0.0, total_s - prefill - decode - detok))
+        tr.add("prefill", prefill)
+        tr.add("decode", decode)
+        tr.add("detokenize", detok)
+        tr.observe_into(m["tpustack_request_phase_latency_seconds"],
+                        server="llm")
+
     def _final_payload(self, stats, stopped_eos: bool, content: str) -> dict:
         """llama.cpp-shaped result body, shared by the non-streamed response
         and the terminal SSE event so the two can never drift apart."""
@@ -453,7 +508,11 @@ class LLMServer:
             stopped_eos = True
         else:
             stopped_eos = False
-        return self.tok.decode(out_ids), stats, stopped_eos
+        t_detok = time.perf_counter()
+        content = self.tok.decode(out_ids)
+        stats = dict(stats)
+        stats["detokenize_s"] = time.perf_counter() - t_detok
+        return content, stats, stopped_eos
 
     async def _stream(self, request: web.Request, prompt: str, n_predict: int,
                       temperature: float, top_k: int, seed, fmt: str):
@@ -477,6 +536,9 @@ class LLMServer:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
+            # the obs middleware's post-handler setdefault is too late for a
+            # prepared StreamResponse — stamp the rid before headers flush
+            "X-Request-Id": request.get("request_id", "-"),
         })
         await resp.prepare(request)
 
@@ -627,6 +689,7 @@ class LLMServer:
             else:
                 await send({"content": tail, "stop": False})
 
+        self._observe_done(len(ids), stats, time.time() - t0)
         stopped_eos = bool(out_ids) and out_ids[-1] == self.tok.eos_id
         if fmt == "openai":
             await send(chat_chunk({}, finish="stop" if stopped_eos else "length"))
@@ -650,19 +713,26 @@ class LLMServer:
             "backend": "jax/tpu",
         })
 
+    def _reject(self, reason: str) -> None:
+        self.metrics["tpustack_llm_requests_rejected_total"].labels(
+            reason=reason).inc()
+
     async def completion(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
         except json.JSONDecodeError:
+            self._reject("invalid_json")
             return web.json_response({"error": "invalid json"}, status=400)
         prompt = body.get("prompt", "")
         if not isinstance(prompt, str) or not prompt:
+            self._reject("empty_prompt")
             return web.json_response({"error": "prompt is required"}, status=400)
         try:  # explicit None checks — 0 is a meaningful value (greedy temp)
             n_predict = int(_or_default(body.get("n_predict"), 128))
             temperature = float(_or_default(body.get("temperature"), 0.8))
             top_k = int(_or_default(body.get("top_k"), 40))
         except (TypeError, ValueError) as e:
+            self._reject("bad_parameter")
             return web.json_response({"error": f"invalid parameter: {e}"}, status=400)
         if n_predict < 0:  # llama.cpp: -1 means "until EOS / context limit"
             n_predict = self.gen.cfg.max_seq
@@ -736,9 +806,12 @@ class LLMServer:
         })
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(
+            middlewares=[obs_http.instrument("llm", self._registry)])
         app.router.add_get("/health", self.health)
         app.router.add_get("/props", self.props)
+        app.router.add_get("/metrics",
+                           obs_http.make_metrics_handler(self._registry))
         app.router.add_post("/completion", self.completion)
         app.router.add_post("/tokenize", self.tokenize)
         app.router.add_post("/detokenize", self.detokenize)

@@ -45,6 +45,9 @@ import numpy as np
 from aiohttp import web
 from pydantic import BaseModel, ValidationError
 
+from tpustack.obs import catalog as obs_catalog
+from tpustack.obs import device as obs_device
+from tpustack.obs import http as obs_http
 from tpustack.utils import get_logger
 from tpustack.utils.image import array_to_png
 
@@ -70,11 +73,15 @@ class _PendingReq:
     negative: str
     seed: Optional[int]
     future: asyncio.Future
+    t_enqueue: float = 0.0  # perf_counter at admission → queue_wait phase
 
 
 class SDServer:
     def __init__(self, pipeline=None, mesh=None, batch_window_ms: float = None,
-                 max_batch: int = None):
+                 max_batch: int = None, registry=None):
+        self._registry = registry
+        self.metrics = obs_catalog.build(registry)
+        obs_device.install(registry)
         if pipeline is None:
             pipeline = self._pipeline_from_env()
         self.pipe = pipeline
@@ -209,10 +216,19 @@ class SDServer:
             img = await self._enqueue(
                 key=(steps, float(guidance), width, height),
                 req=_PendingReq(req.prompt, req.negative_prompt or "",
-                                req.seed, asyncio.get_running_loop().create_future()))
+                                req.seed,
+                                asyncio.get_running_loop().create_future(),
+                                t_enqueue=time.perf_counter()))
         except ValueError as e:  # e.g. size not a multiple of the UNet factor
             return web.json_response({"detail": str(e)}, status=400)
-        png = array_to_png(img)
+        from tpustack.obs import Trace
+
+        tr = Trace(request_id=request.get("request_id"))
+        with tr.span("png_encode"):
+            png = array_to_png(img)
+        tr.observe_into(self.metrics["tpustack_request_phase_latency_seconds"],
+                        server="sd")
+        self.metrics["tpustack_sd_images_total"].inc()
         latency = time.time() - t0
         log.info("Completed generation in %.2fs", latency)
         self._last_image = png
@@ -235,6 +251,7 @@ class SDServer:
             self._pending[key] = (self._group_seq, [])
         gid, group = self._pending[key]
         group.append(req)
+        self._set_queue_depth()
         if len(group) == self.max_batch:  # == not >=: one flusher per group
             asyncio.ensure_future(self._flush(key, gid, wait=False))
         elif len(group) == 1:
@@ -256,10 +273,15 @@ class SDServer:
                 asyncio.ensure_future(self._flush(key, self._group_seq, wait=False))
             else:
                 self._pending.pop(key, None)
+            self._set_queue_depth()
         # OUTSIDE the bookkeeping lock: batches pipeline — while batch k's
         # images stream device→host, batch k+1's program is already queued
         # on the chip (generate_async dispatches without blocking)
         await self._run_batch(key, batch)
+
+    def _set_queue_depth(self) -> None:
+        self.metrics["tpustack_sd_queue_depth"].set(
+            sum(len(g) for _, g in self._pending.values()))
 
     def _padded_size(self, n: int) -> int:
         """Canonical batch size: next power of two (so at most log2(max_batch)
@@ -277,7 +299,11 @@ class SDServer:
         return min(size, self.max_batch)
 
     async def _run_batch(self, key: tuple, batch: list) -> None:
+        from tpustack.obs import Trace
+
         steps, guidance, width, height = key
+        tr = Trace()  # phase spans for this fused dispatch
+        t_build = time.perf_counter()
         prompts = [r.prompt for r in batch]
         negs = [r.negative for r in batch]
         seeds = [r.seed for r in batch]
@@ -286,6 +312,12 @@ class SDServer:
         prompts += prompts[-1:] * pad  # pad to a canonical compiled signature
         negs += negs[-1:] * pad
         seeds += [0] * pad
+        self.metrics["tpustack_sd_batch_size_images"].observe(len(batch))
+        if pad:
+            self.metrics["tpustack_sd_padded_slots_total"].inc(pad)
+        for r in batch:  # admission → dispatch: the window + lock wait
+            if r.t_enqueue:
+                tr.add("queue_wait", time.perf_counter() - r.t_enqueue)
         if len(batch) > 1 or pad:
             log.info("Micro-batch: %d requests (+%d pad) in one program (dp=%s)",
                      len(batch), pad, self._mesh_data_size() or 1)
@@ -302,9 +334,15 @@ class SDServer:
                         seed=seeds, width=width, height=height,
                         negative_prompt=negs, mesh=mesh))
                 self._inflight.append(dev_imgs)
+            # batch_build: list assembly + the host-side trace/dispatch of
+            # the fused program (returns before the device finishes)
+            tr.add("batch_build", time.perf_counter() - t_build)
             try:
-                imgs = await loop.run_in_executor(None,
-                                                  lambda: np.asarray(dev_imgs))
+                # device wall time: the CFG denoise loop AND the VAE decode
+                # are ONE fused XLA program here, so they are one phase
+                with tr.span("denoise_vae"):
+                    imgs = await loop.run_in_executor(
+                        None, lambda: np.asarray(dev_imgs))
             finally:
                 # remove by identity: list.remove uses ==, which on jax.Array
                 # raises "truth value is ambiguous" whenever two batches
@@ -316,6 +354,10 @@ class SDServer:
                 if not r.future.done():
                     r.future.set_exception(e)
             return
+        # flush the phase spans only for batches that served images — a
+        # failed dispatch must not skew the latency histograms
+        tr.observe_into(self.metrics["tpustack_request_phase_latency_seconds"],
+                        server="sd")
         for i, r in enumerate(batch):
             if not r.future.done():
                 r.future.set_result(imgs[i])
@@ -382,10 +424,14 @@ class SDServer:
 
     # ---------------------------------------------------------------- app
     def build_app(self) -> web.Application:
-        app = web.Application(client_max_size=1 << 20)
+        app = web.Application(
+            client_max_size=1 << 20,
+            middlewares=[obs_http.instrument("sd", self._registry)])
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/", self.index)
         app.router.add_get("/last", self.last)
+        app.router.add_get("/metrics",
+                           obs_http.make_metrics_handler(self._registry))
         app.router.add_post("/generate", self.generate)
         app.router.add_post("/profile", self.profile)
         return app

@@ -396,6 +396,15 @@ def main(argv=None) -> int:
                         "snapshot servable via MODEL_DIR")
     args = p.parse_args(argv)
 
+    # TPUSTACK_METRICS_PORT (the train-job manifests set 9100): stdlib
+    # /metrics sidecar thread so Prometheus sees trainer device gauges —
+    # jobs are not aiohttp apps, so this is their only exposition path
+    from tpustack.obs import device as obs_device
+    from tpustack.obs.http import maybe_start_metrics_sidecar
+
+    obs_device.install()
+    maybe_start_metrics_sidecar()
+
     if args.task == "resnet50":
         run_resnet50(args)
     elif args.task == "sd15":

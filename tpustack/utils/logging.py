@@ -1,36 +1,94 @@
-"""Structured-ish logging matching the reference app's posture.
+"""Structured logging for the serving stack.
 
 The reference sd15-api logs INFO lines with prompt/params/latency
 (``cluster-config/apps/sd15-api/configmap.yaml:33-35,94-102,116``) and relies
-on ``kubectl logs`` as the observability interface.  We keep that: stdlib
-logging to stdout, one shared formatter, no external sinks.
+on ``kubectl logs`` as the observability interface.  We keep stdout as the
+sink (no external log shippers), but grow the posture two ways:
+
+- every line carries the current request-id (``rid=<12 hex>``, ``-`` outside
+  a request context), bound by the servers' obs middleware via a contextvar
+  — one request's lines grep together across handlers;
+- ``TPUSTACK_LOG_FORMAT=json`` switches to one-JSON-object-per-line
+  (``ts``/``level``/``logger``/``request_id``/``message``, plus ``exc`` for
+  tracebacks) for log pipelines that want structure; the default stays the
+  human text format so ``kubectl logs`` remains readable.
+
+``TPUSTACK_LOG_LEVEL`` picks the level (default INFO), as before.
 """
 
 from __future__ import annotations
 
+import datetime
+import json
 import logging
 import os
 import sys
 
-_FORMAT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+_TEXT_FORMAT = "%(asctime)s %(levelname)s [%(name)s] [rid=%(request_id)s] %(message)s"
 _configured = False
 
 
-def _configure_root() -> None:
-    global _configured
-    if _configured:
-        return
+class _RequestIdFilter(logging.Filter):
+    """Stamp ``record.request_id`` from the obs contextvar ("-" outside a
+    request) so both formatters can reference it unconditionally."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "request_id"):
+            try:
+                from tpustack.obs.trace import current_request_id
+
+                record.request_id = current_request_id.get()
+            except Exception:
+                record.request_id = "-"
+        return True
+
+
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line: timestamp, level, logger, request_id,
+    message (+ exc when a traceback rides along)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": datetime.datetime.fromtimestamp(
+                record.created, tz=datetime.timezone.utc).isoformat(),
+            "level": record.levelname,
+            "logger": record.name,
+            "request_id": getattr(record, "request_id", "-"),
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, ensure_ascii=False)
+
+
+def _build_handler() -> logging.Handler:
     handler = logging.StreamHandler(sys.stdout)
-    handler.setFormatter(logging.Formatter(_FORMAT))
+    if os.environ.get("TPUSTACK_LOG_FORMAT", "text").lower() == "json":
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(_TEXT_FORMAT))
+    handler.addFilter(_RequestIdFilter())
+    return handler
+
+
+def configure_logging(force: bool = False) -> None:
+    """Configure the ``tpustack`` root logger from the environment.  Runs
+    once lazily via ``get_logger``; ``force=True`` re-reads the env vars
+    and swaps the handler (tests toggling TPUSTACK_LOG_FORMAT)."""
+    global _configured
+    if _configured and not force:
+        return
     root = logging.getLogger("tpustack")
-    root.addHandler(handler)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.addHandler(_build_handler())
     root.setLevel(os.environ.get("TPUSTACK_LOG_LEVEL", "INFO").upper())
     root.propagate = False
     _configured = True
 
 
 def get_logger(name: str) -> logging.Logger:
-    _configure_root()
+    configure_logging()
     if name == "tpustack" or name.startswith("tpustack."):
         return logging.getLogger(name)
     return logging.getLogger(f"tpustack.{name}")
