@@ -380,3 +380,45 @@ def test_flux_monitoring_kustomization_wired():
     assert mon["path"] == "./cluster-config/apps/monitoring"
     deps = [x["name"] for x in mon.get("dependsOn", [])]
     assert {"sd15-api", "llm"} <= set(deps)
+
+
+def test_persistent_compile_cache_wired_into_serving_pods():
+    """Every TPU serving Deployment (llm, wan, sd15) must set
+    TPUSTACK_COMPILE_CACHE (the stack's persistent-XLA-cache env contract,
+    read by ``tpustack.utils.enable_compile_cache``) to a path under a
+    mounted volume, so pod restarts reuse compiled programs instead of
+    paying the multi-minute cold jit again."""
+    serving = [CLUSTER / "apps" / "llm" / "deployment.yaml",
+               CLUSTER / "apps" / "llm" / "wan-deployment.yaml",
+               CLUSTER / "apps" / "sd15-api" / "deployment.yaml"]
+    for p in serving:
+        deps = [d for d in _load_all(p) if d.get("kind") == "Deployment"]
+        assert deps, f"{p}: no Deployment doc"
+        for d in deps:
+            containers = d["spec"]["template"]["spec"]["containers"]
+            server = containers[0]
+            env = {e["name"]: e.get("value") for e in server.get("env", [])}
+            cache = env.get("TPUSTACK_COMPILE_CACHE")
+            assert cache, f"{p}: server container missing TPUSTACK_COMPILE_CACHE"
+            mounts = [m["mountPath"] for m in server.get("volumeMounts", [])]
+            assert any(cache == m or cache.startswith(m.rstrip("/") + "/")
+                       for m in mounts), (
+                f"{p}: TPUSTACK_COMPILE_CACHE={cache} is not under any "
+                f"volumeMount {mounts} — the cache would die with the pod")
+    # the HelmRelease variant carries the same contract through values
+    hr = _load_all(CLUSTER / "apps" / "sd15-api" / "helmrelease.yaml")
+    text = yaml.safe_dump(hr)
+    assert "TPUSTACK_COMPILE_CACHE" in text
+
+
+def test_llm_prefix_cache_knobs_declared():
+    """The LLM Deployment pins the prefix-KV-cache contract explicitly so
+    operators see (and can tune) it in IaC, not just in code defaults."""
+    for d in _load_all(CLUSTER / "apps" / "llm" / "deployment.yaml"):
+        if d.get("kind") != "Deployment":
+            continue
+        env = {e["name"]: e.get("value")
+               for e in d["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env.get("TPUSTACK_PREFIX_CACHE") == "1"
+        assert float(env["TPUSTACK_PREFIX_CACHE_MB"]) > 0
+        assert int(env["TPUSTACK_PREFIX_CACHE_CHUNK"]) > 0

@@ -267,6 +267,19 @@ def test_metric_name_lint_passes_on_catalog():
     assert lint_metrics.lint() == []
 
 
+def test_metric_name_lint_cli_green():
+    """Shell the lint exactly the way CI/operators do: a new metric that
+    escapes the naming contract must fail `python tools/lint_metrics.py`
+    itself, not just the in-process import path."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_metrics.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "metrics OK" in proc.stdout
+
+
 def test_metric_name_lint_catches_violations(monkeypatch):
     sys.path.insert(0, os.path.join(REPO, "tools"))
     try:

@@ -27,6 +27,105 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _shared_prefix_bench(args, gen, cfg, log) -> int:
+    """``--shared-prefix``: the chat-traffic workload the prefix KV cache
+    exists for — ``--requests`` prompts share a long system prompt
+    (``--prompt-tokens``) and differ only in a short per-request tail
+    (``--unique-tokens``).  Runs the fleet twice, cache OFF then cache ON
+    (same greedy decode), and reports prefill tokens computed vs skipped
+    plus p50/p99 TTFT for each, asserting the outputs are identical.
+
+    TTFT here is the engine-side prefill wall (restore + suffix prefill +
+    first-token sample for hits; full prefill for misses) — the device
+    cost the cache removes; HTTP overhead is mode-independent."""
+    from tpustack.models.llm_generate import SampleConfig
+    from tpustack.serving.prefix_cache import PrefixCache
+
+    sample = SampleConfig(greedy=True)
+    ctx, vocab = cfg.max_seq, cfg.vocab_size
+    unique = max(1, args.unique_tokens)
+    shared_len = min(args.prompt_tokens, ctx - unique - args.new_tokens - 2)
+    # snap granularity: whole chunks of the shared prompt must exist for a
+    # hit, so the chunk has to fit inside it (tiny-preset runs shrink it)
+    chunk = max(1, min(args.prefix_chunk, shared_len // 2))
+    shared = [(7 + j) % (vocab - 1) + 1 for j in range(shared_len)]
+    tail = lambda i: [(1000 + i * unique + j) % (vocab - 1) + 1
+                      for j in range(unique)]
+    dchunk = min(args.chunk, args.new_tokens)
+
+    def run_mode(use_cache: bool):
+        pc = (PrefixCache(chunk_tokens=chunk,
+                          capacity_bytes=args.prefix_cache_mb * 1024 * 1024)
+              if use_cache else None)
+
+        def hooks(ids):
+            if pc is None:
+                return None, None, None
+            m = pc.match(ids)
+            prefix = (m.length, m.kv, m.key) if m.length else None
+            upto = pc.snap(len(ids))
+            if upto <= m.length:
+                return prefix, None, None
+            return prefix, (m.length, upto), (
+                lambda kv, ids=list(ids), s=m.length: pc.insert(ids, s, kv))
+
+        def one(ids):
+            pre, ext, cb = hooks(ids)
+            t0 = time.time()
+            out, st = gen.generate_fused(
+                ids, max_new_tokens=args.new_tokens, sample=sample,
+                chunk=dchunk, prefix=pre, kv_extract=ext, on_prefill_kv=cb)
+            return out, st, time.time() - t0
+
+        # warm-ups (uncounted): one miss-shaped request populates the cache
+        # and one hit-shaped request compiles the restore + suffix-prefill
+        # programs, so measured requests are cache-warm AND compile-warm
+        one(shared + tail(-1))
+        one(shared + tail(-2))
+        outs, ttfts, computed, skipped = [], [], 0, 0
+        for i in range(args.requests):
+            out, st, _ = one(shared + tail(i))
+            outs.append(out)
+            ttfts.append(st["prefill_s"])
+            computed += st["prefill_tokens"]
+            skipped += st["cached_tokens"]
+        ttfts.sort()
+        q = lambda p: ttfts[min(len(ttfts) - 1,
+                                int(round(p * (len(ttfts) - 1))))]
+        return outs, {
+            "prefill_tokens_computed": computed,
+            "prefill_tokens_skipped": skipped,
+            "ttft_p50_ms": round(q(0.50) * 1e3, 2),
+            "ttft_p99_ms": round(q(0.99) * 1e3, 2),
+        }
+
+    outs_off, off = run_mode(False)
+    log(f"[bench_llm] shared-prefix cache OFF: {off}")
+    outs_on, on = run_mode(True)
+    log(f"[bench_llm] shared-prefix cache ON:  {on}")
+    identical = outs_off == outs_on
+    if not identical:
+        log("[bench_llm] WARNING: cache-on outputs diverged from cache-off")
+    total = on["prefill_tokens_computed"] + on["prefill_tokens_skipped"]
+    skip_pct = 100.0 * on["prefill_tokens_skipped"] / total if total else 0.0
+    print(json.dumps({
+        "metric": f"{args.preset}_{args.quant or 'bf16'}_ctx{args.ctx}"
+                  f"_shared_prefix_prefill_skip_pct",
+        "value": round(skip_pct, 1),
+        "unit": "%",
+        "requests": args.requests,
+        "shared_prompt_tokens": shared_len,
+        "unique_tokens": unique,
+        "prefix_chunk": chunk,
+        "cache_off": off,
+        "cache_on": on,
+        "ttft_p50_speedup": (round(off["ttft_p50_ms"] / on["ttft_p50_ms"], 2)
+                             if on["ttft_p50_ms"] > 0 else None),
+        "outputs_identical": identical,
+    }))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="llama2_7b",
@@ -53,6 +152,21 @@ def main() -> int:
                    help="route the --batch workload through the continuous "
                         "engine (slot admission, per-row inline prefills) "
                         "instead of generate_batch; tok/s is end-to-end")
+    p.add_argument("--shared-prefix", action="store_true",
+                   help="chat-shaped workload: --requests prompts share a "
+                        "--prompt-tokens system prompt (+ --unique-tokens "
+                        "tail each); reports prefill tokens computed vs "
+                        "skipped and p50/p99 TTFT with the prefix KV cache "
+                        "off vs on (greedy outputs asserted identical)")
+    p.add_argument("--requests", type=int, default=8,
+                   help="shared-prefix mode: measured requests per cache mode")
+    p.add_argument("--unique-tokens", type=int, default=16,
+                   help="shared-prefix mode: per-request unique tail length")
+    p.add_argument("--prefix-chunk", type=int, default=256,
+                   help="prefix-cache snap granularity "
+                        "(TPUSTACK_PREFIX_CACHE_CHUNK analog)")
+    p.add_argument("--prefix-cache-mb", type=int, default=512,
+                   help="prefix-cache capacity (TPUSTACK_PREFIX_CACHE_MB)")
     args = p.parse_args()
 
     import jax
@@ -100,6 +214,9 @@ def main() -> int:
             tmpl)
         gen = Generator(cfg, params=params, dtype=dtype)
     log(f"[bench_llm] init {time.time() - t0:.1f}s")
+
+    if args.shared_prefix:
+        return _shared_prefix_bench(args, gen, cfg, log)
 
     prompt = list(range(5, 5 + args.prompt_tokens))
     sample = SampleConfig(greedy=True)

@@ -103,6 +103,15 @@ class SlotRequest:
     ``seed``: sampling PRNG seed — a seeded non-greedy request reproduces
     its output exactly regardless of admission timing / batch peers (per-
     slot key chains); None draws a fresh random seed.
+
+    Prefix-KV-cache hooks (``tpustack.serving.prefix_cache``): ``prefix``
+    is an optional ``(n_cached, kv)`` hit — the cached KV restores into
+    the slot's cache line and admission prefills ONLY the uncached suffix;
+    ``kv_extract`` is an optional ``(start, end)`` token range the engine
+    slices out of the slot's cache after prefill and hands (as host numpy
+    arrays) to ``on_prefill_kv`` — the server's cache-insert hook.  All
+    three default to None: the no-cache path is byte-for-byte the
+    pre-prefix-cache engine.
     """
 
     ids: List[int]
@@ -112,11 +121,14 @@ class SlotRequest:
     on_done: Optional[Callable[[List[int], Dict], None]] = None
     cancelled: Callable[[], bool] = lambda: False
     seed: Optional[int] = None
+    prefix: Optional[Tuple[int, list]] = None
+    kv_extract: Optional[Tuple[int, int]] = None
+    on_prefill_kv: Optional[Callable[[list], None]] = None
 
 
 class _Slot:
     __slots__ = ("req", "out", "budget", "gen_id", "t0", "prefill_s",
-                 "dispatched", "done", "pending")
+                 "dispatched", "done", "pending", "cached")
 
     def __init__(self):
         self.req: Optional[SlotRequest] = None
@@ -128,19 +140,24 @@ class _Slot:
         self.dispatched = 0  # decode steps dispatched for this occupancy
         self.done = True
         self.pending = False  # admission dispatched, firsts not yet fetched
+        self.cached = 0  # prompt tokens restored from the prefix KV cache
 
 
 class _PendingWave:
     """One dispatched-but-unresolved admission group: the device is (or
     soon will be) holding the group's first tokens; ``resolve`` fetches
-    them and completes the host-side bookkeeping."""
+    them and completes the host-side bookkeeping.  ``extracts``: per-row
+    prefix-cache KV slices dispatched right after the splice — fetched at
+    resolution (when prefill has provably landed) and handed to each
+    request's ``on_prefill_kv``."""
 
-    __slots__ = ("rows", "firsts_dev", "t0")
+    __slots__ = ("rows", "firsts_dev", "t0", "extracts")
 
-    def __init__(self, rows, firsts_dev, t0):
+    def __init__(self, rows, firsts_dev, t0, extracts=()):
         self.rows = rows            # [(slot_idx, req, budget)]
         self.firsts_dev = firsts_dev
         self.t0 = t0
+        self.extracts = list(extracts)  # [(req, device kv slices)]
 
 
 class ContinuousEngine:
@@ -197,8 +214,10 @@ class ContinuousEngine:
             s.t0, s.done, s.pending = t0, False, False
             s.prefill_s = 0.0  # else a zero-budget retire below reports the
             # slot's PREVIOUS occupant's prefill time
+            s.cached = req.prefix[0] if req.prefix else 0
             n_prompt = len(req.ids)
-            if n_prompt == 0 or n_prompt >= c.max_seq:
+            if (n_prompt == 0 or n_prompt >= c.max_seq
+                    or s.cached >= n_prompt):
                 s.req, s.done = None, True
                 if req.on_done is not None:
                     req.on_done(None, {"error": f"prompt length {n_prompt} "
@@ -215,18 +234,18 @@ class ContinuousEngine:
 
         # group by prefill bucket: a 16-token prompt must not pay a 16k
         # peer's padded prefill (the engine admits ANY prompt that fits ctx
-        # — long prompts included — so buckets can differ wildly in a wave)
+        # — long prompts included — so buckets can differ wildly in a wave).
+        # Prefix-cache hits admit one at a time (n=1 groups): each carries
+        # its own restored prefix length, so there is no shared bucket.
         groups: Dict[int, List[Tuple[int, SlotRequest, int]]] = {}
+        prefix_rows: List[Tuple[int, SlotRequest, int]] = []
         for row in valid:
-            groups.setdefault(g._bucket(len(row[1].ids)), []).append(row)
+            if row[1].prefix and row[1].prefix[0] > 0:
+                prefix_rows.append(row)
+            else:
+                groups.setdefault(g._bucket(len(row[1].ids)), []).append(row)
 
-        for bucket, rows in sorted(groups.items()):
-            n = len(rows)
-            tokens = np.zeros((n, bucket), np.int32)
-            for j, (_, r, _) in enumerate(rows):
-                tokens[j, :len(r.ids)] = r.ids
-            lengths = jnp.asarray([len(r.ids) for _, r, _ in rows], jnp.int32)
-            slot_ids = jnp.asarray([i for i, _, _ in rows], jnp.int32)
+        def row_arrays(rows):
             # normalize into uint32 exactly like jax.random.PRNGKey wraps
             # ints: llama.cpp clients send seed=-1 for "random" (the server
             # maps that to None) but ANY out-of-range int must not be able
@@ -236,12 +255,80 @@ class ContinuousEngine:
                 [(r.seed % (2**32)) if r.seed is not None
                  else np.random.randint(0, 2**31)
                  for _, r, _ in rows], jnp.uint32)
-            temp_r = jnp.asarray([r.sample.temperature for _, r, _ in rows],
-                                 jnp.float32)
-            topk_r = jnp.asarray([r.sample.top_k for _, r, _ in rows],
-                                 jnp.int32)
-            greedy_r = jnp.asarray([r.sample.greedy for _, r, _ in rows],
-                                   jnp.bool_)
+            return (jnp.asarray([len(r.ids) for _, r, _ in rows], jnp.int32),
+                    jnp.asarray([i for i, _, _ in rows], jnp.int32),
+                    seeds,
+                    jnp.asarray([r.sample.temperature for _, r, _ in rows],
+                                jnp.float32),
+                    jnp.asarray([r.sample.top_k for _, r, _ in rows],
+                                jnp.int32),
+                    jnp.asarray([r.sample.greedy for _, r, _ in rows],
+                                jnp.bool_))
+
+        def dispatch_extracts(rows):
+            # prefix-cache inserts: slice each row's prompt KV out of the
+            # just-spliced slot cache (device-side; fetched at _resolve,
+            # when the firsts fetch proves prefill landed).  Dispatch order
+            # makes this safe against the donated-cache hazard: the slices
+            # read state["caches"] BEFORE any later dispatch donates it.
+            out = []
+            for i, r, _ in rows:
+                if r.kv_extract is None or r.on_prefill_kv is None:
+                    continue
+                lo, hi = r.kv_extract
+                if hi > lo:
+                    out.append((r, g._extract_kv(
+                        state["caches"], jnp.asarray(i, jnp.int32),
+                        jnp.asarray(lo, jnp.int32), hi - lo)))
+            return out
+
+        for row in prefix_rows:
+            rows = [row]
+            i, req, budget = row
+            plen, pkv = req.prefix[0], req.prefix[1]
+            n_prompt = len(req.ids)
+            # suffix bucket: power-of-two padded, capped so the restored
+            # prefix + suffix writes stay inside the cache line
+            sbucket = min(g._bucket(n_prompt - plen), c.max_seq - plen)
+            tokens = np.zeros((1, sbucket), np.int32)
+            tokens[0, :n_prompt - plen] = req.ids[plen:]
+            lengths, slot_ids, seeds, temp_r, topk_r, greedy_r = (
+                row_arrays(rows))
+            prefix_dev = g._prefix_to_device(
+                pkv, req.prefix[2] if len(req.prefix) > 2 else None)
+            if sbucket * c.max_seq <= g.MASKED_PREFILL_MAX:
+                # one dispatch: in-graph row caches + restore + masked
+                # suffix prefill (the common warm-hit shape)
+                logits, row_caches = g._prefill_prefix_fused(
+                    g.params, jnp.asarray(tokens),
+                    jnp.asarray(plen, jnp.int32), lengths, prefix_dev)
+            else:
+                row_caches = init_kv_caches(c, 1, dtype=g.cache_dtype)
+                row_caches = g._restore_kv_rows(row_caches, prefix_dev)
+                logits, row_caches = g._prefill_from(tokens, plen, lengths,
+                                                     row_caches)
+            state["caches"] = g._insert_cache_rows(
+                state["caches"], row_caches, slot_ids, 1, plen + sbucket)
+            firsts, row_keys = g._admit_sample_jit(
+                logits, seeds, temp_r, topk_r, greedy_r)
+            (state["cur"], state["active"], state["first"],
+             state["temp"], state["topk"], state["greedy"],
+             state["keys"]) = g._slot_activate(
+                state["cur"], state["active"], state["first"],
+                state["temp"], state["topk"], state["greedy"],
+                state["keys"], slot_ids, lengths, firsts, temp_r,
+                topk_r, greedy_r, row_keys)
+            slots[i].pending = True
+            self._pending.append(_PendingWave(rows, firsts, t0,
+                                              dispatch_extracts(rows)))
+
+        for bucket, rows in sorted(groups.items()):
+            n = len(rows)
+            tokens = np.zeros((n, bucket), np.int32)
+            for j, (_, r, _) in enumerate(rows):
+                tokens[j, :len(r.ids)] = r.ids
+            lengths, slot_ids, seeds, temp_r, topk_r, greedy_r = (
+                row_arrays(rows))
             if bucket > g.PREFILL_CHUNK:
                 # chunked long-prompt admission: one fused scan dispatch
                 # for exact-multiple buckets (16k/32k), a per-chunk host
@@ -273,7 +360,8 @@ class ContinuousEngine:
                     state["greedy"], state["keys"], temp_r, topk_r, greedy_r)
             for i, _, _ in rows:
                 slots[i].pending = True
-            self._pending.append(_PendingWave(rows, firsts, t0))
+            self._pending.append(_PendingWave(rows, firsts, t0,
+                                              dispatch_extracts(rows)))
         return gen_ctr
 
     def _resolve(self, state, slots: List[_Slot], wave: _PendingWave):
@@ -284,6 +372,17 @@ class ContinuousEngine:
         overlap this is the request's true time-to-first-token."""
         firsts = [int(t) for t in np.asarray(wave.firsts_dev)]
         t_first = time.time() - wave.t0
+        for req, dev in wave.extracts:
+            # prefill has landed (the firsts fetch above synced on it), so
+            # this fetch costs only the transfer; a failing server-side
+            # insert must not kill the engine run for every in-flight peer
+            try:
+                req.on_prefill_kv(
+                    [{k: np.asarray(v) for k, v in layer.items()}
+                     for layer in dev])
+            except Exception:
+                log.exception("on_prefill_kv failed (prefix-cache insert "
+                              "skipped)")
         live = self._live(slots)
         for (i, req, budget), first in zip(wave.rows, firsts):
             s = slots[i]
@@ -349,6 +448,8 @@ class ContinuousEngine:
                 "batch": batch_size,
                 "prompt_tokens": len(req.ids),
                 "generated_tokens": len(out),
+                "cached_tokens": s.cached,
+                "prefill_tokens": len(req.ids) - s.cached,
                 "prefill_s": s.prefill_s,
                 "decode_s": max(dt - s.prefill_s, 0.0),
                 "tokens_per_s": (len(out) / max(dt - s.prefill_s, 1e-9)

@@ -88,6 +88,13 @@ class Generator:
             specs = match_partition_rules(rules or LLAMA_RULES, params)
             params = shard_params(params, specs, mesh)
         self.params = params
+        # device-side memo of hot prefix-cache entries (HBM-resident): a
+        # repeat hit on the same stored prefix skips the host→device
+        # transfer — see _prefix_to_device
+        import collections as _collections
+
+        self._prefix_dev: "Any" = _collections.OrderedDict()
+        self.prefix_dev_cap = 4
 
     @staticmethod
     def _quantize(cfg: LlamaConfig, params: Dict) -> Dict:
@@ -223,22 +230,161 @@ class Generator:
         scan dispatch; a bucket capped at a non-multiple ``max_seq`` falls
         back to the per-chunk host loop with its shorter tail segment."""
         b, bucket = tokens.shape
-        chunk = self.PREFILL_CHUNK
-        if bucket % chunk == 0:
+        if bucket % self.PREFILL_CHUNK == 0:
             return self._prefill_long_scan(
                 self.params, jnp.asarray(tokens), length, caches,
-                bucket // chunk)
+                bucket // self.PREFILL_CHUNK)
+        return self._prefill_from(tokens, 0, length, caches)
+
+    #: score-matrix budget (elements) under which a suffix prefill runs as
+    #: ONE explicit-mask XLA attention dispatch over the full cache instead
+    #: of the k-streaming flash chunk loop: at the prefix-cache's typical
+    #: shapes (a few hundred uncached tokens over a 4k cache) the
+    #: materialised [s, max_seq] scores are tiny and XLA's fused attention
+    #: beats the flash kernel's fixed overhead (and its CPU interpret mode,
+    #: which the tiny-preset tests run)
+    MASKED_PREFILL_MAX = 1 << 21
+
+    def _prefill_masked_body(self, params, tokens, base, length, caches):
+        """Traced body of the small-suffix prefill: rows at global
+        positions ``base + i`` attend ``[0, base + i]`` via an explicit
+        mask (the full-cache XLA attention path) — semantics identical to
+        ``_prefill_chunk``.  Shared by ``_prefill_masked`` and the fused
+        restore+prefill program."""
+        b, s = tokens.shape
+        positions = base + jnp.broadcast_to(jnp.arange(s), (b, s))
+        mask = (jnp.arange(self.cfg.max_seq)[None, None, None, :]
+                <= positions[:, None, :, None])
+        local_last = jnp.clip(length - 1 - base, 0, s - 1)
+        logits, caches = self.model.apply(
+            {"params": params}, tokens, positions, caches, base, mask,
+            local_last)
+        return logits[:, 0], caches
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(5,))
+    def _prefill_masked(self, params, tokens, base, length, caches):
+        """One-dispatch small-suffix prefill — see _prefill_masked_body."""
+        return self._prefill_masked_body(params, tokens, base, length, caches)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _prefill_prefix_fused(self, params, tokens, base, length, prefix):
+        """ONE-dispatch warm start: fresh row caches created in-graph →
+        cached prefix written into ``[0, plen)`` → masked suffix prefill.
+        This keeps a prefix-cache hit at the SAME dispatch count as a cold
+        short-prompt prefill, so TTFT strictly improves even when the
+        model is dispatch-bound (tiny/CPU shapes), not just FLOP-bound."""
+        b = tokens.shape[0]
+        caches = init_kv_caches(self.cfg, b, dtype=self.cache_dtype)
+        caches = self._restore_body(caches, prefix)
+        return self._prefill_masked_body(params, tokens, base, length, caches)
+
+    def _prefill_from(self, tokens: np.ndarray, base: int, length, caches):
+        """Prefill ``tokens [B, bucket]`` starting at cache position
+        ``base``, attending the already-populated cache ``[0, base)`` —
+        chunked like ``_prefill_long`` (each chunk reuses the one compiled
+        ``_prefill_chunk`` program; ``base`` is a traced offset, so a new
+        prefix length never recompiles).  ``base=0`` is the long-prompt
+        fallback loop; ``base>0`` is the prefix-cache suffix path: a
+        restored cross-request KV prefix sits in ``[0, base)`` and only the
+        uncached suffix pays prefill FLOPs.  ``length`` stays the TRUE
+        per-row prompt length (global), so logits land at ``length - 1``."""
+        b, bucket = tokens.shape
+        # base == 0 is the cold long-prompt fallback — byte-for-byte the
+        # pre-prefix-cache flash chunk loop; only warm suffixes take the
+        # masked fast path
+        if base > 0 and bucket * self.cfg.max_seq <= self.MASKED_PREFILL_MAX:
+            return self._prefill_masked(self.params, jnp.asarray(tokens),
+                                        jnp.asarray(base, jnp.int32), length,
+                                        caches)
+        chunk = self.PREFILL_CHUNK
         out = None
         lo = 0
         while lo < bucket:  # final segment may be shorter (bucket capped at
             n = min(chunk, bucket - lo)  # a non-multiple max_seq): its own
             seg = jnp.asarray(tokens[:, lo:lo + n])  # (one) jit signature
             logits, caches = self._prefill_chunk(
-                self.params, seg, jnp.asarray(lo, jnp.int32), length, caches)
-            hit = (length - 1 >= lo) & (length - 1 < lo + n)  # [B]
+                self.params, seg, jnp.asarray(base + lo, jnp.int32), length,
+                caches)
+            hit = (length - 1 >= base + lo) & (length - 1 < base + lo + n)
             out = logits if out is None else jnp.where(hit[:, None], logits, out)
             lo += n
         return out, caches
+
+    # ------------------------------------------------- prefix-cache surgery
+    #
+    # Device side of the cross-request prefix KV cache
+    # (tpustack.serving.prefix_cache): extract slices a finished prefill's
+    # K/V rows to the host for insertion; restore writes a cached prefix
+    # back into fresh row caches so admission prefills ONLY the uncached
+    # suffix (_prefill_from with base = prefix length).  Both are generic
+    # over the cache layout (bf16 k/v, or int8 + per-vector scales).
+
+    @functools.partial(jax.jit, static_argnums=(0, 4))
+    def _extract_kv(self, caches, row, start, n: int):
+        """Slice cache row ``row`` positions ``[start, start + n)`` of every
+        layer/tensor — the device half of a prefix-cache insert.  ``row``
+        and ``start`` are traced (no recompile per slot or per boundary);
+        ``n`` is static but chunk-snapped by the caller, so signatures stay
+        bounded.  NOT donated: the caches keep serving decode; dispatch
+        ordering guarantees this read completes before any later donating
+        dispatch reuses the buffer."""
+
+        def sl(x):
+            idx = (row, start) + (jnp.zeros((), jnp.int32),) * (x.ndim - 2)
+            return jax.lax.dynamic_slice(x, idx, (1, n) + x.shape[2:])[0]
+
+        return [{k: sl(v) for k, v in layer.items()} for layer in caches]
+
+    @staticmethod
+    def _restore_body(row_caches, prefix):
+        """Traced body of the prefix restore — see _restore_kv_rows."""
+
+        def wr(dst, src):
+            src = jnp.broadcast_to(src[None].astype(dst.dtype),
+                                   (dst.shape[0],) + src.shape)
+            return jax.lax.dynamic_update_slice(
+                dst, src, (jnp.zeros((), jnp.int32),) * dst.ndim)
+
+        return [{k: wr(layer[k], pre[k]) for k in layer}
+                for layer, pre in zip(row_caches, prefix)]
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _restore_kv_rows(self, row_caches, prefix):
+        """Write a cached prefix (per-layer ``[n, ...]`` arrays, host-fetched
+        by ``_extract_kv`` earlier) into positions ``[0, n)`` of every row
+        of fresh row caches — the device half of a prefix-cache hit.  The
+        prefix KV is a pure function of (token ids, weights), so the
+        restored rows are exactly what prefill would have written.  The
+        small-suffix common case fuses this with the prefill itself
+        (``_prefill_prefix_fused``); this standalone dispatch serves the
+        big-suffix flash-chunk path."""
+        return self._restore_body(row_caches, prefix)
+
+    def _prefix_to_device(self, kv, key=None):
+        """Host KV segment → device arrays, memoised by the store's stable
+        path ``key`` (small LRU, ``prefix_dev_cap`` entries): the hottest
+        prefixes stay HBM-resident, so a warm hit costs zero host→device
+        KV traffic.  ``key=None`` (no identity) transfers uncached."""
+        dev = self._prefix_dev.get(key) if key is not None else None
+        if dev is None:
+            dev = [{k: jnp.asarray(v) for k, v in layer.items()}
+                   for layer in kv]
+            if key is not None:
+                self._prefix_dev[key] = dev
+                while len(self._prefix_dev) > max(1, self.prefix_dev_cap):
+                    self._prefix_dev.popitem(last=False)
+        else:
+            self._prefix_dev.move_to_end(key)
+        return dev
+
+    def extract_prefix_host(self, caches, row: int, start: int, n: int):
+        """Host-side convenience: ``_extract_kv`` then fetch to numpy (the
+        layout ``tpustack.serving.prefix_cache`` stores)."""
+        if n <= 0:
+            return []
+        dev = self._extract_kv(caches, jnp.asarray(row, jnp.int32),
+                               jnp.asarray(start, jnp.int32), n)
+        return [{k: np.asarray(v) for k, v in layer.items()} for layer in dev]
 
     def _topk_scaled(self, logits, temperature, top_k):
         """Shared temperature/top-k filter: ``[B, V]`` f32 logits →
@@ -828,10 +974,20 @@ class Generator:
         return min(p, self.cfg.max_seq)
 
     def _start_generation(self, prompt_tokens: List[int], max_new_tokens: int,
-                          sample: SampleConfig, seed: Optional[int]):
+                          sample: SampleConfig, seed: Optional[int],
+                          prefix=None, kv_extract=None, on_prefill_kv=None):
         """Shared prologue of both decoders: validate, prefill, sample the
         first token from prefill logits on the host, seed the split chain.
-        Returns (first_tok, caches, key, n_prompt, max_new_tokens, t_prefill).
+        Returns (first_tok, caches, key, n_prompt, max_new_tokens, t_prefill,
+        n_cached).
+
+        ``prefix``: optional ``(n_cached, kv)`` from a prefix-cache hit —
+        the cached KV is restored into ``[0, n_cached)`` and ONLY the
+        suffix ``[n_cached, n_prompt)`` pays prefill (``_prefill_from``).
+        ``kv_extract``: optional ``(start, end)`` token range to slice out
+        of the prefilled cache and hand to ``on_prefill_kv`` as host numpy
+        arrays (the prefix-cache insert hook).  With both None the path is
+        byte-for-byte the pre-prefix-cache behavior.
         """
         c = self.cfg
         n_prompt = len(prompt_tokens)
@@ -841,25 +997,64 @@ class Generator:
             max_new_tokens = c.max_seq - n_prompt
             if max_new_tokens <= 0:
                 raise ValueError(f"prompt ({n_prompt}) exceeds ctx {c.max_seq}")
+        n_cached = 0
+        if prefix is not None and prefix[0] > 0:
+            n_cached = int(prefix[0])
+            if n_cached >= n_prompt:
+                raise ValueError(f"cached prefix ({n_cached}) must leave "
+                                 f"a suffix of prompt ({n_prompt})")
 
         t0 = time.time()
-        bucket = self._bucket(n_prompt)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n_prompt] = prompt_tokens
-        caches = init_kv_caches(c, 1, dtype=self.cache_dtype)
         length = jnp.asarray([n_prompt], jnp.int32)
-        if bucket > self.PREFILL_CHUNK:
-            logits, caches = self._prefill_long(tokens, length, caches)
+        if n_cached:
+            prefix_dev = self._prefix_to_device(
+                prefix[1], prefix[2] if len(prefix) > 2 else None)
+            bucket = min(self._bucket(n_prompt - n_cached),
+                         c.max_seq - n_cached)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n_prompt - n_cached] = prompt_tokens[n_cached:]
+            if bucket * c.max_seq <= self.MASKED_PREFILL_MAX:
+                # one dispatch: in-graph caches + restore + masked prefill
+                # (no host-side cache allocation — the fused program builds
+                # its own)
+                logits, caches = self._prefill_prefix_fused(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(n_cached, jnp.int32), length, prefix_dev)
+            else:
+                caches = self._restore_kv_rows(
+                    init_kv_caches(c, 1, dtype=self.cache_dtype), prefix_dev)
+                logits, caches = self._prefill_from(tokens, n_cached, length,
+                                                    caches)
         else:
-            logits, caches = self._prefill(self.params, jnp.asarray(tokens),
-                                           length, caches)
+            caches = init_kv_caches(c, 1, dtype=self.cache_dtype)
+            bucket = self._bucket(n_prompt)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n_prompt] = prompt_tokens
+            if bucket > self.PREFILL_CHUNK:
+                logits, caches = self._prefill_long(tokens, length, caches)
+            else:
+                logits, caches = self._prefill(self.params,
+                                               jnp.asarray(tokens),
+                                               length, caches)
+        if kv_extract is not None and on_prefill_kv is not None:
+            s, e = kv_extract
+            if e > s:
+                # mirror the engine path's guard: a failing cache insert
+                # must not 500 a completion the device already produced
+                try:
+                    on_prefill_kv(self.extract_prefix_host(caches, 0, s,
+                                                           e - s))
+                except Exception:
+                    log.exception("on_prefill_kv failed (prefix-cache "
+                                  "insert skipped)")
         key = jax.random.PRNGKey(np.random.randint(0, 2**31) if seed is None else seed)
 
         # first sampled token comes from prefill logits: reuse decode's sampling
         # by treating it as a temperature/top-k draw on the host side once.
         first = self._sample_host(logits, sample, key)
         key = jax.random.fold_in(key, 0)
-        return first, caches, key, n_prompt, max_new_tokens, time.time() - t0
+        return (first, caches, key, n_prompt, max_new_tokens,
+                time.time() - t0, n_cached)
 
     def generate(
         self,
@@ -869,6 +1064,9 @@ class Generator:
         seed: Optional[int] = None,
         stop_tokens: Tuple[int, ...] = (),
         on_token=None,
+        prefix=None,
+        kv_extract=None,
+        on_prefill_kv=None,
     ) -> Tuple[List[int], Dict[str, float]]:
         """Returns (generated token ids, timing stats).
 
@@ -877,9 +1075,13 @@ class Generator:
         streaming endpoints use.  The decode step for token i+1 is already in
         flight on device when the callback for token i runs, so streaming
         costs no TPU idle time.
+
+        ``prefix`` / ``kv_extract`` / ``on_prefill_kv`` — prefix-KV-cache
+        hooks, see ``_start_generation``.
         """
-        next_tok, caches, key, n_prompt, max_new_tokens, t_prefill = (
-            self._start_generation(prompt_tokens, max_new_tokens, sample, seed))
+        next_tok, caches, key, n_prompt, max_new_tokens, t_prefill, n_cached = (
+            self._start_generation(prompt_tokens, max_new_tokens, sample, seed,
+                                   prefix, kv_extract, on_prefill_kv))
         t0 = time.time()
 
         out: List[int] = []
@@ -897,7 +1099,7 @@ class Generator:
                 jnp.float32(sample.temperature), jnp.int32(sample.top_k),
                 jnp.bool_(sample.greedy))
             next_tok = np.asarray(next_tok_arr)[0]
-        return out, self._finish_stats(out, n_prompt, t_prefill, t0)
+        return out, self._finish_stats(out, n_prompt, t_prefill, t0, n_cached)
 
     def generate_fused(
         self,
@@ -908,6 +1110,9 @@ class Generator:
         stop_tokens: Tuple[int, ...] = (),
         chunk: int = 32,
         cancel_check=None,
+        prefix=None,
+        kv_extract=None,
+        on_prefill_kv=None,
     ) -> Tuple[List[int], Dict[str, float]]:
         """Like ``generate`` but decodes ``chunk`` tokens per device dispatch
         (``lax.scan``) instead of one — the throughput path when no per-token
@@ -924,8 +1129,9 @@ class Generator:
         """
         if chunk <= 0:
             raise ValueError(f"chunk must be positive, got {chunk}")
-        first, caches, key, n_prompt, max_new_tokens, t_prefill = (
-            self._start_generation(prompt_tokens, max_new_tokens, sample, seed))
+        first, caches, key, n_prompt, max_new_tokens, t_prefill, n_cached = (
+            self._start_generation(prompt_tokens, max_new_tokens, sample, seed,
+                                   prefix, kv_extract, on_prefill_kv))
         t0 = time.time()
         out: List[int] = [] if max_new_tokens <= 0 else [first]
         tok = first
@@ -974,15 +1180,17 @@ class Generator:
                 jnp.int32(sample.top_k), jnp.bool_(sample.greedy))
             tok = int(np.asarray(nxt)[0])
             out.append(tok)
-        return out, self._finish_stats(out, n_prompt, t_prefill, t0)
+        return out, self._finish_stats(out, n_prompt, t_prefill, t0, n_cached)
 
     def _finish_stats(self, out: List[int], n_prompt: int, t_prefill: float,
-                      t0: float) -> Dict[str, float]:
+                      t0: float, n_cached: int = 0) -> Dict[str, float]:
         t_decode = time.time() - t0
         n_gen = len(out)
         return {
             "prompt_tokens": n_prompt,
             "generated_tokens": n_gen,
+            "cached_tokens": n_cached,
+            "prefill_tokens": n_prompt - n_cached,
             "prefill_s": t_prefill,
             "decode_s": t_decode,
             "tokens_per_s": n_gen / t_decode if t_decode > 0 and n_gen else 0.0,

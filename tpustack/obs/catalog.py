@@ -74,6 +74,24 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "Prompt length distribution.",
                buckets=TOKEN_BUCKETS, unit="tokens"),
 
+    # ---- LLM prefix KV cache (cross-request radix reuse) ----
+    MetricSpec("tpustack_llm_prefix_cache_lookups_total", "counter",
+               "Prefix-cache lookups, by result (hit|miss).  A hit means "
+               "at least one chunk of the prompt's KV was reused.",
+               ("result",), unit="total"),
+    MetricSpec("tpustack_llm_prefix_cache_evictions_total", "counter",
+               "Cached chunks evicted under capacity pressure (LRU "
+               "leaves).", unit="total"),
+    MetricSpec("tpustack_llm_prefix_cached_tokens", "histogram",
+               "Prompt tokens served from the prefix cache per request "
+               "(prefill FLOPs skipped; 0 on a miss).",
+               buckets=TOKEN_BUCKETS, unit="tokens"),
+    MetricSpec("tpustack_llm_prefix_cache_bytes", "gauge",
+               "Resident bytes of cached KV segments (host RAM).",
+               unit="bytes"),
+    MetricSpec("tpustack_llm_prefix_cache_entries", "gauge",
+               "Chunk nodes resident in the radix store.", unit="entries"),
+
     # ---- SD server (signature-keyed micro-batcher) ----
     MetricSpec("tpustack_sd_queue_depth", "gauge",
                "Generate requests waiting in micro-batch groups.",

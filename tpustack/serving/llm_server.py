@@ -33,6 +33,13 @@ reference's Q4_K_M GGUF but ~2x decode from halved HBM traffic),
 ``LLM_MAX_BATCH`` (continuous-batching slot count — llama.cpp
 ``--parallel`` analog; requests join/leave the running batch at chunk
 boundaries; ``LLM_BATCH_WINDOW_MS`` is a legacy no-op),
+``TPUSTACK_PREFIX_CACHE`` (cross-request prefix KV cache — radix reuse of
+finished prefill KV so chat requests sharing a system prompt skip its
+prefill entirely; on by default, ``0`` disables;
+``TPUSTACK_PREFIX_CACHE_MB`` caps resident host bytes, default 512;
+``TPUSTACK_PREFIX_CACHE_CHUNK`` is the snap granularity in tokens,
+default 256; per-request opt-out via ``"cache_prompt": false`` in the
+body — llama.cpp's field name),
 ``MODEL_DIR`` (HF safetensors), ``LLM_TOKENIZER_DIR``, ``PORT`` (8080).
 """
 
@@ -135,10 +142,10 @@ class _PendingCompletion:
     other)."""
 
     __slots__ = ("ids", "n_predict", "sample", "future", "cancel",
-                 "stream_put", "seed")
+                 "stream_put", "seed", "prefix", "kv_extract", "on_prefill_kv")
 
     def __init__(self, ids, n_predict, sample, future, stream_put=None,
-                 seed=None):
+                 seed=None, prefix=None, kv_extract=None, on_prefill_kv=None):
         self.ids = ids
         self.n_predict = n_predict
         self.sample = sample
@@ -146,6 +153,12 @@ class _PendingCompletion:
         self.cancel = threading.Event()
         self.stream_put = stream_put
         self.seed = seed
+        # prefix-KV-cache hooks (see tpustack.serving.prefix_cache): a hit
+        # restores `prefix` into the slot's cache line; `kv_extract` +
+        # `on_prefill_kv` hand the prefilled KV back for insertion
+        self.prefix = prefix
+        self.kv_extract = kv_extract
+        self.on_prefill_kv = on_prefill_kv
 
 
 class LLMServer:
@@ -175,15 +188,29 @@ class LLMServer:
     solo path survives only for ``LLM_MAX_BATCH=1`` deployments.
     """
 
+    #: sentinel: "build the prefix cache from the environment"
+    _PREFIX_FROM_ENV = object()
+
     def __init__(self, generator=None, tokenizer=None, model_name: str = "tpustack",
                  max_batch: Optional[int] = None,
                  batch_window_ms: Optional[float] = None,
-                 registry=None):
+                 registry=None, prefix_cache=_PREFIX_FROM_ENV):
         # metrics registry: tests pass a fresh Registry for isolation; the
         # default is the process-wide one /metrics exposes
         self._registry = registry
         self.metrics = obs_catalog.build(registry)
         obs_device.install(registry)
+        # cross-request prefix KV cache (tpustack.serving.prefix_cache):
+        # tests pass an instance (tiny chunk) or None (hard off); serving
+        # builds from TPUSTACK_PREFIX_CACHE{,_MB,_CHUNK}, default ON —
+        # lookup/insert are no-ops until a prompt spans a whole chunk
+        if prefix_cache is LLMServer._PREFIX_FROM_ENV:
+            prefix_cache = self._build_prefix_cache()
+        self.prefix_cache = prefix_cache
+        if prefix_cache is not None and prefix_cache._on_evict is None:
+            prefix_cache._on_evict = (
+                lambda n: self.metrics[
+                    "tpustack_llm_prefix_cache_evictions_total"].inc(n))
         if generator is None:
             generator, tokenizer, model_name = _build_generator()
         self.gen = generator
@@ -216,6 +243,47 @@ class LLMServer:
         # solo requests queued on the device lock; the engine stops
         # admitting while > 0 so the FIFO-fair lock can hand over
         self._solo_waiting = 0
+
+    @staticmethod
+    def _build_prefix_cache():
+        from tpustack.serving.prefix_cache import PrefixCache
+
+        if os.environ.get("TPUSTACK_PREFIX_CACHE", "1").lower() in (
+                "0", "false", "no", "off"):
+            return None
+        mb = float(os.environ.get("TPUSTACK_PREFIX_CACHE_MB", "512") or 512)
+        chunk = int(os.environ.get("TPUSTACK_PREFIX_CACHE_CHUNK", "256")
+                    or 256)
+        return PrefixCache(chunk_tokens=chunk,
+                           capacity_bytes=max(1, int(mb * 1024 * 1024)))
+
+    def _prefix_lookup(self, ids, allow: bool = True):
+        """Per-request prefix-cache policy: longest cached prefix (hit →
+        restore + suffix-only prefill) and, when the prompt extends past
+        what's cached, an extract range + insert callback so THIS request's
+        prefill populates the cache for the next one.  Returns
+        ``(prefix, kv_extract, on_prefill_kv)`` — all None when the cache
+        is off, the request opted out, or the prompt is shorter than one
+        chunk."""
+        pc = self.prefix_cache
+        if pc is None or not allow:
+            return None, None, None
+        m = pc.match(ids)
+        self.metrics["tpustack_llm_prefix_cache_lookups_total"].labels(
+            result="hit" if m.length else "miss").inc()
+        self.metrics["tpustack_llm_prefix_cached_tokens"].observe(m.length)
+        prefix = (m.length, m.kv, m.key) if m.length else None
+        upto = pc.snap(len(ids))
+        if upto <= m.length:
+            return prefix, None, None
+        start, ids_copy = m.length, list(ids)
+
+        def on_kv(kv):
+            pc.insert(ids_copy, start, kv)
+            self.metrics["tpustack_llm_prefix_cache_bytes"].set(pc.bytes)
+            self.metrics["tpustack_llm_prefix_cache_entries"].set(pc.entries)
+
+        return prefix, (start, upto), on_kv
 
     @property
     def engine_chunk(self) -> int:
@@ -280,10 +348,13 @@ class LLMServer:
         self.metrics["tpustack_llm_queue_depth"].set(len(self._queue))
         self._wake.set()
 
-    async def _enqueue_completion(self, ids, n_predict, sample, seed=None):
+    async def _enqueue_completion(self, ids, n_predict, sample, seed=None,
+                                  prefix_hooks=(None, None, None)):
         loop = asyncio.get_running_loop()
         req = _PendingCompletion(ids, n_predict, sample, loop.create_future(),
-                                 seed=seed)
+                                 seed=seed, prefix=prefix_hooks[0],
+                                 kv_extract=prefix_hooks[1],
+                                 on_prefill_kv=prefix_hooks[2])
         await self._enqueue_raw(req)
         try:
             return await req.future
@@ -321,7 +392,9 @@ class LLMServer:
 
         return SlotRequest(ids=r.ids, max_new=r.n_predict, sample=r.sample,
                            on_tokens=on_tokens, on_done=on_done,
-                           cancelled=r.cancel.is_set, seed=r.seed)
+                           cancelled=r.cancel.is_set, seed=r.seed,
+                           prefix=r.prefix, kv_extract=r.kv_extract,
+                           on_prefill_kv=r.on_prefill_kv)
 
     async def _batch_loop(self):
         """Run the continuous engine whenever requests are queued: the
@@ -401,7 +474,8 @@ class LLMServer:
                          stats["generated_tokens"], stats["tokens_per_s"])
 
     async def _complete_routed(self, prompt: str, n_predict: int,
-                               temperature: float, top_k: int, seed):
+                               temperature: float, top_k: int, seed,
+                               cache_prompt: bool = True):
         """(content, stats, stopped_eos) via the micro-batcher when eligible,
         else the solo device path.  Raises ValueError for bad requests."""
         from tpustack.models.llm_generate import SampleConfig
@@ -411,6 +485,7 @@ class LLMServer:
             self.metrics["tpustack_llm_requests_rejected_total"].labels(
                 reason="empty_prompt").inc()
             raise ValueError("empty prompt")
+        prefix_hooks = self._prefix_lookup(ids, cache_prompt)
         t_start = time.perf_counter()
         if not self._batchable():
             cancel = threading.Event()
@@ -418,7 +493,8 @@ class LLMServer:
             try:                     # chunk boundary (FIFO-fair handover)
                 content, stats, stopped_eos = await self._run_on_device(
                     lambda: self._complete(ids, n_predict, temperature, top_k,
-                                           seed, False, cancel), cancel)
+                                           seed, False, cancel, prefix_hooks),
+                    cancel)
             finally:
                 self._solo_waiting -= 1
             self._observe_done(len(ids), stats, time.perf_counter() - t_start)
@@ -426,7 +502,8 @@ class LLMServer:
         sample = SampleConfig(temperature=temperature, top_k=top_k,
                               greedy=temperature <= 0)
         out_ids, stats = await self._enqueue_completion(ids, n_predict, sample,
-                                                        seed=seed)
+                                                        seed=seed,
+                                                        prefix_hooks=prefix_hooks)
         if out_ids and out_ids[-1] == self.tok.eos_id:
             out_ids = out_ids[:-1]
             stopped_eos = True
@@ -488,7 +565,8 @@ class LLMServer:
 
     def _complete(self, ids, n_predict: int, temperature: float,
                   top_k: int, seed: Optional[int], greedy: bool,
-                  cancel: Optional[threading.Event] = None):
+                  cancel: Optional[threading.Event] = None,
+                  prefix_hooks=(None, None, None)):
         """Non-streaming solo path: fused scan decode (chunk of tokens per
         device dispatch — the throughput path; a dead client is noticed
         between chunks).  Output matches the streaming per-token path
@@ -502,7 +580,9 @@ class LLMServer:
                                 greedy=greedy or temperature <= 0),
             seed=seed, stop_tokens=(self.tok.eos_id,),
             chunk=self.chunk,
-            cancel_check=None if cancel is None else cancel.is_set)
+            cancel_check=None if cancel is None else cancel.is_set,
+            prefix=prefix_hooks[0], kv_extract=prefix_hooks[1],
+            on_prefill_kv=prefix_hooks[2])
         if out_ids and out_ids[-1] == self.tok.eos_id:
             out_ids = out_ids[:-1]
             stopped_eos = True
@@ -515,7 +595,8 @@ class LLMServer:
         return content, stats, stopped_eos
 
     async def _stream(self, request: web.Request, prompt: str, n_predict: int,
-                      temperature: float, top_k: int, seed, fmt: str):
+                      temperature: float, top_k: int, seed, fmt: str,
+                      cache_prompt: bool = True):
         """SSE streaming shared by /completion (llama.cpp chunk shape) and
         /v1/chat/completions (OpenAI ``chat.completion.chunk`` + ``[DONE]``).
 
@@ -552,6 +633,7 @@ class LLMServer:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
+        prefix_hooks = self._prefix_lookup(ids, cache_prompt)
         batched = self._batchable()
         if batched:
             # concurrent streams coalesce into ONE batched decode; tokens
@@ -563,7 +645,8 @@ class LLMServer:
                              greedy=temperature <= 0),
                 loop.create_future(),
                 stream_put=lambda t: loop.call_soon_threadsafe(q.put_nowait, t),
-                seed=seed)
+                seed=seed, prefix=prefix_hooks[0],
+                kv_extract=prefix_hooks[1], on_prefill_kv=prefix_hooks[2])
             cancel = req.cancel
         else:
             cancel = threading.Event()
@@ -583,7 +666,9 @@ class LLMServer:
                                             top_k=top_k,
                                             greedy=temperature <= 0),
                         seed=seed, stop_tokens=(self.tok.eos_id,),
-                        on_token=on_token)
+                        on_token=on_token,
+                        prefix=prefix_hooks[0], kv_extract=prefix_hooks[1],
+                        on_prefill_kv=prefix_hooks[2])
                 finally:
                     loop.call_soon_threadsafe(q.put_nowait, None)  # EOS
 
@@ -707,10 +792,16 @@ class LLMServer:
         return web.json_response({"status": "ok"})
 
     async def props(self, request: web.Request) -> web.Response:
+        """Server properties + live prefix-cache config/stats, so operators
+        can verify the cache (enabled, chunk, capacity, hit rate) without
+        scraping ``/metrics``."""
+        pc = self.prefix_cache
         return web.json_response({
             "model": self.model_name,
             "n_ctx": self.gen.cfg.max_seq,
             "backend": "jax/tpu",
+            "prefix_cache": pc.stats() if pc is not None
+            else {"enabled": False},
         })
 
     def _reject(self, reason: str) -> None:
@@ -737,14 +828,20 @@ class LLMServer:
         if n_predict < 0:  # llama.cpp: -1 means "until EOS / context limit"
             n_predict = self.gen.cfg.max_seq
         seed = _normalize_seed(body.get("seed"))
+        # llama.cpp's prompt-cache field: absent/true → use the prefix KV
+        # cache (when server-enabled); explicit false → this request neither
+        # reuses nor populates it
+        cache_prompt = bool(_or_default(body.get("cache_prompt"), True))
         if body.get("stream"):
             return await self._stream(request, prompt, n_predict, temperature,
-                                      top_k, seed, fmt="llamacpp")
+                                      top_k, seed, fmt="llamacpp",
+                                      cache_prompt=cache_prompt)
 
         t0 = time.time()
         try:
             content, stats, stopped_eos = await self._complete_routed(
-                prompt, n_predict, temperature, top_k, seed)
+                prompt, n_predict, temperature, top_k, seed,
+                cache_prompt=cache_prompt)
         except ValueError as e:  # e.g. prompt longer than the context window
             return web.json_response({"error": str(e)}, status=400)
         log.info("completion: %d prompt tok, %d gen tok, %.2fs",
@@ -778,14 +875,17 @@ class LLMServer:
         except (TypeError, ValueError) as e:
             return web.json_response(
                 {"error": {"message": f"invalid parameter: {e}"}}, status=400)
+        cache_prompt = bool(_or_default(body.get("cache_prompt"), True))
         if body.get("stream"):
             return await self._stream(request, prompt, n_predict, temperature,
-                                      40, _normalize_seed(body.get("seed")), fmt="openai")
+                                      40, _normalize_seed(body.get("seed")),
+                                      fmt="openai", cache_prompt=cache_prompt)
 
         try:
             content, stats, stopped_eos = await self._complete_routed(
                 prompt, n_predict, temperature, 40,
-                _normalize_seed(body.get("seed")))
+                _normalize_seed(body.get("seed")),
+                cache_prompt=cache_prompt)
         except ValueError as e:
             return web.json_response({"error": {"message": str(e)}}, status=400)
         return web.json_response({

@@ -1,0 +1,313 @@
+"""Cross-request prefix KV cache — radix reuse for the LLM serving path.
+
+Chat-shaped traffic re-sends the same system prompt + few-shot preamble on
+every request, and until now every request re-ran full prefill over it
+(``llm_generate`` prefill buckets, ``llm_continuous`` admission).  vLLM's
+PagedAttention and SGLang's RadixAttention showed cross-request KV-prefix
+reuse is the single largest serving win for that shape — typically 50-90%
+of prefill FLOPs eliminated.  This module is the store; the device surgery
+(extract / restore / suffix-only prefill) lives in
+``Generator._extract_kv`` / ``_restore_kv_rows`` / ``_prefill_from``, and
+the per-request lookup/insert policy in ``serving.llm_server``.
+
+Design:
+
+- **Chunked radix trie on token ids.**  Prefixes are snapped to
+  ``chunk_tokens`` boundaries, so every edge is exactly one chunk of token
+  ids and a node stores that chunk's K/V slice for every layer.  Snapping
+  bounds both the trie's branching granularity and the number of compiled
+  restore/extract signatures on device (lengths are chunk multiples).
+- **Host-resident by default.**  Entries are numpy arrays in the engine's
+  cache dtype (bf16 via ml_dtypes, or int8 + f32 scales under
+  ``kv_quant``), so cache capacity is host RAM, not HBM — the restore cost
+  is one host→device transfer of the reused prefix, which is far cheaper
+  than recomputing its prefill.
+- **Bounded + LRU.**  ``capacity_bytes`` caps resident bytes; eviction
+  removes least-recently-used *leaves* (interior nodes stay until their
+  subtree goes, keeping every stored prefix contiguous from the root).
+- **Correct-by-construction reuse.**  ``match`` never returns the whole
+  prompt: at least one suffix token is always left to prefill, because the
+  engine needs the last real token's logits to sample from.  KV entries
+  are pure functions of (token ids, weights), so a restored prefix is
+  bit-identical to what prefill would have written.
+
+Thread-safe: the server's event loop reads stats while the engine thread
+looks up / inserts.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpustack.utils import get_logger
+
+log = get_logger("serving.prefix_cache")
+
+#: per-layer K/V segment: {"k": [n, kv_heads, head_dim], "v": ..., and
+#: "k_scale"/"v_scale" [n, kv_heads] when the engine cache is int8}
+KVSegment = List[Dict[str, np.ndarray]]
+
+
+_NODE_UIDS = itertools.count(1)
+
+
+class _Node:
+    """One chunk of a cached prefix: edge label = its token ids.  ``uid``
+    is a process-unique monotonic id (never reused, unlike ``id()``), so a
+    path's uid tuple is a stable identity for memoisation."""
+
+    __slots__ = ("key", "parent", "children", "kv", "nbytes", "last_used",
+                 "uid")
+
+    def __init__(self, key: Tuple[int, ...], parent: Optional["_Node"],
+                 kv: Optional[KVSegment], nbytes: int):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.kv = kv
+        self.nbytes = nbytes
+        self.last_used = 0
+        self.uid = next(_NODE_UIDS)
+
+
+class PrefixMatch:
+    """Result of a lookup: ``length`` cached tokens (chunk-snapped, 0 on a
+    miss), their assembled per-layer K/V (None on a miss), and ``key`` — a
+    stable identity of the matched node path.  Two matches with the same
+    key carry the SAME kv object, which is what lets the engine keep a
+    small device-side memo of hot prefixes (skip the host→HBM transfer on
+    repeat hits)."""
+
+    __slots__ = ("length", "kv", "key")
+
+    def __init__(self, length: int, kv: Optional[KVSegment], key=None):
+        self.length = length
+        self.kv = kv
+        self.key = key
+
+
+def _segment_bytes(kv: KVSegment) -> int:
+    return sum(int(a.nbytes) for layer in kv for a in layer.values())
+
+
+class PrefixCache:
+    """Radix (chunked-trie) store of finished prefill KV segments.
+
+    ``chunk_tokens``: prefix snap granularity — larger chunks mean fewer
+    nodes and device signatures but coarser reuse (a request reuses only
+    whole cached chunks).  ``capacity_bytes``: resident-byte cap, LRU leaf
+    eviction.  ``on_evict(n_nodes)``: optional hook, called (under the
+    lock) whenever eviction removes nodes — the server bumps its eviction
+    counter there.
+    """
+
+    def __init__(self, chunk_tokens: int = 256,
+                 capacity_bytes: int = 512 * 1024 * 1024,
+                 on_evict: Optional[Callable[[int], None]] = None):
+        if chunk_tokens <= 0:
+            raise ValueError(f"chunk_tokens must be positive, got {chunk_tokens}")
+        if capacity_bytes <= 0:
+            raise ValueError(f"capacity_bytes must be positive, got {capacity_bytes}")
+        self.chunk = chunk_tokens
+        self.capacity_bytes = capacity_bytes
+        self._on_evict = on_evict
+        self._root = _Node((), None, None, 0)
+        self._lock = threading.Lock()
+        self._tick = 0
+        # assembled-prefix memo: path uid tuple → concatenated KV (LRU) —
+        # hot prefixes skip the per-lookup np.concatenate AND give the
+        # engine a stable object to key its device memo on.  Byte-capped at
+        # a quarter of the main capacity (these are COPIES on top of the
+        # node segments, so they must be bounded and visible: stats()
+        # reports assembled_bytes so operators can size pod memory as
+        # capacity_mb × 1.25).  Cleared wholesale on eviction (entries may
+        # reference evicted nodes).
+        self._assembled: "OrderedDict[Tuple[int, ...], KVSegment]" = (
+            OrderedDict())
+        self._assembled_bytes = 0
+        self._assembled_cap_bytes = max(1, capacity_bytes // 4)
+        # stats (monotonic except bytes/entries, which track residency)
+        self.bytes = 0
+        self.entries = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.lookups = 0
+        self.inserted_tokens = 0
+        self.hit_tokens = 0
+
+    # ------------------------------------------------------------- lookup
+    def match(self, ids: List[int]) -> PrefixMatch:
+        """Longest cached prefix of ``ids``, capped at ``len(ids) - 1``
+        tokens (the engine must prefill at least one token for logits) and
+        snapped down to a chunk boundary.  Touches the matched path's LRU
+        clocks.  Returns assembled host K/V ready for
+        ``Generator._restore_kv_rows``."""
+        max_chunks = max(0, (len(ids) - 1) // self.chunk)
+        with self._lock:
+            self._tick += 1
+            self.lookups += 1
+            node, depth, path = self._root, 0, []
+            while depth < max_chunks:
+                key = tuple(ids[depth * self.chunk:(depth + 1) * self.chunk])
+                child = node.children.get(key)
+                if child is None:
+                    break
+                child.last_used = self._tick
+                path.append(child)
+                node, depth = child, depth + 1
+            if not path:
+                self.misses += 1
+                return PrefixMatch(0, None)
+            self.hits += 1
+            n = depth * self.chunk
+            self.hit_tokens += n
+            key = tuple(p.uid for p in path)
+            kv = self._assembled.get(key)
+            if kv is not None:
+                self._assembled.move_to_end(key)
+                return PrefixMatch(n, kv, key)
+            segs = [p.kv for p in path]  # node segments are immutable
+        # assemble OUTSIDE the lock: a long-prefix concatenate is real
+        # memcpy work and must not stall the engine thread's insert (or
+        # whoever else is looking up) behind it
+        kv = [
+            {k: np.concatenate([seg[li][k] for seg in segs], axis=0)
+             for k in segs[0][li]}
+            for li in range(len(segs[0]))
+        ]
+        nbytes = _segment_bytes(kv)
+        with self._lock:
+            if key not in self._assembled:
+                self._assembled[key] = kv
+                self._assembled_bytes += nbytes
+                while (self._assembled_bytes > self._assembled_cap_bytes
+                       and len(self._assembled) > 1):
+                    _, old = self._assembled.popitem(last=False)
+                    self._assembled_bytes -= _segment_bytes(old)
+        return PrefixMatch(n, kv, key)
+
+    def snap(self, n_tokens: int) -> int:
+        """Largest cacheable boundary ≤ ``n_tokens`` (chunk multiple)."""
+        return (n_tokens // self.chunk) * self.chunk
+
+    # ------------------------------------------------------------- insert
+    def insert(self, ids: List[int], start: int, kv: KVSegment) -> int:
+        """Store the KV segment covering token positions ``[start, start +
+        seg_len)`` of ``ids``; both ``start`` and ``seg_len`` must be chunk
+        multiples and the path ``[0, start)`` must already be cached (the
+        server extracts exactly ``[match.length, snap(len(ids)))``).
+        Idempotent: chunks another request already inserted are skipped
+        (their LRU clocks are touched).  Returns newly cached tokens."""
+        if not kv:
+            return 0
+        seg_len = kv[0][next(iter(kv[0]))].shape[0]
+        if start % self.chunk or seg_len % self.chunk:
+            raise ValueError(
+                f"insert not chunk-aligned: start={start} len={seg_len} "
+                f"chunk={self.chunk}")
+        if start + seg_len > len(ids):
+            raise ValueError(f"segment [{start}, {start + seg_len}) exceeds "
+                             f"prompt length {len(ids)}")
+        with self._lock:
+            self._tick += 1
+            node = self._walk_locked(ids, start)
+            if node is None:
+                # the [0, start) path was evicted between match and insert
+                # (possible under pressure) — nothing to attach to; skip
+                # rather than cache a prefix unreachable from the root
+                return 0
+            new_tokens = 0
+            for d in range(start // self.chunk,
+                           (start + seg_len) // self.chunk):
+                key = tuple(ids[d * self.chunk:(d + 1) * self.chunk])
+                child = node.children.get(key)
+                if child is None:
+                    lo = d * self.chunk - start
+                    seg = [{k: np.ascontiguousarray(a[lo:lo + self.chunk])
+                            for k, a in layer.items()} for layer in kv]
+                    child = _Node(key, node, seg, _segment_bytes(seg))
+                    node.children[key] = child
+                    self.bytes += child.nbytes
+                    self.entries += 1
+                    new_tokens += self.chunk
+                child.last_used = self._tick
+                node = child
+            if new_tokens:
+                self.inserted_tokens += new_tokens
+                self._evict_locked()
+            return new_tokens
+
+    def _walk_locked(self, ids: List[int], upto: int) -> Optional[_Node]:
+        node = self._root
+        for d in range(upto // self.chunk):
+            node = node.children.get(
+                tuple(ids[d * self.chunk:(d + 1) * self.chunk]))
+            if node is None:
+                return None
+            node.last_used = self._tick
+        return node
+
+    def _evict_locked(self) -> None:
+        """Drop least-recently-used leaves until under capacity.  A leaf's
+        last_used is ≥ its ancestors' only along *its own* path, so interior
+        nodes become leaves (and candidates) as their subtrees drain."""
+        n_evicted = 0
+        while self.bytes > self.capacity_bytes:
+            leaf = None
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                if n.children:
+                    stack.extend(n.children.values())
+                elif n is not self._root and (
+                        leaf is None or n.last_used < leaf.last_used):
+                    leaf = n
+            if leaf is None:
+                break  # a single over-cap chunk: keep it, nothing smaller
+            leaf.parent.children.pop(leaf.key)
+            self.bytes -= leaf.nbytes
+            self.entries -= 1
+            self.evictions += 1
+            n_evicted += 1
+        if n_evicted:
+            self._assembled.clear()
+            self._assembled_bytes = 0
+            log.info("prefix cache evicted %d chunk(s) (%d tokens), "
+                     "%.1f MB resident", n_evicted, n_evicted * self.chunk,
+                     self.bytes / 1e6)
+            if self._on_evict is not None:
+                self._on_evict(n_evicted)
+
+    # -------------------------------------------------------------- admin
+    def clear(self) -> None:
+        with self._lock:
+            self._root = _Node((), None, None, 0)
+            self._assembled.clear()
+            self._assembled_bytes = 0
+            self.bytes = 0
+            self.entries = 0
+
+    def stats(self) -> Dict[str, object]:
+        """Snapshot for ``/props`` and the bench: config + live counters."""
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {
+                "enabled": True,
+                "chunk_tokens": self.chunk,
+                "capacity_mb": round(self.capacity_bytes / (1024 * 1024), 3),
+                "resident_bytes": self.bytes,
+                "assembled_bytes": self._assembled_bytes,
+                "entries": self.entries,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / lookups) if lookups else 0.0,
+                "evictions": self.evictions,
+                "cached_tokens_served": self.hit_tokens,
+                "inserted_tokens": self.inserted_tokens,
+            }

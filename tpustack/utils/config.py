@@ -119,8 +119,11 @@ def _default_of(field: dataclasses.Field) -> Any:
 
 def enable_compile_cache(default_dir: Optional[str] = None) -> Optional[str]:
     """Turn on JAX's persistent compilation cache, honouring
-    ``JAX_COMPILATION_CACHE_DIR`` (the env contract the serving manifests
-    set — e.g. ``cluster-config/apps/sd15-api/deployment.yaml:79``).
+    ``TPUSTACK_COMPILE_CACHE`` (the stack's own env contract, what the
+    serving manifests set on their PVC-backed cache volume) and, as a
+    fallback, the upstream ``JAX_COMPILATION_CACHE_DIR`` spelling — so a
+    pod restart (or a rescheduled node) reuses every compiled program
+    instead of paying the multi-minute cold jit again.
 
     For CLI tools the env var is usually unset and jax may already be
     imported, so this applies the config programmatically.  ``default_dir``
@@ -136,7 +139,8 @@ def enable_compile_cache(default_dir: Optional[str] = None) -> Optional[str]:
         default_dir = os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))), ".cache", "xla")
-    cache = os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_dir
+    cache = (os.environ.get("TPUSTACK_COMPILE_CACHE")
+             or os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_dir)
     try:
         os.makedirs(cache, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache)
