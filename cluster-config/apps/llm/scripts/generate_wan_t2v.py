@@ -12,6 +12,11 @@ deployment, and writes an ``index.html`` gallery.  Differences, all fixes:
   pointed at a deployment its manifests never shipped (SURVEY.md §2.6).
 - If the server does not advertise ``SaveWEBM`` (no ffmpeg in the image), the
   client falls back to animated WebP instead of failing mid-batch.
+- Resilience-aware: 429 (backpressure) and 503 (drain / transient device
+  error) responses retry with exponential backoff + jitter, honouring the
+  server's ``Retry-After`` hint; ``--run-name`` pins the output directory so
+  a restarted batch Job resumes — items whose outputs already exist are
+  skipped without a submit.
 - stdlib-only, like the reference.
 """
 
@@ -88,13 +93,55 @@ def build_graph(*, prompt, negative, seed, width, height, frames, steps, cfg,
 
 
 # ------------------------------------------------------------------- http/k8s
-def get_json(base_url, path, payload=None, timeout=30):
+#: statuses the server's resilience layer asks us to retry: 429 carries a
+#: Retry-After from its observed p50 service time, 503 means draining (a
+#: replacement pod is coming) or a transient device error
+RETRY_STATUSES = (429, 503)
+MAX_RETRY_SLEEP_S = 120.0
+
+
+def retry_delay_s(attempt, retry_after, backoff_s=0.5, jitter=0.25,
+                  rng=random):
+    """Server ``Retry-After`` when present, else exponential backoff —
+    jittered so restarted batch Jobs don't herd onto a draining server."""
+    try:
+        base = float(retry_after) if retry_after is not None else None
+    except ValueError:
+        base = None
+    if base is None:
+        base = backoff_s * (2 ** attempt)
+    base = min(base, MAX_RETRY_SLEEP_S)
+    return base + rng.uniform(0, jitter * base)
+
+
+def get_json(base_url, path, payload=None, timeout=30, retries=0):
     url = urllib.parse.urljoin(base_url, path)
     data = json.dumps(payload).encode() if payload is not None else None
     headers = {"Content-Type": "application/json"} if data else {}
-    req = urllib.request.Request(url, data=data, headers=headers)
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.loads(resp.read().decode())
+    for attempt in range(retries + 1):
+        req = urllib.request.Request(url, data=data, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code not in RETRY_STATUSES or attempt == retries:
+                raise
+            delay = retry_delay_s(attempt, e.headers.get("Retry-After"))
+            print(f"  server said {e.code} "
+                  f"(Retry-After={e.headers.get('Retry-After', '-')}); "
+                  f"retrying in {delay:.1f}s")
+            time.sleep(delay)
+        except urllib.error.URLError:
+            # connection errors retry only for idempotent GETs: a POSTed
+            # /prompt may have been ACCEPTED before the socket died, and a
+            # blind resubmit would queue a duplicate multi-minute video.
+            # (429/503 HTTPErrors above are safe to retry on POST — the
+            # server refused the work, nothing was queued.)
+            if data is not None or attempt == retries:
+                raise
+            delay = retry_delay_s(attempt, None)
+            print(f"  connection error; retrying in {delay:.1f}s")
+            time.sleep(delay)
 
 
 def server_reachable(base_url):
@@ -146,10 +193,28 @@ def preflight(base_url, unet, clip, vae):
     return info
 
 
-def submit(base_url, graph, client_id):
+def _done_marker(run_dir: Path, prefix: str) -> Path:
+    return run_dir / f".{prefix}.done"
+
+
+def already_done(run_dir: Path, prefix: str) -> list:
+    """Outputs an earlier (interrupted) run fully produced for this item —
+    the resume contract: prefixes are deterministic per item index, and a
+    ``.<prefix>.done`` marker is written only after EVERY file of the item
+    downloaded, so a crash between a multi-output item's files (e.g.
+    ``--format both``) re-runs the item instead of silently dropping the
+    missing output."""
+    if not run_dir.is_dir() or not _done_marker(run_dir, prefix).is_file():
+        return []
+    return sorted(p for p in run_dir.glob(f"{prefix}_*")
+                  if p.is_file() and p.stat().st_size > 0)
+
+
+def submit(base_url, graph, client_id, retries=4):
     try:
         resp = get_json(base_url, "/prompt",
-                        payload={"prompt": graph, "client_id": client_id})
+                        payload={"prompt": graph, "client_id": client_id},
+                        retries=retries)
     except urllib.error.HTTPError as e:
         # surface the server's JSON error body, not just "400 Bad Request"
         try:
@@ -165,10 +230,14 @@ def submit(base_url, graph, client_id):
     return resp["prompt_id"]
 
 
-def wait_for_result(base_url, prompt_id, timeout=3600, poll=5):
+def wait_for_result(base_url, prompt_id, timeout=3600, poll=5, retries=4):
+    # the client spends nearly all its wall time here — a transient
+    # connection blip mid-rolling-update must not abandon a multi-minute
+    # video the server is still finishing (polling is an idempotent GET)
     deadline = time.time() + timeout
     while time.time() < deadline:
-        hist = get_json(base_url, f"/history/{prompt_id}", timeout=30)
+        hist = get_json(base_url, f"/history/{prompt_id}", timeout=30,
+                        retries=retries)
         entry = hist.get(prompt_id)
         if entry and entry.get("status", {}).get("completed"):
             status = entry["status"]
@@ -190,7 +259,7 @@ def result_files(entry):
     return files
 
 
-def download(base_url, file_info, dest_dir: Path) -> Path:
+def download(base_url, file_info, dest_dir: Path, retries=4) -> Path:
     params = urllib.parse.urlencode({
         "filename": file_info["filename"],
         "subfolder": file_info.get("subfolder", ""),
@@ -198,8 +267,17 @@ def download(base_url, file_info, dest_dir: Path) -> Path:
     url = urllib.parse.urljoin(base_url, "/view") + "?" + params
     dest_dir.mkdir(parents=True, exist_ok=True)
     dest = dest_dir / file_info["filename"]
-    with urllib.request.urlopen(url, timeout=120) as resp:
-        dest.write_bytes(resp.read())
+    for attempt in range(retries + 1):
+        try:
+            with urllib.request.urlopen(url, timeout=120) as resp:
+                dest.write_bytes(resp.read())
+            return dest
+        except urllib.error.URLError:
+            if attempt == retries:
+                raise
+            delay = retry_delay_s(attempt, None)
+            print(f"  download blip; retrying in {delay:.1f}s")
+            time.sleep(delay)
     return dest
 
 
@@ -253,6 +331,14 @@ def main(argv=None):
                     help="In-graph latent batch (EmptyHunyuanLatentVideo "
                          "batch_size): one graph yields B videos stacked "
                          "along the frame axis, row i seeded seed+i.")
+    ap.add_argument("--run-name", default=None,
+                    help="Subdirectory under --output-dir (default: a "
+                         "timestamp).  Pin it (the batch Job does) so a "
+                         "restarted run resumes: items whose outputs "
+                         "already exist are skipped.")
+    ap.add_argument("--retries", type=int, default=4,
+                    help="Retries per request on 429/503/connection errors, "
+                         "honouring Retry-After (default: 4).")
     args = ap.parse_args(argv)
 
     want_webm = args.mode == "video" and args.format in ("webm", "both")
@@ -263,8 +349,8 @@ def main(argv=None):
     rng = random.SystemRandom()
     seeds = [rng.randrange(0, 2**63) if args.seed is None else args.seed + i
              for i in range(args.count)]
-    run_dir = (Path(args.output_dir).expanduser().resolve()
-               / datetime.now().strftime("%Y%m%d_%H%M%S"))
+    run_name = args.run_name or datetime.now().strftime("%Y%m%d_%H%M%S")
+    run_dir = Path(args.output_dir).expanduser().resolve() / run_name
     run_dir.mkdir(parents=True, exist_ok=True)
 
     pf_proc = None
@@ -290,6 +376,12 @@ def main(argv=None):
         client_id = f"cli-{rng.randrange(0, 1_000_000)}"
         for i, seed in enumerate(seeds, start=1):
             prefix = ("wan_t2v" if args.mode == "video" else "wan_t2i") + f"_{i:02d}"
+            done = already_done(run_dir, prefix)
+            if done:
+                print(f"[{i}/{args.count}] {prefix} already has "
+                      f"{len(done)} output(s) — skipping (resume)")
+                saved.extend(done)
+                continue
             graph = build_graph(
                 prompt=args.prompt, negative=args.negative, seed=seed,
                 width=args.width, height=args.height, frames=frames,
@@ -300,15 +392,19 @@ def main(argv=None):
                 save_webp=want_webp, save_images=want_images,
                 batch_size=args.batch_size)
             print(f"[{i}/{args.count}] queueing (seed={seed})...")
-            pid = submit(args.server_url, graph, client_id)
-            entry = wait_for_result(args.server_url, pid)
+            pid = submit(args.server_url, graph, client_id,
+                         retries=args.retries)
+            entry = wait_for_result(args.server_url, pid,
+                                    retries=args.retries)
             files = result_files(entry)
             if not files:
                 raise RuntimeError("No output files in history response.")
             for f in files:
-                dest = download(args.server_url, f, run_dir)
+                dest = download(args.server_url, f, run_dir,
+                                retries=args.retries)
                 saved.append(dest)
                 print(f"  saved: {dest}")
+            _done_marker(run_dir, prefix).touch()  # item fully downloaded
     finally:
         if pf_proc is not None:
             pf_proc.terminate()

@@ -10,12 +10,22 @@ use (ref L32,35), the ``--steps`` default matches its help text (ref L50),
 and a summary line reports aggregate samples/sec at the end.
 
 Also runs in-cluster as a Flux-reconciled Job (``cluster-config/jobs/
-batch-generate.yaml``), the north-star deployment mode.
+batch-generate.yaml``), the north-star deployment mode.  Two behaviors make
+a restarted Job idempotent against the server's resilience layer:
+
+- **retry with backoff + jitter** — 429 (backpressure) and 503 (draining /
+  transient device error) responses are retried, honouring the server's
+  ``Retry-After`` hint when present and exponential backoff with jitter
+  otherwise; connection errors (the pod is mid-rollout) retry the same way.
+- **resume** — an output file that already exists (non-empty) is skipped
+  without a request, so a Job restarted after SIGTERM/preemption only pays
+  for the images it has not produced yet (``--no-resume`` disables).
 """
 
 from __future__ import annotations
 
 import argparse
+import random
 import sys
 import threading
 import time
@@ -25,6 +35,28 @@ from pathlib import Path
 import requests
 
 DEFAULT_URL = "http://127.0.0.1:30800/generate"
+
+#: statuses worth retrying: backpressure, and draining/transient-error 503
+RETRY_STATUSES = (429, 503)
+#: never sleep longer than this between attempts, whatever the server hints
+MAX_RETRY_SLEEP_S = 120.0
+
+
+def retry_delay_s(attempt: int, retry_after: str | None,
+                  backoff_s: float = 0.5, jitter: float = 0.25,
+                  rng=random) -> float:
+    """Delay before retry ``attempt`` (0-based): the server's ``Retry-After``
+    when it sent one, else exponential backoff — both with proportional
+    jitter so a restarted batch Job doesn't thundering-herd a draining
+    server."""
+    try:
+        base = float(retry_after) if retry_after is not None else None
+    except ValueError:
+        base = None
+    if base is None:
+        base = backoff_s * (2 ** attempt)
+    base = min(base, MAX_RETRY_SLEEP_S)
+    return base + rng.uniform(0, jitter * base)
 
 
 _tls = threading.local()
@@ -50,11 +82,40 @@ def _thread_session() -> requests.Session:
     return _tls.session
 
 
-def _one_request(url: str, payload: dict, target: Path, name: str) -> bool:
+def _post_with_retries(url: str, payload: dict, name: str,
+                       retries: int = 5) -> requests.Response:
+    """POST with shed/drain-aware retries: 429/503 honour ``Retry-After``
+    (exponential backoff + jitter otherwise) and connection errors retry
+    the same way — a rolling update's drain window looks like both."""
+    last_exc: Exception | None = None
+    for attempt in range(retries + 1):
+        try:
+            resp = _thread_session().post(url, json=payload, timeout=600)
+        except requests.exceptions.ConnectionError as e:
+            last_exc = e
+            if attempt == retries:
+                raise
+            delay = retry_delay_s(attempt, None)
+            print(f"    {name}: connection error, retrying in {delay:.1f}s")
+            time.sleep(delay)
+            continue
+        if resp.status_code in RETRY_STATUSES and attempt < retries:
+            delay = retry_delay_s(attempt, resp.headers.get("Retry-After"))
+            print(f"    {name}: server said {resp.status_code} "
+                  f"(Retry-After={resp.headers.get('Retry-After', '-')}), "
+                  f"retrying in {delay:.1f}s")
+            time.sleep(delay)
+            continue
+        resp.raise_for_status()
+        return resp
+    raise last_exc or RuntimeError("retries exhausted")
+
+
+def _one_request(url: str, payload: dict, target: Path, name: str,
+                 retries: int = 5) -> bool:
     counter = _progress_counter()
     try:
-        resp = _thread_session().post(url, json=payload, timeout=600)
-        resp.raise_for_status()
+        resp = _post_with_retries(url, payload, name, retries=retries)
         target.write_bytes(resp.content)
         gen_time = resp.headers.get("X-Gen-Time", "?")
         print(f"    {name} done in {gen_time}")
@@ -74,7 +135,8 @@ def _one_request(url: str, payload: dict, target: Path, name: str) -> bool:
 
 def generate(prompt: str, steps: int, url: str, out_dir: Path, prefix: str,
              count: int, delay: float, width: int | None = None,
-             height: int | None = None, concurrency: int = 1) -> int:
+             height: int | None = None, concurrency: int = 1,
+             resume: bool = True, retries: int = 5) -> int:
     out_dir.mkdir(parents=True, exist_ok=True)
     ok = 0
     t_start = time.time()
@@ -92,23 +154,34 @@ def generate(prompt: str, steps: int, url: str, out_dir: Path, prefix: str,
     # request completes before the next is sent; --delay paces completions).
     from concurrent.futures import ThreadPoolExecutor
 
+    skipped = 0
     with ThreadPoolExecutor(max_workers=max(1, concurrency)) as pool:
         futs = []
         for idx in range(1, count + 1):
             name = f"{prefix}_{idx:02d}.png"
-            print(f"[*] Generating {name} -> {out_dir / name}")
+            target = out_dir / name
+            if resume and target.is_file() and target.stat().st_size > 0:
+                # idempotent Job restarts: output already on the volume
+                print(f"[*] {name} already exists — skipping (resume)")
+                skipped += 1
+                continue
+            print(f"[*] Generating {name} -> {target}")
             futs.append(pool.submit(_one_request, url, dict(payload),
-                                    out_dir / name, name))
+                                    target, name, retries))
             if concurrency == 1:
                 futs[-1].result()  # sequential: finish before the next send
             if delay > 0 and idx != count:
                 time.sleep(delay)
-        ok = sum(f.result() for f in futs)
+        ok = skipped + sum(f.result() for f in futs)
 
     wall = time.time() - t_start
-    if ok:
-        print(f"[*] {ok}/{count} images in {wall:.1f}s "
-              f"({ok / wall:.3f} samples/sec)")
+    made = ok - skipped  # the BASELINE samples/sec metric must count only
+    if made:             # images actually generated THIS run, not resumes
+        print(f"[*] {ok}/{count} images ({made} generated, {skipped} "
+              f"resumed) in {wall:.1f}s ({made / wall:.3f} samples/sec)")
+    elif ok:
+        print(f"[*] {ok}/{count} images already present (resume) — "
+              "nothing generated")
     else:
         print("[*] Generation loop finished (all requests failed).")
     return ok
@@ -135,6 +208,13 @@ def main(argv: list[str]) -> int:
     parser.add_argument("--concurrency", type=int, default=1,
                         help="in-flight requests; >1 lets the server micro-"
                              "batch them across its chips (default: 1)")
+    parser.add_argument("--retries", type=int, default=5,
+                        help="retries per image on 429/503/connection "
+                             "errors, honouring Retry-After (default: 5)")
+    parser.add_argument("--no-resume", action="store_true",
+                        help="regenerate outputs that already exist instead "
+                             "of skipping them (resume is the default so a "
+                             "restarted Job is idempotent)")
     args = parser.parse_args(argv)
 
     # TPUSTACK_METRICS_PORT (batch-generate.yaml sets 9100): expose client-
@@ -151,7 +231,8 @@ def main(argv: list[str]) -> int:
     out_dir = Path(args.out_dir)
     ok = generate(args.prompt, args.steps, args.url, out_dir, args.prefix,
                   args.count, args.delay, args.width, args.height,
-                  concurrency=args.concurrency)
+                  concurrency=args.concurrency, resume=not args.no_resume,
+                  retries=args.retries)
     print(f"All done. Images saved under {out_dir.resolve()}")
     return 0 if ok == args.count else 1
 

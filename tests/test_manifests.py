@@ -411,6 +411,83 @@ def test_persistent_compile_cache_wired_into_serving_pods():
     assert "TPUSTACK_COMPILE_CACHE" in text
 
 
+# ------------------------------------------------------------ resilience
+def _import_lint_manifests():
+    import sys
+
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import lint_manifests
+    finally:
+        sys.path.pop(0)
+    return lint_manifests
+
+
+def test_manifest_lint_green():
+    assert _import_lint_manifests().lint() == []
+
+
+def test_manifest_lint_cli_green():
+    """Shell the lint exactly the way CI/operators do (same pattern as
+    tools/lint_metrics.py): a workload missing probes/resources/grace must
+    fail `python tools/lint_manifests.py` itself."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_manifests.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "cluster-config OK" in proc.stdout
+
+
+def test_manifest_lint_catches_violations(tmp_path):
+    """A Deployment with no probes, no cpu/memory resources, and a grace
+    period shorter than its declared drain budget trips every rule."""
+    bad = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "bad", "namespace": "x"},
+        "spec": {"template": {"spec": {
+            "terminationGracePeriodSeconds": 10,
+            "containers": [{
+                "name": "srv",
+                "env": [{"name": "TPUSTACK_DRAIN_TIMEOUT_S",
+                         "value": "30"}],
+                "resources": {"limits": {"google.com/tpu": 1}},
+            }],
+        }}},
+    }
+    (tmp_path / "bad.yaml").write_text(yaml.safe_dump(bad))
+    errors = _import_lint_manifests().lint(root=tmp_path)
+    joined = "\n".join(errors)
+    for frag in ("readinessProbe", "livenessProbe", "requests.cpu",
+                 "limits.memory", "preStop", "SIGKILL the pod mid-drain"):
+        assert frag in joined, (frag, joined)
+
+
+def test_serving_deployments_declare_drain_contract():
+    """All three serving Deployments: drain env present, readiness on
+    /readyz, liveness on /healthz, preStop hook, and a grace period that
+    covers preStop + drain (the SIGKILL-mid-drain guard)."""
+    serving = [CLUSTER / "apps" / "llm" / "deployment.yaml",
+               CLUSTER / "apps" / "llm" / "wan-deployment.yaml",
+               CLUSTER / "apps" / "sd15-api" / "deployment.yaml"]
+    for p in serving:
+        dep = next(d for d in _load_all(p) if d.get("kind") == "Deployment")
+        spec = dep["spec"]["template"]["spec"]
+        server = spec["containers"][0]
+        env = {e["name"]: e.get("value") for e in server.get("env", [])}
+        drain = float(env["TPUSTACK_DRAIN_TIMEOUT_S"])
+        assert float(env["TPUSTACK_REQUEST_TIMEOUT_S"]) > 0, p
+        assert int(env["TPUSTACK_MAX_QUEUE_DEPTH"]) > 0, p
+        assert float(env["TPUSTACK_WATCHDOG_S"]) > 0, p
+        assert server["readinessProbe"]["httpGet"]["path"] == "/readyz", p
+        assert server["livenessProbe"]["httpGet"]["path"] == "/healthz", p
+        assert "startupProbe" in server, p
+        assert server["lifecycle"]["preStop"], p
+        assert spec["terminationGracePeriodSeconds"] >= drain + 5, p
+
+
 def test_llm_prefix_cache_knobs_declared():
     """The LLM Deployment pins the prefix-KV-cache contract explicitly so
     operators see (and can tune) it in IaC, not just in code defaults."""

@@ -34,9 +34,12 @@ def test_rest_contract(server, monkeypatch):
         client = TestClient(TestServer(server.build_app()))
         await client.start_server()
         try:
-            # healthz (configmap.yaml:60-62 parity)
+            # healthz (configmap.yaml:60-62 parity on the "ok" field; the
+            # resilience layer adds drain/watchdog state alongside it)
             r = await client.get("/healthz")
-            assert r.status == 200 and await r.json() == {"ok": True}
+            body = await r.json()
+            assert r.status == 200 and body["ok"] is True
+            assert body["state"] == "serving"
 
             # /last before any generate → 404 (configmap.yaml:80-84)
             r = await client.get("/last")

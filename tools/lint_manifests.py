@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Manifest lint: every workload in ``cluster-config/`` declares the
+production-resilience basics the serving stack depends on.
+
+``tools/lint_metrics.py`` keeps the metric namespace coherent; this is the
+same idea for IaC.  A Deployment without probes serves traffic while dead,
+a workload without cpu/memory limits can OOM the single node out from
+under the TPU, and a grace period shorter than the drain budget means
+Kubernetes SIGKILLs the pod mid-drain — exactly the failure
+``TPUSTACK_DRAIN_TIMEOUT_S`` exists to prevent.  Rules:
+
+- **Every workload container** (Deployment, DaemonSet, Job, CronJob,
+  JobSet) declares ``resources.requests`` and ``resources.limits`` with
+  both ``cpu`` and ``memory`` — an accelerator limit alone does not stop a
+  runaway host allocation.  Init containers are exempt (short-lived fetch
+  helpers serialized before the workload).
+- **Every Deployment** declares startup-or-readiness + liveness probes on
+  its serving (first) container and a pod-level
+  ``terminationGracePeriodSeconds``.
+- **Drain consistency**: a container that sets
+  ``TPUSTACK_DRAIN_TIMEOUT_S`` must have a ``preStop`` hook (endpoint
+  propagation) and a grace period covering ``preStop (5s) + drain``.
+
+Vendored upstream files (the Flux toolkit export) are skipped — we lint
+what we author.  Runs standalone (``python tools/lint_manifests.py``,
+exit 1 on violations) and inside the tier-1 suite
+(``tests/test_manifests.py`` imports ``lint()``), the same pattern as
+``tools/lint_metrics.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+from typing import List
+
+import yaml
+
+REPO = Path(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: vendored upstream manifests we do not author (flux install --export)
+SKIP_FILES = ("cluster/flux-system/gotk-components.yaml",)
+
+#: seconds the preStop sleep holds before SIGTERM (endpoint propagation)
+PRESTOP_GRACE_S = 5
+
+WORKLOAD_KINDS = ("Deployment", "DaemonSet", "Job", "CronJob", "JobSet")
+
+
+def _pod_templates(doc):
+    """Yield every pod template a workload doc carries."""
+    kind = doc.get("kind")
+    if kind in ("Deployment", "DaemonSet", "Job"):
+        yield doc["spec"]["template"]
+    elif kind == "CronJob":
+        yield doc["spec"]["jobTemplate"]["spec"]["template"]
+    elif kind == "JobSet":
+        for rj in doc["spec"].get("replicatedJobs", []):
+            yield rj["template"]["spec"]["template"]
+
+
+def _env_value(container, name):
+    for e in container.get("env", []) or []:
+        if e.get("name") == name and "value" in e:
+            return e["value"]
+    return None
+
+
+def _check_resources(where: str, container, errors: List[str]) -> None:
+    res = container.get("resources") or {}
+    for section in ("requests", "limits"):
+        block = res.get(section) or {}
+        for unit in ("cpu", "memory"):
+            if unit not in block:
+                errors.append(f"{where}: container {container.get('name')!r} "
+                              f"missing resources.{section}.{unit}")
+
+
+def _check_deployment(where: str, doc, errors: List[str]) -> None:
+    tmpl = doc["spec"]["template"]
+    spec = tmpl["spec"]
+    server = (spec.get("containers") or [{}])[0]
+    # startupProbe may carry the cold-compile window, but readiness and
+    # liveness are unconditional: without them a draining or hung pod
+    # keeps receiving traffic / never restarts
+    for probe in ("readinessProbe", "livenessProbe"):
+        if probe not in server:
+            errors.append(f"{where}: serving container missing {probe}")
+    grace = spec.get("terminationGracePeriodSeconds")
+    if grace is None:
+        errors.append(f"{where}: pod template missing "
+                      "terminationGracePeriodSeconds")
+
+
+def _check_drain_consistency(where: str, doc, errors: List[str]) -> None:
+    for tmpl in _pod_templates(doc):
+        spec = tmpl.get("spec", {})
+        grace = spec.get("terminationGracePeriodSeconds")
+        for container in spec.get("containers", []) or []:
+            drain = _env_value(container, "TPUSTACK_DRAIN_TIMEOUT_S")
+            if drain is None:
+                continue
+            linger = _env_value(container, "TPUSTACK_DRAIN_LINGER_S") or 0
+            need = float(drain) + float(linger) + PRESTOP_GRACE_S
+            if not (container.get("lifecycle") or {}).get("preStop"):
+                errors.append(
+                    f"{where}: TPUSTACK_DRAIN_TIMEOUT_S set but no preStop "
+                    "hook (readiness flip needs endpoint propagation time)")
+            if grace is None or float(grace) < need:
+                errors.append(
+                    f"{where}: terminationGracePeriodSeconds ({grace}) < "
+                    f"preStop {PRESTOP_GRACE_S}s + drain {drain}s — "
+                    "kubernetes would SIGKILL the pod mid-drain")
+
+
+def lint(root: Path = None) -> List[str]:
+    """Return a list of violation strings (empty = clean)."""
+    root = Path(root) if root is not None else REPO / "cluster-config"
+    errors: List[str] = []
+    for path in sorted(root.rglob("*.yaml")):
+        rel = path.relative_to(root).as_posix()
+        if rel in SKIP_FILES:
+            continue
+        with open(path) as f:
+            try:
+                docs = [d for d in yaml.safe_load_all(f) if d]
+            except yaml.YAMLError as e:
+                errors.append(f"{rel}: unparseable YAML: {e}")
+                continue
+        for doc in docs:
+            if not isinstance(doc, dict) or doc.get("kind") not in WORKLOAD_KINDS:
+                continue
+            where = f"{rel}/{doc.get('kind')}/{doc['metadata'].get('name')}"
+            for tmpl in _pod_templates(doc):
+                for container in (tmpl.get("spec", {}).get("containers")
+                                  or []):
+                    _check_resources(where, container, errors)
+            if doc.get("kind") == "Deployment":
+                _check_deployment(where, doc, errors)
+            _check_drain_consistency(where, doc, errors)
+    return errors
+
+
+def main() -> int:
+    errors = lint()
+    if errors:
+        for e in errors:
+            print(f"lint_manifests: {e}", file=sys.stderr)
+        print(f"lint_manifests: {len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    print("lint_manifests: cluster-config OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

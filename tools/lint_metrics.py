@@ -39,6 +39,8 @@ UNIT_SUFFIXES = (
     "seconds", "bytes", "ratio", "celsius", "info",
     # count units (dimensionless gauges/histograms say what they count)
     "depth", "slots", "tokens", "images", "requests", "entries", "prompts",
+    # enum gauges (value is a documented small-integer state machine)
+    "state",
 )
 _RESERVED_LABELS = {"le", "quantile"}
 

@@ -169,12 +169,21 @@ class ContinuousEngine:
     """
 
     def __init__(self, gen: Generator, slots: int = 8, chunk: int = 32,
-                 stop_tokens: Tuple[int, ...] = (), depth: int = 2):
+                 stop_tokens: Tuple[int, ...] = (), depth: int = 2,
+                 on_progress: Optional[Callable[[str], None]] = None):
         self.gen = gen
         self.B = slots
         self.chunk = chunk
         self.stop_tokens = stop_tokens
         self.depth = depth
+        # resilience hook (tpustack.serving.resilience): called with
+        # "prefill" immediately before an admission dispatch and "wave"
+        # after each chunk-block fetch — the wave boundaries at which drain
+        # quiesces, the watchdog measures progress, and faults inject.
+        # Runs on the engine thread; an exception raised from the "prefill"
+        # point (injected transient device error) aborts the run through
+        # the server's existing engine-failure path.
+        self._on_progress = on_progress
         self._to_park: List[int] = []  # retirements awaiting a fused park
         self._pending: List[_PendingWave] = []
         self._retired_tokens = 0
@@ -231,6 +240,8 @@ class ContinuousEngine:
             valid.append((i, req, budget))
         if not valid:
             return gen_ctr
+        if self._on_progress is not None:
+            self._on_progress("prefill")
 
         # group by prefill bucket: a 16-token prompt must not pay a 16k
         # peer's padded prefill (the engine admits ANY prompt that fits ctx
@@ -563,6 +574,8 @@ class ContinuousEngine:
                 self._resolve_pending(state, slots,
                                       needed_slots=pending_here)
             block = np.asarray(block)
+            if self._on_progress is not None:
+                self._on_progress("wave")
             fetch_marks.append((time.time(), self._retired_tokens + sum(
                 len(s.out) for s in slots if s.req is not None)))
             live = self._live(slots)

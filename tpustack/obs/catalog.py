@@ -120,6 +120,33 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "OOM) and degraded to per-row serial dispatch.",
                unit="total"),
 
+    # ---- resilience layer (tpustack.serving.resilience; all three servers) ----
+    MetricSpec("tpustack_serving_drain_state", "gauge",
+               "Lifecycle: 0 serving, 1 draining (SIGTERM received, "
+               "finishing in-flight work), 2 drained (about to exit).",
+               ("server",), unit="state"),
+    MetricSpec("tpustack_requests_shed_total", "counter",
+               "Work refused at admission, by reason (backpressure 429 | "
+               "draining 503).  Both responses carry Retry-After.",
+               ("server", "reason"), unit="total"),
+    MetricSpec("tpustack_deadline_exceeded_total", "counter",
+               "Requests cancelled at their deadline (504), by the phase "
+               "they died in (queued|decode|denoise).",
+               ("server", "phase"), unit="total"),
+    MetricSpec("tpustack_watchdog_stalls_total", "counter",
+               "Watchdog detections of in-flight work with no wave "
+               "progress — each flips liveness so kubernetes restarts "
+               "the pod.", ("server",), unit="total"),
+    MetricSpec("tpustack_retry_after_seconds", "gauge",
+               "Last Retry-After hint handed to a shed client: p50 "
+               "service time scaled by queue depth over capacity.",
+               ("server",), unit="seconds"),
+    MetricSpec("tpustack_faults_injected_total", "counter",
+               "Deterministic TPUSTACK_FAULT_* injections fired, by kind "
+               "(slow_prefill|device_error|dispatch_hang|sigterm).  "
+               "Nonzero outside a chaos drill is a config bug.",
+               ("server", "kind"), unit="total"),
+
     # ---- batch clients (scripts/batch_generate.py via the Job sidecar) ----
     MetricSpec("tpustack_batch_generate_requests_total", "counter",
                "batch_generate client requests, by outcome (ok|failed).",

@@ -26,6 +26,14 @@ single fused XLA program (UMT5 → CFG flow-matching loop → causal-3D-VAE
 decode) from ``WanPipeline``.  Intermediate latents never round-trip to the
 host, which is precisely what a node-per-op executor cannot avoid.
 Graphs wired outside this shape are rejected with a clear error.
+
+Resilience (``tpustack.serving.resilience``): SIGTERM drains — /prompt
+refuses with 503 + Retry-After while the worker publishes every accepted
+prompt, then the process exits 0; ``TPUSTACK_MAX_QUEUE_DEPTH`` sheds with
+429; a queued prompt past its deadline (``TPUSTACK_REQUEST_TIMEOUT_S`` or
+body ``timeout_s``) is answered through /history instead of wasting a
+dispatch; ``TPUSTACK_WATCHDOG_S`` flips ``/healthz`` (liveness) when a
+dispatch hangs; ``GET /readyz`` is the readiness probe endpoint.
 """
 
 from __future__ import annotations
@@ -49,6 +57,7 @@ from tpustack.obs import Trace
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import http as obs_http
+from tpustack.serving.resilience import ResilienceManager
 from tpustack.utils import get_logger
 from tpustack.utils.image import array_to_png
 
@@ -652,9 +661,37 @@ class GraphServer:
         self._running: List[str] = []  # dispatched, not yet finalized
         self._no_batch: set = set()  # signatures whose batched build failed
         self._lock = threading.Lock()
+        self.max_batch = max(1, int(os.environ.get("WAN_MAX_BATCH", "4")))
+        # per-prompt absolute deadlines (monotonic); the worker refuses to
+        # start a prompt past its deadline (phase=queued) — there is no
+        # long-lived HTTP request to 504, so the verdict lands in /history
+        self._deadline_at: Dict[str, float] = {}
+        # shared resilience layer: drain on SIGTERM, queued-prompt
+        # deadlines, 429 backpressure, hung-dispatch watchdog, TPUSTACK_
+        # FAULT_* hooks.  /prompt answers immediately, so drain must wait
+        # on the worker's accepted-but-unfinished prompts, not on open
+        # HTTP requests
+        # observe_http=False: /prompt answers in ~1ms while the prompt runs
+        # minutes — Retry-After must come from real submit→publish times,
+        # fed in _finalize, or shed clients would be told to retry in ~1s
+        self.resilience = ResilienceManager(
+            "graph", registry, concurrency=self.max_batch,
+            queue_depth=self._queue.qsize,
+            extra_busy=self._graph_busy, observe_http=False,
+            expected_service_s=60.0)  # video prompts run minutes, and the
+        # cold-start seed must say so before the first publish is observed
+        self._t_submit: Dict[str, float] = {}
         self._worker = threading.Thread(target=self._work, daemon=True,
                                         name="wan-graph-worker")
         self._worker.start()
+
+    def _graph_busy(self) -> bool:
+        """Accepted work the drain loop must wait for: queued, planned, or
+        dispatched-but-unpublished prompts."""
+        with self._lock:
+            if self._running or self._pending:
+                return True
+        return not self._queue.empty()
 
     # ---- worker
     def _work(self):
@@ -667,7 +704,7 @@ class GraphServer:
         upcoming dispatch signature is COLD (a multi-minute full-size XLA
         build), the previous wave is published FIRST so finished prompts
         never sit unpublished behind a compile (ADVICE r3)."""
-        max_batch = max(1, int(os.environ.get("WAN_MAX_BATCH", "4")))
+        max_batch = self.max_batch
         in_flight: List[Tuple] = []  # (pid, entry, outputs, finish)
         stop = False
         while not stop:
@@ -704,6 +741,22 @@ class GraphServer:
                     graph = self._pending.pop(pid, None)
                     self._running.append(pid)
                     entry = self._history[pid]
+                deadline = self._deadline_at.pop(pid, None)
+                if deadline is not None and time.monotonic() > deadline:
+                    # expired while queued: refuse to start it (its device
+                    # work would be wasted), publish the verdict in history
+                    self._t_submit.pop(pid, None)
+                    self.resilience.note_deadline("queued")
+                    self.metrics["tpustack_graph_prompts_total"].labels(
+                        status="error").inc()
+                    with self._lock:
+                        entry.status_str = "error"
+                        entry.messages.append(
+                            "DeadlineExceeded: request deadline exceeded "
+                            "(phase=queued)")
+                        entry.completed = True
+                        self._running.remove(pid)
+                    continue
                 specs: List[Tuple[SampleSpec, Frames]] = []
 
                 def hook(spec, specs=specs):
@@ -718,6 +771,7 @@ class GraphServer:
                                                             sample_hook=hook)
                 except Exception as e:  # noqa: BLE001 — via /history
                     log.exception("prompt %s failed", pid)
+                    self._t_submit.pop(pid, None)
                     self.metrics["tpustack_graph_prompts_total"].labels(
                         status="error").inc()
                     with self._lock:
@@ -735,6 +789,9 @@ class GraphServer:
                 in_flight = []
             for key, chunk in plan:
                 self._dispatch_one(key, chunk)
+                # prompt-wave boundary (worker thread): watchdog beat +
+                # the injected mid-request SIGTERM point
+                self.resilience.progress("wave")
             for f in in_flight:
                 self._finalize(*f)
             in_flight = [(pid, entry, outputs, finish)
@@ -789,6 +846,10 @@ class GraphServer:
         pipe = self.rt.pipeline()
         t0 = time.perf_counter()
         try:
+            # pre-dispatch progress point (worker thread): watchdog beat +
+            # TPUSTACK_FAULT_* slow-prefill / device-error / hang hooks; an
+            # injected error rides the existing dispatch-failure paths
+            self.resilience.progress("prefill")
             if len(members) == 1:
                 spec = members[0][0]
                 log.info("Sampling: %dx%d f=%d steps=%d cfg=%.1f "
@@ -851,6 +912,7 @@ class GraphServer:
 
     def _finalize(self, pid, entry, outputs, finish):
         """Run deferred saves (fetch + encode + write) and publish."""
+        self.resilience.beat()  # publishing is progress too
         tr = Trace()
         try:
             with tr.span("finalize"):
@@ -864,6 +926,11 @@ class GraphServer:
                 entry.completed = True
             self.metrics["tpustack_graph_prompts_total"].labels(
                 status="success").inc()
+            # the Retry-After basis: true submit→publish wall time
+            t_submit = self._t_submit.pop(pid, None)
+            if t_submit is not None:
+                self.resilience.observe_service_time(
+                    time.monotonic() - t_submit)
         except Exception as e:  # noqa: BLE001 — surfaced via /history
             log.exception("prompt %s failed", pid)
             self.metrics["tpustack_graph_prompts_total"].labels(
@@ -873,6 +940,7 @@ class GraphServer:
                 entry.messages.append(f"{type(e).__name__}: {e}")
                 entry.completed = True
         finally:
+            self._t_submit.pop(pid, None)  # error paths must not leak
             with self._lock:
                 if pid in self._running:
                     self._running.remove(pid)
@@ -880,6 +948,7 @@ class GraphServer:
 
     def shutdown(self):
         self._queue.put(None)
+        self.resilience.close()
 
     # ---- handlers
     async def queue_state(self, request: web.Request) -> web.Response:
@@ -913,12 +982,21 @@ class GraphServer:
                 return web.json_response(
                     {"error": f"unknown node class_type {ct!r} (node {nid})"},
                     status=400)
+        try:
+            deadline_s = self.resilience.deadline(body.get("timeout_s"))
+        except (TypeError, ValueError) as e:
+            rejected.labels(status="rejected").inc()
+            return web.json_response({"error": f"bad timeout_s: {e}"},
+                                     status=400)
         pid = str(uuid.uuid4())
         entry = HistoryEntry(prompt_id=pid,
                              client_id=str(body.get("client_id", "")))
         with self._lock:
             self._history[pid] = entry
             self._pending[pid] = graph
+        if deadline_s is not None:
+            self._deadline_at[pid] = time.monotonic() + deadline_s
+        self._t_submit[pid] = time.monotonic()
         self._queue.put(pid)
         self.metrics["tpustack_graph_queue_depth"].set(self._queue.qsize())
         return web.json_response({"prompt_id": pid, "number": len(self._history)})
@@ -942,12 +1020,25 @@ class GraphServer:
         return web.FileResponse(path)
 
     async def healthz(self, request: web.Request) -> web.Response:
-        return web.json_response({"ok": True})
+        """Liveness + worker state (503 only on a watchdog-declared hang)."""
+        with self._lock:
+            running, pending = len(self._running), len(self._pending)
+        status, payload = self.resilience.health_payload(extra={
+            "worker_alive": self._worker.is_alive(),
+            "running": running,
+            "pending": pending,
+        })
+        return web.json_response(payload, status=status)
+
+    async def readyz(self, request: web.Request) -> web.Response:
+        status, payload = self.resilience.ready_payload()
+        return web.json_response(payload, status=status)
 
     def build_app(self) -> web.Application:
         app = web.Application(
             client_max_size=4 << 20,
-            middlewares=[obs_http.instrument("graph", self._registry)])
+            middlewares=[obs_http.instrument("graph", self._registry),
+                         self.resilience.middleware({"/prompt"})])
         app.router.add_get("/queue", self.queue_state)
         app.router.add_get("/object_info", self.object_info)
         app.router.add_get("/metrics",
@@ -956,6 +1047,7 @@ class GraphServer:
         app.router.add_get("/history/{prompt_id}", self.history)
         app.router.add_get("/view", self.view)
         app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/readyz", self.readyz)
         return app
 
 
@@ -973,7 +1065,11 @@ def main() -> None:
     server = GraphServer()
     log.info("Wan graph server on :%d (models=%s, outputs=%s)",
              port, server.rt.models_dir, server.rt.output_dir)
-    web.run_app(server.build_app(), port=port, access_log=None)
+    # SIGTERM → graceful drain: stop accepting /prompt (503), let the
+    # worker publish every accepted prompt, exit 0 within the drain budget
+    server.resilience.install_signal_handlers()
+    web.run_app(server.build_app(), port=port, access_log=None,
+                handle_signals=False)
 
 
 if __name__ == "__main__":

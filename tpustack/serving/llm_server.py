@@ -40,7 +40,14 @@ prefill entirely; on by default, ``0`` disables;
 ``TPUSTACK_PREFIX_CACHE_CHUNK`` is the snap granularity in tokens,
 default 256; per-request opt-out via ``"cache_prompt": false`` in the
 body — llama.cpp's field name),
-``MODEL_DIR`` (HF safetensors), ``LLM_TOKENIZER_DIR``, ``PORT`` (8080).
+``MODEL_DIR`` (HF safetensors), ``LLM_TOKENIZER_DIR``, ``PORT`` (8080),
+plus the shared resilience contract (``tpustack.serving.resilience``):
+``TPUSTACK_DRAIN_TIMEOUT_S``, ``TPUSTACK_REQUEST_TIMEOUT_S`` (per-request
+body override ``timeout_s``), ``TPUSTACK_MAX_QUEUE_DEPTH``,
+``TPUSTACK_WATCHDOG_S`` and the ``TPUSTACK_FAULT_*`` injection knobs.
+``GET /healthz`` (liveness + engine state) and ``GET /readyz`` (readiness,
+503 while draining) carry the kubernetes probe contract; ``/health`` stays
+for llama.cpp client parity.
 """
 
 from __future__ import annotations
@@ -58,6 +65,9 @@ from aiohttp import web
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import http as obs_http
+from tpustack.serving.resilience import (DeadlineExceeded,
+                                         InjectedDeviceError,
+                                         ResilienceManager)
 from tpustack.utils import get_logger
 
 log = get_logger("serving.llm_server")
@@ -142,7 +152,8 @@ class _PendingCompletion:
     other)."""
 
     __slots__ = ("ids", "n_predict", "sample", "future", "cancel",
-                 "stream_put", "seed", "prefix", "kv_extract", "on_prefill_kv")
+                 "stream_put", "seed", "prefix", "kv_extract", "on_prefill_kv",
+                 "phase")
 
     def __init__(self, ids, n_predict, sample, future, stream_put=None,
                  seed=None, prefix=None, kv_extract=None, on_prefill_kv=None):
@@ -153,6 +164,9 @@ class _PendingCompletion:
         self.cancel = threading.Event()
         self.stream_put = stream_put
         self.seed = seed
+        # deadline reporting: "queued" until feed() hands the request to an
+        # engine slot, "decode" after — the phase a 504 names
+        self.phase = "queued"
         # prefix-KV-cache hooks (see tpustack.serving.prefix_cache): a hit
         # restores `prefix` into the slot's cache line; `kv_extract` +
         # `on_prefill_kv` hand the prefilled KV back for insertion
@@ -243,6 +257,12 @@ class LLMServer:
         # solo requests queued on the device lock; the engine stops
         # admitting while > 0 so the FIFO-fair lock can hand over
         self._solo_waiting = 0
+        # shared resilience layer: drain on SIGTERM, per-request deadlines,
+        # 429 backpressure, hung-dispatch watchdog, TPUSTACK_FAULT_* hooks
+        self.resilience = ResilienceManager(
+            "llm", registry, concurrency=self.max_batch,
+            queue_depth=lambda: len(self._queue) + self._solo_waiting,
+            expected_service_s=2.0)
 
     @staticmethod
     def _build_prefix_cache():
@@ -349,7 +369,8 @@ class LLMServer:
         self._wake.set()
 
     async def _enqueue_completion(self, ids, n_predict, sample, seed=None,
-                                  prefix_hooks=(None, None, None)):
+                                  prefix_hooks=(None, None, None),
+                                  deadline_s=None):
         loop = asyncio.get_running_loop()
         req = _PendingCompletion(ids, n_predict, sample, loop.create_future(),
                                  seed=seed, prefix=prefix_hooks[0],
@@ -357,7 +378,13 @@ class LLMServer:
                                  on_prefill_kv=prefix_hooks[2])
         await self._enqueue_raw(req)
         try:
-            return await req.future
+            return await asyncio.wait_for(req.future, deadline_s)
+        except asyncio.TimeoutError:
+            # deadline: the cancel event frees the slot at the engine's next
+            # chunk boundary (the existing cancelled() poll); report the
+            # phase the request died in
+            req.cancel.set()
+            raise DeadlineExceeded(req.phase) from None
         except asyncio.CancelledError:
             req.cancel.set()  # dropped if still queued; batch notices if all die
             raise
@@ -416,7 +443,8 @@ class LLMServer:
                 engine = ContinuousEngine(
                     self.gen, slots=self.max_batch,
                     chunk=self.engine_chunk,
-                    stop_tokens=(self.tok.eos_id,))
+                    stop_tokens=(self.tok.eos_id,),
+                    on_progress=self.resilience.progress)
 
                 def feed():
                     if self._solo_waiting > 0:
@@ -432,6 +460,7 @@ class LLMServer:
                         if r.cancel.is_set():
                             continue  # waiter already cancelled its future
                         handed.append(r)
+                        r.phase = "decode"  # now owns a slot (504 phase)
                         self.metrics["tpustack_llm_running_requests"].inc()
                         return self._slot_request(r, loop)
                     return None
@@ -475,9 +504,10 @@ class LLMServer:
 
     async def _complete_routed(self, prompt: str, n_predict: int,
                                temperature: float, top_k: int, seed,
-                               cache_prompt: bool = True):
+                               cache_prompt: bool = True, deadline_s=None):
         """(content, stats, stopped_eos) via the micro-batcher when eligible,
-        else the solo device path.  Raises ValueError for bad requests."""
+        else the solo device path.  Raises ValueError for bad requests and
+        DeadlineExceeded past ``deadline_s``."""
         from tpustack.models.llm_generate import SampleConfig
 
         ids = self.tok.encode(prompt)
@@ -489,12 +519,23 @@ class LLMServer:
         t_start = time.perf_counter()
         if not self._batchable():
             cancel = threading.Event()
+            started = {"v": False}  # device work began (vs queued on lock)
+
+            def solo_fn():
+                started["v"] = True
+                return self._solo_complete(ids, n_predict, temperature,
+                                           top_k, seed, cancel, prefix_hooks)
+
             self._solo_waiting += 1  # engine yields the lock at its next
             try:                     # chunk boundary (FIFO-fair handover)
-                content, stats, stopped_eos = await self._run_on_device(
-                    lambda: self._complete(ids, n_predict, temperature, top_k,
-                                           seed, False, cancel, prefix_hooks),
-                    cancel)
+                content, stats, stopped_eos = await asyncio.wait_for(
+                    self._run_on_device(solo_fn, cancel), deadline_s)
+            except asyncio.TimeoutError:
+                # wait_for already cancelled the awaiting task, which set
+                # ``cancel`` (via _run_on_device's teardown path) so the
+                # worker stops at its next chunk and the device lock frees
+                raise DeadlineExceeded(
+                    "decode" if started["v"] else "queued") from None
             finally:
                 self._solo_waiting -= 1
             self._observe_done(len(ids), stats, time.perf_counter() - t_start)
@@ -503,7 +544,8 @@ class LLMServer:
                               greedy=temperature <= 0)
         out_ids, stats = await self._enqueue_completion(ids, n_predict, sample,
                                                         seed=seed,
-                                                        prefix_hooks=prefix_hooks)
+                                                        prefix_hooks=prefix_hooks,
+                                                        deadline_s=deadline_s)
         if out_ids and out_ids[-1] == self.tok.eos_id:
             out_ids = out_ids[:-1]
             stopped_eos = True
@@ -563,6 +605,17 @@ class LLMServer:
             },
         }
 
+    def _solo_complete(self, ids, n_predict, temperature, top_k, seed,
+                       cancel, prefix_hooks):
+        """Solo worker (executor thread): report the dispatch progress point
+        (watchdog beat + fault hooks) then run the fused solo path."""
+        self.resilience.progress("prefill")
+        try:
+            return self._complete(ids, n_predict, temperature, top_k,
+                                  seed, False, cancel, prefix_hooks)
+        finally:
+            self.resilience.progress("wave")
+
     def _complete(self, ids, n_predict: int, temperature: float,
                   top_k: int, seed: Optional[int], greedy: bool,
                   cancel: Optional[threading.Event] = None,
@@ -574,13 +627,19 @@ class LLMServer:
         (the router already tokenised to decide batchability)."""
         from tpustack.models.llm_generate import SampleConfig
 
+        def chunk_check():
+            # polled once per fused chunk: a long-but-healthy solo run must
+            # keep beating the watchdog (the batched engine beats per wave)
+            self.resilience.beat()
+            return False if cancel is None else cancel.is_set()
+
         out_ids, stats = self.gen.generate_fused(
             ids, max_new_tokens=n_predict,
             sample=SampleConfig(temperature=temperature, top_k=top_k,
                                 greedy=greedy or temperature <= 0),
             seed=seed, stop_tokens=(self.tok.eos_id,),
             chunk=self.chunk,
-            cancel_check=None if cancel is None else cancel.is_set,
+            cancel_check=chunk_check,
             prefix=prefix_hooks[0], kv_extract=prefix_hooks[1],
             on_prefill_kv=prefix_hooks[2])
         if out_ids and out_ids[-1] == self.tok.eos_id:
@@ -596,7 +655,7 @@ class LLMServer:
 
     async def _stream(self, request: web.Request, prompt: str, n_predict: int,
                       temperature: float, top_k: int, seed, fmt: str,
-                      cache_prompt: bool = True):
+                      cache_prompt: bool = True, deadline_s=None):
         """SSE streaming shared by /completion (llama.cpp chunk shape) and
         /v1/chat/completions (OpenAI ``chat.completion.chunk`` + ``[DONE]``).
 
@@ -652,6 +711,7 @@ class LLMServer:
             cancel = threading.Event()
 
             def on_token(t):
+                self.resilience.beat()  # per-token progress (solo stream)
                 loop.call_soon_threadsafe(q.put_nowait, t)
                 if cancel.is_set():
                     raise _Cancelled()  # aborts generate in the worker thread
@@ -660,6 +720,7 @@ class LLMServer:
                 try:
                     if cancel.is_set():  # client died while we were queued:
                         raise _Cancelled()  # skip the whole prefill
+                    self.resilience.progress("prefill")
                     return self.gen.generate(
                         ids, max_new_tokens=n_predict,
                         sample=SampleConfig(temperature=temperature,
@@ -729,11 +790,28 @@ class LLMServer:
             locked_task.add_done_callback(
                 lambda t: setattr(self, "_solo_waiting",
                                   self._solo_waiting - 1))
+        t_deadline = (loop.time() + deadline_s) if deadline_s else None
         try:
             if fmt == "openai":
                 await send(chat_chunk({"role": "assistant", "content": ""}))
             while True:
-                tok = await q.get()
+                if t_deadline is None:
+                    tok = await q.get()
+                else:
+                    # per-request deadline mid-stream: a 504 status is no
+                    # longer possible (headers flushed), so the timeout
+                    # surfaces as a terminal error event below.  Converted
+                    # HERE so send()'s own 60s stalled-reader write timeout
+                    # keeps falling through to the cancel-and-raise path
+                    # instead of masquerading as a deadline
+                    try:
+                        tok = await asyncio.wait_for(
+                            q.get(), max(t_deadline - loop.time(), 0.001))
+                    except asyncio.TimeoutError:
+                        # batched requests track queued-vs-decode; the solo
+                        # worker starts immediately, so it is decoding
+                        raise DeadlineExceeded(
+                            req.phase if batched else "decode") from None
                 if tok is None:
                     break
                 if tok == self.tok.eos_id:
@@ -748,7 +826,7 @@ class LLMServer:
                     await send({"content": delta, "stop": False})
             try:
                 out_ids, stats = await locked_task
-            except ValueError as e:
+            except (ValueError, InjectedDeviceError) as e:
                 # stream already started: surface the error as a final event
                 if fmt == "openai":
                     await send(chat_chunk({}, finish="error") | {
@@ -757,6 +835,18 @@ class LLMServer:
                     await send({"content": "", "stop": True, "error": str(e)})
                 await resp.write_eof()
                 return resp
+        except DeadlineExceeded as e:
+            # the cancel event frees the engine slot at the next chunk
+            cancel.set()
+            self.resilience.note_deadline(e.phase)
+            msg = str(e)
+            if fmt == "openai":
+                await send(chat_chunk({}, finish="error") | {
+                    "error": {"message": msg}})
+            else:
+                await send({"content": "", "stop": True, "error": msg})
+            await resp.write_eof()
+            return resp
         except BaseException:
             # client gone / write timed out / handler cancelled: tell the
             # worker to stop at its next token; _run_on_device keeps holding
@@ -791,6 +881,26 @@ class LLMServer:
     async def health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    async def healthz(self, request: web.Request) -> web.Response:
+        """Liveness + engine state: 503 only when the watchdog declared a
+        hung dispatch (kubernetes then restarts the pod).  Draining pods
+        stay live — they are finishing in-flight work on purpose."""
+        status, payload = self.resilience.health_payload(extra={"engine": {
+            "model": self.model_name,
+            "slots": self.max_batch,
+            "chunk": self.engine_chunk,
+            "queue_depth": len(self._queue),
+            "solo_waiting": self._solo_waiting,
+            "prefix_cache": self.prefix_cache is not None,
+        }})
+        return web.json_response(payload, status=status)
+
+    async def readyz(self, request: web.Request) -> web.Response:
+        """Readiness: 503 from the moment drain begins, so the endpoint
+        leaves Service rotation while in-flight completions finish."""
+        status, payload = self.resilience.ready_payload()
+        return web.json_response(payload, status=status)
+
     async def props(self, request: web.Request) -> web.Response:
         """Server properties + live prefix-cache config/stats, so operators
         can verify the cache (enabled, chunk, capacity, hit rate) without
@@ -822,6 +932,7 @@ class LLMServer:
             n_predict = int(_or_default(body.get("n_predict"), 128))
             temperature = float(_or_default(body.get("temperature"), 0.8))
             top_k = int(_or_default(body.get("top_k"), 40))
+            deadline_s = self.resilience.deadline(body.get("timeout_s"))
         except (TypeError, ValueError) as e:
             self._reject("bad_parameter")
             return web.json_response({"error": f"invalid parameter: {e}"}, status=400)
@@ -835,15 +946,22 @@ class LLMServer:
         if body.get("stream"):
             return await self._stream(request, prompt, n_predict, temperature,
                                       top_k, seed, fmt="llamacpp",
-                                      cache_prompt=cache_prompt)
+                                      cache_prompt=cache_prompt,
+                                      deadline_s=deadline_s)
 
         t0 = time.time()
         try:
             content, stats, stopped_eos = await self._complete_routed(
                 prompt, n_predict, temperature, top_k, seed,
-                cache_prompt=cache_prompt)
+                cache_prompt=cache_prompt, deadline_s=deadline_s)
         except ValueError as e:  # e.g. prompt longer than the context window
             return web.json_response({"error": str(e)}, status=400)
+        except DeadlineExceeded as e:
+            self.resilience.note_deadline(e.phase)
+            return web.json_response({"error": str(e), "phase": e.phase},
+                                     status=504)
+        except InjectedDeviceError as e:
+            return self.resilience.transient_error_response(e)
         log.info("completion: %d prompt tok, %d gen tok, %.2fs",
                  stats["prompt_tokens"], stats["generated_tokens"], time.time() - t0)
         return web.json_response(self._final_payload(stats, stopped_eos, content))
@@ -872,6 +990,7 @@ class LLMServer:
         try:
             n_predict = int(_or_default(body.get("max_tokens"), 128))
             temperature = float(_or_default(body.get("temperature"), 0.8))
+            deadline_s = self.resilience.deadline(body.get("timeout_s"))
         except (TypeError, ValueError) as e:
             return web.json_response(
                 {"error": {"message": f"invalid parameter: {e}"}}, status=400)
@@ -879,15 +998,22 @@ class LLMServer:
         if body.get("stream"):
             return await self._stream(request, prompt, n_predict, temperature,
                                       40, _normalize_seed(body.get("seed")),
-                                      fmt="openai", cache_prompt=cache_prompt)
+                                      fmt="openai", cache_prompt=cache_prompt,
+                                      deadline_s=deadline_s)
 
         try:
             content, stats, stopped_eos = await self._complete_routed(
                 prompt, n_predict, temperature, 40,
                 _normalize_seed(body.get("seed")),
-                cache_prompt=cache_prompt)
+                cache_prompt=cache_prompt, deadline_s=deadline_s)
         except ValueError as e:
             return web.json_response({"error": {"message": str(e)}}, status=400)
+        except DeadlineExceeded as e:
+            self.resilience.note_deadline(e.phase)
+            return web.json_response(
+                {"error": {"message": str(e)}, "phase": e.phase}, status=504)
+        except InjectedDeviceError as e:
+            return self.resilience.transient_error_response(e)
         return web.json_response({
             "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
@@ -907,8 +1033,12 @@ class LLMServer:
 
     def build_app(self) -> web.Application:
         app = web.Application(
-            middlewares=[obs_http.instrument("llm", self._registry)])
+            middlewares=[obs_http.instrument("llm", self._registry),
+                         self.resilience.middleware(
+                             {"/completion", "/v1/chat/completions"})])
         app.router.add_get("/health", self.health)
+        app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/readyz", self.readyz)
         app.router.add_get("/props", self.props)
         app.router.add_get("/metrics",
                            obs_http.make_metrics_handler(self._registry))
@@ -925,7 +1055,12 @@ def main() -> None:
     enable_compile_cache()  # JAX_COMPILATION_CACHE_DIR or <repo>/.cache/xla
     port = int(os.environ.get("PORT", "8080"))
     server = LLMServer()
-    web.run_app(server.build_app(), port=port, access_log=None)
+    # our SIGTERM handler drains (readiness 503, in-flight work finishes,
+    # exit 0); handle_signals=False keeps aiohttp's own immediate-stop
+    # SIGTERM handler from racing it
+    server.resilience.install_signal_handlers()
+    web.run_app(server.build_app(), port=port, access_log=None,
+                handle_signals=False)
 
 
 if __name__ == "__main__":

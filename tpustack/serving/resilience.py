@@ -1,0 +1,502 @@
+"""Production resilience layer shared by the three serving apps.
+
+Kubernetes *will* inflict failures on a single-node TPU stack: rolling
+updates SIGTERM the pod mid-decode, overload grows unbounded queues until
+the OOM killer wins, and a wedged TPU dispatch leaves a pod Ready-but-dead
+forever.  This module gives ``llm_server``, ``sd_server`` and
+``graph_server`` one shared answer:
+
+- **Graceful drain** — SIGTERM flips readiness to 503 and stops admitting
+  work; in-flight requests finish at their natural wave/batch boundaries;
+  the process exits 0 once idle or after ``TPUSTACK_DRAIN_TIMEOUT_S``.
+- **Per-request deadlines** — ``TPUSTACK_REQUEST_TIMEOUT_S`` (body override
+  ``timeout_s``); a request past its deadline is cancelled (its engine slot
+  frees at the next chunk boundary via the existing ``cancelled()`` poll)
+  and answered 504 with the phase it died in.
+- **Bounded admission with backpressure** — ``TPUSTACK_MAX_QUEUE_DEPTH``
+  caps waiting work; excess requests get 429 with a ``Retry-After``
+  computed from the observed p50 service time scaled by queue depth, so
+  clients back off proportionally to real load instead of hammering.
+- **Watchdog** — a monitor thread flips liveness (``/healthz`` → 503) when
+  there is in-flight work but no wave progress for ``TPUSTACK_WATCHDOG_S``,
+  so Kubernetes restarts a pod whose TPU dispatch hung.
+- **Deterministic fault injection** — ``TPUSTACK_FAULT_*`` env knobs insert
+  a dispatch hang, a slow prefill, a one-shot transient device error, or a
+  mid-request SIGTERM at exact dispatch/wave counts, so every behavior
+  above is testable on CPU in tier-1.
+
+Env knobs (all optional; defaults are production-shaped):
+
+=============================== ======= ====================================
+``TPUSTACK_DRAIN_TIMEOUT_S``    30      max seconds to wait for in-flight
+                                        work after SIGTERM before exiting
+``TPUSTACK_REQUEST_TIMEOUT_S``  600     default per-request deadline
+                                        (0 disables; body ``timeout_s``
+                                        overrides per request)
+``TPUSTACK_MAX_QUEUE_DEPTH``    64      waiting-work cap before shedding
+                                        with 429 (0 disables)
+``TPUSTACK_WATCHDOG_S``         0       no-progress seconds before liveness
+                                        flips (0 disables; set it above the
+                                        worst cold-compile dispatch, and
+                                        rely on the persistent XLA cache)
+``TPUSTACK_FAULT_SLOW_PREFILL_S``   0   sleep injected before every device
+                                        dispatch
+``TPUSTACK_FAULT_DEVICE_ERROR_NTH`` 0   the Nth dispatch raises a one-shot
+                                        :class:`InjectedDeviceError`
+``TPUSTACK_FAULT_HANG_NTH``     0       the Nth dispatch hangs for
+                                        ``TPUSTACK_FAULT_HANG_S`` (3600)
+``TPUSTACK_FAULT_SIGTERM_AFTER``    0   begin drain after the Nth completed
+                                        wave (mid-request SIGTERM)
+=============================== ======= ====================================
+
+The servers report *progress points* into the layer
+(:meth:`ResilienceManager.progress`): ``"prefill"`` immediately before a
+device dispatch (admission prefill for the LLM engine, the fused program
+dispatch for sd/graph) and ``"wave"`` at each wave/batch boundary (chunk
+fetch, batch completion, prompt dispatch).  Points both feed the watchdog
+(a beat) and give the fault injector its deterministic hooks.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import signal
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+from tpustack.obs import catalog as obs_catalog
+from tpustack.utils import get_logger
+
+log = get_logger("serving.resilience")
+
+#: drain states, exported as ``tpustack_serving_drain_state``
+SERVING, DRAINING, DRAINED = 0, 1, 2
+_STATE_NAMES = {SERVING: "serving", DRAINING: "draining", DRAINED: "drained"}
+
+
+class InjectedDeviceError(RuntimeError):
+    """The transient device error the fault injector raises at a dispatch
+    point.  Handlers map it to 503 + ``Retry-After`` so clients retry —
+    the same contract a real transient XLA/runtime error should get."""
+
+
+class DeadlineExceeded(Exception):
+    """A request blew its deadline; ``phase`` is where it died."""
+
+    def __init__(self, phase: str):
+        super().__init__(f"request deadline exceeded (phase={phase})")
+        self.phase = phase
+
+
+def _env_float(env, name: str, default: float) -> float:
+    try:
+        return float(env.get(name, "") or default)
+    except ValueError:
+        raise ValueError(f"{name}={env.get(name)!r} is not a number")
+
+
+def _env_int(env, name: str, default: int) -> int:
+    try:
+        return int(env.get(name, "") or default)
+    except ValueError:
+        raise ValueError(f"{name}={env.get(name)!r} is not an integer")
+
+
+class FaultInjector:
+    """Deterministic failure injection, keyed on dispatch/wave counts.
+
+    All knobs are exact: "the Nth dispatch errors", not "errors with
+    probability p" — tier-1 tests must reproduce byte-for-byte.  Counters
+    are process-wide per injector instance and thread-safe (dispatch points
+    fire from engine/executor threads)."""
+
+    def __init__(self, env=None):
+        env = os.environ if env is None else env
+        self.slow_prefill_s = _env_float(env, "TPUSTACK_FAULT_SLOW_PREFILL_S", 0.0)
+        self.device_error_nth = _env_int(env, "TPUSTACK_FAULT_DEVICE_ERROR_NTH", 0)
+        self.hang_nth = _env_int(env, "TPUSTACK_FAULT_HANG_NTH", 0)
+        self.hang_s = _env_float(env, "TPUSTACK_FAULT_HANG_S", 3600.0)
+        self.sigterm_after = _env_int(env, "TPUSTACK_FAULT_SIGTERM_AFTER", 0)
+        #: set by the manager so an injected SIGTERM takes the exact code
+        #: path the real signal handler takes; standalone default is a real
+        #: kernel signal to our own pid
+        self.sigterm_cb: Callable[[], None] = (
+            lambda: os.kill(os.getpid(), signal.SIGTERM))
+        #: metrics hook (kind -> counted); set by the manager
+        self.on_inject: Optional[Callable[[str], None]] = None
+        self._lock = threading.Lock()
+        self.dispatches = 0
+        self.waves = 0
+        self._sigterm_fired = False
+
+    @property
+    def active(self) -> bool:
+        return bool(self.slow_prefill_s or self.device_error_nth
+                    or self.hang_nth or self.sigterm_after)
+
+    def _note(self, kind: str) -> None:
+        log.warning("fault injected: %s (dispatch=%d wave=%d)", kind,
+                    self.dispatches, self.waves)
+        if self.on_inject is not None:
+            self.on_inject(kind)
+
+    def point(self, name: str) -> None:
+        """Fire the faults registered for progress point ``name``.
+
+        ``"prefill"`` (immediately before a device dispatch): slow-prefill
+        sleep, then the counted one-shot device error / hang.  ``"wave"``
+        (a wave/batch boundary passed): the counted mid-request SIGTERM.
+        May sleep or raise — callers invoke it from worker threads, never
+        the event loop."""
+        if name == "prefill":
+            with self._lock:
+                self.dispatches += 1
+                n = self.dispatches
+            if self.slow_prefill_s > 0:
+                self._note("slow_prefill")
+                time.sleep(self.slow_prefill_s)
+            if self.hang_nth and n == self.hang_nth:
+                self._note("dispatch_hang")
+                time.sleep(self.hang_s)
+            if self.device_error_nth and n == self.device_error_nth:
+                self._note("device_error")
+                raise InjectedDeviceError(
+                    f"injected transient device error at dispatch {n}")
+        elif name == "wave":
+            fire = False
+            with self._lock:
+                self.waves += 1
+                if (self.sigterm_after and not self._sigterm_fired
+                        and self.waves >= self.sigterm_after):
+                    self._sigterm_fired = fire = True
+            if fire:
+                self._note("sigterm")
+                self.sigterm_cb()
+
+
+class ResilienceManager:
+    """One per server process: drain state machine + watchdog + admission
+    control + deadline bookkeeping, exported through the obs catalog.
+
+    Servers construct it with callables describing their own queueing
+    (``queue_depth``: requests waiting for capacity; ``extra_busy``:
+    server-side work the HTTP in-flight counter cannot see, e.g. the graph
+    worker's accepted-but-unfinished prompts) and wire three integration
+    points: the aiohttp :meth:`middleware` on their work endpoints,
+    :meth:`progress` at dispatch/wave boundaries, and the
+    ``/healthz``/``/readyz`` payload helpers."""
+
+    def __init__(self, server: str, registry=None, *, concurrency: int = 1,
+                 queue_depth: Optional[Callable[[], int]] = None,
+                 extra_busy: Optional[Callable[[], bool]] = None,
+                 on_exit: Optional[Callable[[int], None]] = None,
+                 env=None, fault: Optional[FaultInjector] = None,
+                 observe_http: bool = True,
+                 expected_service_s: float = 1.0):
+        env = os.environ if env is None else env
+        self.server = server
+        # accept-and-poll servers (graph /prompt answers in ~1ms while the
+        # work runs minutes) pass observe_http=False and feed real
+        # completion times via observe_service_time themselves — otherwise
+        # Retry-After would be computed from the accept handler's wall time
+        self._observe_http = observe_http
+        # the Retry-After p50 until the first real observation: a cold
+        # server shedding multi-minute work must not tell clients "retry in
+        # seconds" before it has ever completed anything
+        self.expected_service_s = max(0.001, expected_service_s)
+        self.metrics = obs_catalog.build(registry)
+        self.concurrency = max(1, concurrency)
+        self.drain_timeout_s = _env_float(env, "TPUSTACK_DRAIN_TIMEOUT_S", 30.0)
+        # accept-and-poll servers (graph): keep serving reads for this long
+        # AFTER the last accepted prompt publishes, so clients polling
+        # /history can still fetch their results before the process exits
+        self.drain_linger_s = _env_float(env, "TPUSTACK_DRAIN_LINGER_S", 0.0)
+        self.request_timeout_s = _env_float(env, "TPUSTACK_REQUEST_TIMEOUT_S",
+                                            600.0)
+        self.max_queue_depth = _env_int(env, "TPUSTACK_MAX_QUEUE_DEPTH", 64)
+        self.watchdog_s = _env_float(env, "TPUSTACK_WATCHDOG_S", 0.0)
+        self.fault = fault if fault is not None else FaultInjector(env)
+        self.fault.sigterm_cb = self.begin_drain
+        self.fault.on_inject = (
+            lambda kind: self.metrics["tpustack_faults_injected_total"]
+            .labels(server=self.server, kind=kind).inc())
+        self.on_exit = on_exit if on_exit is not None else self._default_exit
+        self._queue_depth = queue_depth
+        self._extra_busy = extra_busy
+        self._lock = threading.Lock()
+        # drain entry is guarded by a NON-BLOCKING one-shot, not self._lock:
+        # the SIGTERM handler runs on the main thread between bytecodes and
+        # may interrupt the middleware while it holds self._lock — a
+        # blocking acquire there would deadlock the event loop forever
+        self._drain_once = threading.Lock()
+        self._state = SERVING
+        self._hung = False
+        self._inflight = 0
+        self._last_beat = time.monotonic()
+        self._service_times: deque = deque(maxlen=64)
+        self._drain_thread: Optional[threading.Thread] = None
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self.metrics["tpustack_serving_drain_state"].labels(
+            server=server).set(SERVING)
+        if self.watchdog_s > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name=f"tpustack-watchdog-{server}")
+            self._watchdog_thread.start()
+
+    # ------------------------------------------------------------- lifecycle
+    @staticmethod
+    def _default_exit(code: int) -> None:
+        # os._exit: the drain already waited for in-flight work; a hung
+        # flush/atexit must not let the pod outlive its grace period
+        log.info("drain complete — exiting %d", code)
+        os._exit(code)
+
+    @property
+    def state(self) -> int:
+        return self._state
+
+    @property
+    def state_name(self) -> str:
+        return _STATE_NAMES[self._state]
+
+    @property
+    def draining(self) -> bool:
+        return self._state != SERVING
+
+    @property
+    def hung(self) -> bool:
+        return self._hung
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM → drain.  Only callable from the main thread (python
+        signal contract); servers call it in ``main()`` and pass
+        ``handle_signals=False`` to ``web.run_app`` so aiohttp's own
+        immediate-stop SIGTERM handler never races ours.
+
+        The handler itself only sets an Event: python signal handlers run
+        on the main thread between bytecodes, possibly mid-critical-
+        section, so they must never take a lock another frame of the SAME
+        thread could be holding (metrics, thread bookkeeping).  A
+        pre-started arm thread does the actual drain work."""
+        self._sigterm_event = threading.Event()
+        threading.Thread(
+            target=lambda: (self._sigterm_event.wait(), self.begin_drain()),
+            daemon=True, name=f"tpustack-sigterm-arm-{self.server}").start()
+        try:
+            signal.signal(signal.SIGTERM,
+                          lambda signum, frame: self._sigterm_event.set())
+        except ValueError:  # pragma: no cover - non-main thread
+            log.warning("not in main thread; SIGTERM drain handler not "
+                        "installed")
+
+    def busy(self) -> bool:
+        if self._inflight > 0:
+            return True
+        if self._extra_busy is not None and self._extra_busy():
+            return True
+        return False
+
+    def begin_drain(self) -> None:
+        """Flip to DRAINING and start the drain waiter.  Thread-safe,
+        idempotent, and NON-BLOCKING — callable from a signal handler (main
+        thread, possibly mid-critical-section), the fault injector's wave
+        hook (engine thread), or a test."""
+        if not self._drain_once.acquire(blocking=False):
+            return  # a drain is already running (or ran)
+        self._state = DRAINING
+        self._drain_thread = threading.Thread(
+            target=self._drain_loop, daemon=True,
+            name=f"tpustack-drain-{self.server}")
+        self.metrics["tpustack_serving_drain_state"].labels(
+            server=self.server).set(DRAINING)
+        log.warning("SIGTERM/drain: refusing new work, waiting up to %.0fs "
+                    "for in-flight requests", self.drain_timeout_s)
+        self._drain_thread.start()
+
+    def _drain_loop(self) -> None:
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline and self.busy():
+            time.sleep(0.02)
+        clean = not self.busy()
+        if clean and self.drain_linger_s > 0:
+            # work is published but poll-based clients may not have fetched
+            # it yet — keep the read surface (GET /history, /view) alive
+            log.info("drain: lingering %.0fs for result pickup",
+                     self.drain_linger_s)
+            time.sleep(self.drain_linger_s)
+        self._state = DRAINED
+        self.metrics["tpustack_serving_drain_state"].labels(
+            server=self.server).set(DRAINED)
+        if clean:
+            log.info("drained cleanly (no in-flight work)")
+        else:
+            log.error("drain timeout after %.0fs with work still in flight",
+                      self.drain_timeout_s)
+        self.on_exit(0)
+
+    def close(self) -> None:
+        """Stop the watchdog thread (tests construct many managers)."""
+        self._watchdog_stop.set()
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.join(timeout=2)
+
+    # -------------------------------------------------------------- watchdog
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+
+    def progress(self, point: str) -> None:
+        """Report a progress point from a worker thread: beats the
+        watchdog, then fires any injected fault registered at that point
+        (a hang injected here therefore starves subsequent beats — exactly
+        the failure the watchdog exists to catch)."""
+        self.beat()
+        self.fault.point(point)
+
+    def beat_age_s(self) -> float:
+        return time.monotonic() - self._last_beat
+
+    def _watchdog_loop(self) -> None:
+        poll = max(0.01, min(1.0, self.watchdog_s / 4.0))
+        while not self._watchdog_stop.wait(poll):
+            if self._hung:
+                continue
+            if self.busy() and self.beat_age_s() > self.watchdog_s:
+                self._hung = True
+                self.metrics["tpustack_watchdog_stalls_total"].labels(
+                    server=self.server).inc()
+                log.error("watchdog: no wave progress for %.1fs with work "
+                          "in flight — flipping liveness so kubernetes "
+                          "restarts the pod", self.beat_age_s())
+
+    # ---------------------------------------------------- admission control
+    def queue_depth(self) -> int:
+        if self._queue_depth is not None:
+            return self._queue_depth()
+        # default: work requests beyond serving capacity are "queued"
+        return max(0, self._inflight - self.concurrency)
+
+    def observe_service_time(self, seconds: float) -> None:
+        self._service_times.append(seconds)
+
+    def retry_after_s(self) -> int:
+        """p50 service time scaled by how many service periods the current
+        queue represents — a client retrying after this has a real chance
+        of admission instead of re-shedding."""
+        p50 = (statistics.median(self._service_times)
+               if self._service_times else self.expected_service_s)
+        periods = (self.queue_depth() + 1) / self.concurrency
+        ra = min(max(1, math.ceil(p50 * periods)), 120)
+        self.metrics["tpustack_retry_after_seconds"].labels(
+            server=self.server).set(ra)
+        return ra
+
+    def admission_check(self):
+        """None to admit, or a ready 503 (draining) / 429 (backpressure)
+        ``web.Response`` carrying ``Retry-After``."""
+        from aiohttp import web
+
+        if self.draining:
+            self.metrics["tpustack_requests_shed_total"].labels(
+                server=self.server, reason="draining").inc()
+            return web.json_response(
+                {"error": "server draining (shutting down)"}, status=503,
+                headers={"Retry-After": str(self.retry_after_s())})
+        if self.max_queue_depth and self.queue_depth() >= self.max_queue_depth:
+            self.metrics["tpustack_requests_shed_total"].labels(
+                server=self.server, reason="backpressure").inc()
+            return web.json_response(
+                {"error": "queue full, retry later"}, status=429,
+                headers={"Retry-After": str(self.retry_after_s())})
+        return None
+
+    def middleware(self, work_paths):
+        """aiohttp middleware gating POSTs to ``work_paths``: sheds under
+        drain/backpressure, counts in-flight work (what drain waits on),
+        and feeds completed-request wall time into the p50 the Retry-After
+        hint is computed from."""
+        from aiohttp import web
+
+        work_paths = frozenset(work_paths)
+
+        @web.middleware
+        async def resilience_middleware(request, handler):
+            if request.method != "POST" or request.path not in work_paths:
+                return await handler(request)
+            shed = self.admission_check()
+            if shed is not None:
+                return shed
+            self.beat()  # arriving work arms the watchdog from "now"
+            with self._lock:
+                self._inflight += 1
+            t0 = time.perf_counter()
+            try:
+                resp = await handler(request)
+                if resp.status < 400 and self._observe_http:
+                    self.observe_service_time(time.perf_counter() - t0)
+                return resp
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+
+        return resilience_middleware
+
+    # -------------------------------------------------------------- deadlines
+    def deadline(self, override=None) -> Optional[float]:
+        """Effective per-request timeout in seconds (None = no deadline).
+        ``override`` is the request-body value; 0/negative disables."""
+        if override is not None:
+            t = float(override)
+        else:
+            t = self.request_timeout_s
+        return t if t > 0 else None
+
+    def note_deadline(self, phase: str) -> None:
+        self.metrics["tpustack_deadline_exceeded_total"].labels(
+            server=self.server, phase=phase).inc()
+        log.warning("request deadline exceeded in phase=%s", phase)
+
+    def transient_error_response(self, exc: Exception):
+        """503 + Retry-After for a transient device error — clients retry
+        instead of treating the blip as a hard failure."""
+        from aiohttp import web
+
+        return web.json_response(
+            {"error": f"transient device error: {exc}"}, status=503,
+            headers={"Retry-After": str(self.retry_after_s())})
+
+    # ---------------------------------------------------------- health views
+    def health_payload(self, extra: Optional[Dict] = None) -> Tuple[int, Dict]:
+        """Liveness view: 503 only when the watchdog declared the process
+        hung (draining pods stay live — restarting a draining pod would
+        kill the in-flight work drain exists to protect)."""
+        payload = {
+            "ok": not self._hung,
+            "state": self.state_name,
+            "hung": self._hung,
+            "inflight": self._inflight,
+            "queue_depth": self.queue_depth(),
+            "watchdog": {
+                "enabled": self.watchdog_s > 0,
+                "timeout_s": self.watchdog_s,
+                "last_progress_age_s": round(self.beat_age_s(), 3),
+            },
+            "drain_timeout_s": self.drain_timeout_s,
+            "request_timeout_s": self.request_timeout_s,
+            "max_queue_depth": self.max_queue_depth,
+        }
+        if extra:
+            payload.update(extra)
+        return (503 if self._hung else 200), payload
+
+    def ready_payload(self) -> Tuple[int, Dict]:
+        """Readiness view: 503 the moment drain begins, so the endpoint
+        drops out of Service rotation while in-flight work finishes."""
+        ready = not self.draining and not self._hung
+        return (200 if ready else 503), {"ready": ready,
+                                         "state": self.state_name}

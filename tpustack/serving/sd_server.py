@@ -29,7 +29,14 @@ Env flags (mirroring the reference's env contract, deployment.yaml:43-53):
 ``MODEL_DIR`` (diffusers safetensors snapshot; random weights if unset),
 ``SD15_PRESET`` (``sd15``|``tiny``), ``PORT``, ``SD15_TOKENIZER_DIR``,
 ``SD15_DP`` (dp mesh size), ``SD15_BATCH_WINDOW_MS`` (batch collection
-window, default 15), ``SD15_MAX_BATCH`` (default dp×fsdp or 1).
+window, default 15), ``SD15_MAX_BATCH`` (default dp×fsdp or 1), plus the
+shared resilience contract (``tpustack.serving.resilience``):
+``TPUSTACK_DRAIN_TIMEOUT_S``, ``TPUSTACK_REQUEST_TIMEOUT_S`` (per-request
+body override ``timeout_s``), ``TPUSTACK_MAX_QUEUE_DEPTH``,
+``TPUSTACK_WATCHDOG_S`` and the ``TPUSTACK_FAULT_*`` injection knobs.
+``GET /readyz`` is the readiness endpoint (503 while draining);
+``/healthz`` stays the liveness endpoint and now reports drain/watchdog
+state alongside the reference's ``ok`` field.
 """
 
 from __future__ import annotations
@@ -48,6 +55,9 @@ from pydantic import BaseModel, ValidationError
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import http as obs_http
+from tpustack.serving.resilience import (DeadlineExceeded,
+                                         InjectedDeviceError,
+                                         ResilienceManager)
 from tpustack.utils import get_logger
 from tpustack.utils.image import array_to_png
 
@@ -65,6 +75,9 @@ class GenReq(BaseModel):
     width: Optional[int] = 512
     height: Optional[int] = 512
     negative_prompt: Optional[str] = ""
+    # per-request deadline override (seconds); None → the server default
+    # TPUSTACK_REQUEST_TIMEOUT_S, 0 disables for this request
+    timeout_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -126,6 +139,14 @@ class SDServer:
         # so a stale timer never shrinks the NEXT group's window
         self._pending: Dict[tuple, tuple] = {}
         self._group_seq = 0
+        # shared resilience layer: drain on SIGTERM, per-request deadlines,
+        # 429 backpressure, hung-dispatch watchdog, TPUSTACK_FAULT_* hooks.
+        # queue depth is the manager default (in-flight work requests beyond
+        # max_batch capacity): a request leaves the window groups the moment
+        # it is dispatched, so group size alone under-counts waiting work
+        self.resilience = ResilienceManager("sd", registry,
+                                            concurrency=self.max_batch,
+                                            expected_service_s=5.0)
 
     @staticmethod
     def _pipeline_from_env():
@@ -165,7 +186,17 @@ class SDServer:
 
     # ------------------------------------------------------------ handlers
     async def healthz(self, request: web.Request) -> web.Response:
-        return web.json_response({"ok": True})
+        """Liveness + server state (503 only on a watchdog-declared hang;
+        the ``ok`` field keeps the reference configmap's response shape)."""
+        status, payload = self.resilience.health_payload(extra={
+            "max_batch": self.max_batch,
+            "batch_window_ms": self.batch_window_s * 1e3,
+        })
+        return web.json_response(payload, status=status)
+
+    async def readyz(self, request: web.Request) -> web.Response:
+        status, payload = self.resilience.ready_payload()
+        return web.json_response(payload, status=status)
 
     async def index(self, request: web.Request) -> web.Response:
         if self._last_image is None:
@@ -206,21 +237,39 @@ class SDServer:
         width = 512 if req.width is None else req.width
         height = 512 if req.height is None else req.height
 
+        try:
+            deadline_s = self.resilience.deadline(req.timeout_s)
+        except (TypeError, ValueError) as e:
+            return web.json_response({"detail": f"bad timeout_s: {e}"},
+                                     status=422)
         t0 = time.time()
         log.info(
             "Generating prompt='%s' steps=%s guidance=%.2f seed=%s size=%sx%s",
             req.prompt, steps, guidance,
             req.seed if req.seed is not None else "auto", width, height)
 
+        key = (steps, float(guidance), width, height)
+        pending = _PendingReq(req.prompt, req.negative_prompt or "",
+                              req.seed,
+                              asyncio.get_running_loop().create_future(),
+                              t_enqueue=time.perf_counter())
         try:
-            img = await self._enqueue(
-                key=(steps, float(guidance), width, height),
-                req=_PendingReq(req.prompt, req.negative_prompt or "",
-                                req.seed,
-                                asyncio.get_running_loop().create_future(),
-                                t_enqueue=time.perf_counter()))
+            img = await asyncio.wait_for(self._enqueue(key, pending),
+                                         deadline_s)
         except ValueError as e:  # e.g. size not a multiple of the UNet factor
             return web.json_response({"detail": str(e)}, status=400)
+        except asyncio.TimeoutError:
+            # still waiting in its window group → pull it out so the batch
+            # never pays for it (phase=queued); already dispatched → the
+            # fused program runs to completion but nobody waits (the engine
+            # "slot" was a batch row, freed when the batch resolves)
+            phase = "queued" if self._abandon(key, pending) else "denoise"
+            self.resilience.note_deadline(phase)
+            return web.json_response(
+                {"detail": f"request deadline exceeded (phase={phase})",
+                 "phase": phase}, status=504)
+        except InjectedDeviceError as e:
+            return self.resilience.transient_error_response(e)
         from tpustack.obs import Trace
 
         tr = Trace(request_id=request.get("request_id"))
@@ -257,6 +306,19 @@ class SDServer:
         elif len(group) == 1:
             asyncio.ensure_future(self._flush(key, gid, wait=self.max_batch > 1))
         return await req.future
+
+    def _abandon(self, key: tuple, req: _PendingReq) -> bool:
+        """Remove a deadline-expired request from its window group (True if
+        it was still queued).  Runs on the event loop with no awaits, so it
+        cannot interleave with a flusher draining the same group."""
+        entry = self._pending.get(key)
+        if entry is None or req not in entry[1]:
+            return False
+        entry[1].remove(req)
+        if not entry[1]:
+            self._pending.pop(key, None)
+        self._set_queue_depth()
+        return True
 
     async def _flush(self, key: tuple, gid: int, wait: bool) -> None:
         if wait:
@@ -326,13 +388,18 @@ class SDServer:
             # dispatch under the lock (host-side, returns immediately via JAX
             # async dispatch — keeps program order deterministic), fetch
             # outside it so the next batch's compute overlaps this transfer
+            def dispatch():
+                # progress point on the executor thread (a fault-injected
+                # sleep/hang must never block the event loop): watchdog
+                # beat + TPUSTACK_FAULT_* hooks, then the async dispatch
+                self.resilience.progress("prefill")
+                return self.pipe.generate_async(
+                    prompts, steps=steps, guidance_scale=guidance,
+                    seed=seeds, width=width, height=height,
+                    negative_prompt=negs, mesh=mesh)
+
             async with self._lock:
-                dev_imgs = await loop.run_in_executor(
-                    None,
-                    lambda: self.pipe.generate_async(
-                        prompts, steps=steps, guidance_scale=guidance,
-                        seed=seeds, width=width, height=height,
-                        negative_prompt=negs, mesh=mesh))
+                dev_imgs = await loop.run_in_executor(None, dispatch)
                 self._inflight.append(dev_imgs)
             # batch_build: list assembly + the host-side trace/dispatch of
             # the fused program (returns before the device finishes)
@@ -358,6 +425,8 @@ class SDServer:
         # failed dispatch must not skew the latency histograms
         tr.observe_into(self.metrics["tpustack_request_phase_latency_seconds"],
                         server="sd")
+        # batch boundary: watchdog beat + injected mid-request SIGTERM point
+        self.resilience.progress("wave")
         for i, r in enumerate(batch):
             if not r.future.done():
                 r.future.set_result(imgs[i])
@@ -426,8 +495,10 @@ class SDServer:
     def build_app(self) -> web.Application:
         app = web.Application(
             client_max_size=1 << 20,
-            middlewares=[obs_http.instrument("sd", self._registry)])
+            middlewares=[obs_http.instrument("sd", self._registry),
+                         self.resilience.middleware({"/generate"})])
         app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/readyz", self.readyz)
         app.router.add_get("/", self.index)
         app.router.add_get("/last", self.last)
         app.router.add_get("/metrics",
@@ -459,7 +530,11 @@ def main() -> None:
                      server._mesh_data_size() or 1)
             secs = server.pipe.warmup(batch_size=size, mesh=server.mesh, **kw)
             log.info("Warmup batch=%d done in %.1fs", size, secs)
-    web.run_app(server.build_app(), port=port, access_log=None)
+    # SIGTERM → graceful drain (readiness 503, in-flight batches finish,
+    # exit 0); aiohttp's own immediate-stop handler must not race it
+    server.resilience.install_signal_handlers()
+    web.run_app(server.build_app(), port=port, access_log=None,
+                handle_signals=False)
 
 
 if __name__ == "__main__":
