@@ -1,0 +1,382 @@
+"""Preemption-safe training (tpustack.train.resilience) — tier-1, CPU-only.
+
+The training twin of tests/test_resilience.py: every failure Kubernetes
+inflicts on a train Job is driven deterministically, in seconds, on CPU:
+
+- async atomic saves + integrity manifests (per-file SHA-256 written after
+  the commit rename);
+- restore of an empty / partially-written checkpoint dir is a fresh start,
+  never a crash;
+- a corrupted checkpoint is quarantined (``<step>.corrupt``) and restore
+  falls back to the newest good step — both at the module level and end to
+  end through ``TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT``;
+- SIGTERM (real, via ``TPUSTACK_FAULT_TRAIN_KILL_STEP``) → emergency
+  checkpoint at the step boundary → distinct resumable exit (42) → the
+  restarted run resumes from exactly that step;
+- the chaos bar: ``tools/chaos_train.py --fast`` kill/resume cycle ends
+  bitwise-identical to an uninterrupted run;
+- the new metric catalog entries and the manifest-lint train-checkpoint
+  rule stay enforced.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from tpustack.obs import Registry
+from tpustack.train import resilience, tasks
+from tpustack.train.resilience import (EXIT_PREEMPTED, ResilientCheckpointer,
+                                       TrainFaultInjector, verify_manifest,
+                                       write_manifest)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY_RESNET = ["resnet50", "--tiny", "--batch", "2", "--classes", "4",
+               "--image-size", "16", "--no-bf16"]
+
+
+@pytest.fixture(autouse=True)
+def _restore_sigterm():
+    """tasks.main installs a SIGTERM handler; put the old one back so one
+    test's guard can never see another test's (or the harness's) signal."""
+    old = signal.getsignal(signal.SIGTERM)
+    yield
+    signal.signal(signal.SIGTERM, old)
+
+
+def _ckpt_steps(ckpt_dir):
+    import orbax.checkpoint as ocp
+
+    mngr = ocp.CheckpointManager(ckpt_dir)
+    return sorted(mngr.all_steps()), mngr.latest_step()
+
+
+def _run_subprocess(argv, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for knob in ("TPUSTACK_FAULT_TRAIN_KILL_STEP",
+                 "TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT"):
+        env.pop(knob, None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "tpustack.train.tasks"] + argv,
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+
+
+# ================================================== unit: manifest integrity
+def test_manifest_write_verify_detects_damage(tmp_path):
+    step = tmp_path / "5"
+    (step / "sub").mkdir(parents=True)
+    (step / "a.bin").write_bytes(b"\x00" * 1024)
+    (step / "sub" / "b.bin").write_bytes(b"tpustack")
+    manifest = write_manifest(str(step))
+    assert set(manifest["files"]) == {"a.bin", os.path.join("sub", "b.bin")}
+    assert manifest["total_bytes"] == 1032
+    assert verify_manifest(str(step)) == (True, "ok")
+
+    # bit flip → checksum mismatch
+    (step / "a.bin").write_bytes(b"\x01" + b"\x00" * 1023)
+    ok, reason = verify_manifest(str(step))
+    assert not ok and "checksum mismatch: a.bin" in reason
+    (step / "a.bin").write_bytes(b"\x00" * 1024)
+
+    # truncation → size mismatch (cheaper check fires first)
+    (step / "sub" / "b.bin").write_bytes(b"tpu")
+    ok, reason = verify_manifest(str(step))
+    assert not ok and "size mismatch" in reason
+    (step / "sub" / "b.bin").write_bytes(b"tpustack")
+
+    # deletion and unexpected extras both fail
+    (step / "a.bin").rename(step / "c.bin")
+    ok, reason = verify_manifest(str(step))
+    assert not ok and ("missing" in reason or "unexpected" in reason)
+
+    # no manifest at all (pre-manifest checkpoint): accepted, flagged
+    os.remove(step / resilience.MANIFEST_NAME)
+    ok, reason = verify_manifest(str(step))
+    assert ok and "no manifest" in reason
+
+    # a torn manifest reads as a failure, not a crash
+    (step / resilience.MANIFEST_NAME).write_text("{not json")
+    ok, reason = verify_manifest(str(step))
+    assert not ok and "unreadable manifest" in reason
+
+
+def test_fault_injector_env_contract():
+    inj = TrainFaultInjector(env={})
+    assert not inj.active
+    inj = TrainFaultInjector(env={"TPUSTACK_FAULT_TRAIN_KILL_STEP": "7"})
+    assert inj.active and inj.kill_step == 7
+    with pytest.raises(ValueError, match="TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT"):
+        TrainFaultInjector(env={"TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT": "soon"})
+
+
+# ==================================== unit: checkpointer restore tolerance
+def test_empty_and_partial_ckpt_dir_is_fresh_start(tmp_path):
+    state = {"step": jnp.zeros((), jnp.int32), "w": jnp.arange(8.0)}
+
+    # empty (just-created) dir
+    ckpt = ResilientCheckpointer(str(tmp_path / "empty"), registry=Registry(),
+                                 env={})
+    assert ckpt.restore_latest(state) == (None, None)
+
+    # partially-written garbage: a committed-looking step dir with junk in
+    # it, plus stray non-step entries orbax must ignore
+    root = tmp_path / "partial"
+    (root / "7").mkdir(parents=True)
+    (root / "7" / "junk.bin").write_bytes(b"not a checkpoint")
+    (root / ".tpustack").mkdir()
+    (root / ".tpustack" / "kill_3").write_text("marker")
+    reg = Registry()
+    ckpt = ResilientCheckpointer(str(root), registry=reg, env={})
+    assert ckpt.restore_latest(state) == (None, None)
+    # the junk step was quarantined out of the way, not crashed on
+    assert (root / "7.corrupt").exists()
+    assert reg.get_sample_value("tpustack_train_checkpoints_quarantined_total",
+                                {"task": "train"}) == 1
+
+
+def test_corrupt_checkpoint_quarantined_and_fallback(tmp_path):
+    state = {"step": jnp.zeros((), jnp.int32), "w": jnp.arange(8.0)}
+    ckpt = ResilientCheckpointer(str(tmp_path), task="unit",
+                                 registry=Registry(), env={}, save_every=1)
+    for s in (1, 2, 3):
+        st = {"step": jnp.asarray(s, jnp.int32), "w": jnp.arange(8.0) + s}
+        assert ckpt.save(s, st)
+        ckpt.poll()
+    ckpt.finalize()
+    assert ckpt.all_steps() == [1, 2, 3]
+    for s in (1, 2, 3):  # every committed step carries a manifest
+        mpath = tmp_path / str(s) / resilience.MANIFEST_NAME
+        assert json.loads(mpath.read_text())["files"]
+
+    # flip bytes in step 3's largest file, restore with a fresh manager
+    victims = sorted(
+        ((os.path.getsize(os.path.join(r, f)), os.path.join(r, f))
+         for r, _d, fs in os.walk(tmp_path / "3") for f in fs
+         if f != resilience.MANIFEST_NAME), reverse=True)
+    with open(victims[0][1], "r+b") as f:
+        head = f.read(64)
+        f.seek(0)
+        f.write(bytes(b ^ 0xFF for b in head))
+
+    reg = Registry()
+    ckpt2 = ResilientCheckpointer(str(tmp_path), task="unit", registry=reg,
+                                  env={}, save_every=1)
+    restored, step = ckpt2.restore_latest(state)
+    assert step == 2
+    assert int(restored["step"]) == 2
+    assert float(restored["w"][0]) == 2.0
+    assert (tmp_path / "3.corrupt").exists()
+    assert ckpt2.all_steps() == [1, 2]
+    assert reg.get_sample_value("tpustack_train_checkpoints_quarantined_total",
+                                {"task": "unit"}) == 1
+    assert reg.get_sample_value("tpustack_train_restores_total",
+                                {"task": "unit", "outcome": "fallback"}) == 1
+
+
+def test_verified_checkpoint_restore_mismatch_raises_not_quarantines(tmp_path):
+    """A checkpoint whose manifest verifies but whose tree doesn't match
+    the task's template (wrong flags against the same --ckpt-dir) must
+    fail LOUDLY — quarantining would rename good history away and
+    silently restart from step 0."""
+    ckpt = ResilientCheckpointer(str(tmp_path), task="unit",
+                                 registry=Registry(), env={}, save_every=1)
+    ckpt.save(1, {"step": jnp.asarray(1, jnp.int32), "w": jnp.arange(8.0)})
+    ckpt.finalize()
+    ckpt2 = ResilientCheckpointer(str(tmp_path), task="unit",
+                                  registry=Registry(), env={}, save_every=1)
+    wrong_template = {"step": jnp.zeros((), jnp.int32),
+                      "w": jnp.zeros((4, 4))}  # shape mismatch
+    with pytest.raises(RuntimeError, match="config/topology mismatch"):
+        ckpt2.restore_latest(wrong_template)
+    assert (tmp_path / "1").exists()  # the good checkpoint was NOT renamed
+    assert not (tmp_path / "1.corrupt").exists()
+
+
+def test_failed_quarantine_rename_still_falls_back(tmp_path, monkeypatch):
+    """A read-only volume can make the quarantine rename fail; restore must
+    still skip the corrupt step and fall back — never loop forever."""
+    state = {"step": jnp.zeros((), jnp.int32), "w": jnp.arange(8.0)}
+    ckpt = ResilientCheckpointer(str(tmp_path), task="unit",
+                                 registry=Registry(), env={}, save_every=1)
+    for s in (1, 2):
+        ckpt.save(s, {"step": jnp.asarray(s, jnp.int32),
+                      "w": jnp.arange(8.0) + s})
+        ckpt.poll()
+    ckpt.finalize()
+    # corrupt step 2, then make every rename fail
+    mpath = tmp_path / "2" / resilience.MANIFEST_NAME
+    mpath.write_text(mpath.read_text().replace("sha256", "sha666"))
+    monkeypatch.setattr(resilience.os, "rename",
+                        lambda a, b: (_ for _ in ()).throw(OSError("EROFS")))
+    ckpt2 = ResilientCheckpointer(str(tmp_path), task="unit",
+                                  registry=Registry(), env={}, save_every=1)
+    restored, step = ckpt2.restore_latest(state)
+    assert step == 1 and int(restored["step"]) == 1
+    assert (tmp_path / "2").exists()  # rename failed, dir left in place
+
+
+# ====================================== end to end: tiny-config save/resume
+def test_tiny_resnet_saves_and_resumes_fast(tmp_path):
+    """The fast twin of the slow tests in test_checkpoint.py — tier-1 now
+    exercises real save/resume on every PR."""
+    ckpt = str(tmp_path / "rn")
+    argv = TINY_RESNET + ["--steps", "3", "--save-every", "2",
+                          "--ckpt-dir", ckpt]
+    assert tasks.main(argv) == 0
+    steps, latest = _ckpt_steps(ckpt)
+    assert latest == 3 and steps == [1, 2, 3]
+
+    # resume: only 4..5 run; step 3 survives (a from-zero restart would
+    # have re-saved 1)
+    argv[argv.index("--steps") + 1] = "5"
+    assert tasks.main(argv) == 0
+    steps, latest = _ckpt_steps(ckpt)
+    assert latest == 5 and steps == [3, 4, 5]
+
+
+def test_corrupt_ckpt_fault_end_to_end(tmp_path, monkeypatch):
+    """TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT corrupts the step-2 checkpoint
+    after its manifest lands; the next run quarantines it, falls back to
+    step 1, and retrains through to completion."""
+    ckpt = str(tmp_path / "rn")
+    argv = TINY_RESNET + ["--steps", "2", "--save-every", "1",
+                          "--ckpt-dir", ckpt]
+    monkeypatch.setenv("TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT", "2")
+    assert tasks.main(argv) == 0
+    monkeypatch.delenv("TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT")
+    _steps, latest = _ckpt_steps(ckpt)
+    assert latest == 2  # the damage is invisible until restore verifies
+
+    argv[argv.index("--steps") + 1] = "4"
+    assert tasks.main(argv) == 0
+    assert os.path.exists(ckpt + "/2.corrupt")
+    steps, latest = _ckpt_steps(ckpt)
+    assert latest == 4
+    assert steps == [2, 3, 4]  # resumed from 1, re-saved a GOOD 2, went on
+
+
+# ============================================= SIGTERM emergency checkpoint
+def test_kill_fault_emergency_save_in_process(tmp_path, monkeypatch):
+    """A real SIGTERM at the step-3 boundary: the guard installed by
+    tasks.main catches it, the loop flushes an emergency checkpoint of
+    exactly 3 steps and raises the distinct resumable exit."""
+    ckpt = str(tmp_path / "rn")
+    argv = TINY_RESNET + ["--steps", "6", "--save-every", "50",
+                          "--ckpt-dir", ckpt]
+    monkeypatch.setenv("TPUSTACK_FAULT_TRAIN_KILL_STEP", "3")
+    with pytest.raises(SystemExit) as exc:
+        tasks.main(argv)
+    assert exc.value.code == EXIT_PREEMPTED
+    monkeypatch.delenv("TPUSTACK_FAULT_TRAIN_KILL_STEP")
+    steps, latest = _ckpt_steps(ckpt)
+    # save-every is 50: without the emergency path NOTHING would be on disk
+    assert latest == 3 and 3 in steps
+    assert verify_manifest(os.path.join(ckpt, "3"))[0]
+    # the marker stops a restarted Job (same env) re-killing itself
+    assert os.path.exists(os.path.join(ckpt, ".tpustack", "kill_3"))
+
+    # resume finishes the run and loses nothing but the in-flight step
+    assert tasks.main(argv) == 0
+    steps, latest = _ckpt_steps(ckpt)
+    assert latest == 6
+
+
+def test_sigterm_exit_code_and_resume_subprocess(tmp_path):
+    """The k8s-visible contract: the preempted process EXITS with code 42
+    and logs ``emergency checkpoint step=N``; the restarted pod logs the
+    resume and completes."""
+    ckpt = str(tmp_path / "rn")
+    argv = TINY_RESNET + ["--steps", "5", "--save-every", "2",
+                          "--ckpt-dir", ckpt]
+    out = _run_subprocess(argv,
+                          env_extra={"TPUSTACK_FAULT_TRAIN_KILL_STEP": "3"})
+    assert out.returncode == EXIT_PREEMPTED, out.stdout + out.stderr
+    assert "emergency checkpoint step=3" in out.stdout + out.stderr
+
+    out = _run_subprocess(argv)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "Resumed from checkpoint step 3" in out.stdout + out.stderr
+    _steps, latest = _ckpt_steps(ckpt)
+    assert latest == 5
+
+
+# ========================================================== the chaos bar
+def test_chaos_train_fast_cli(tmp_path):
+    """Shell ``tools/chaos_train.py --fast`` — the bitwise-identical-resume
+    guarantee is enforced on every PR."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_train.py"),
+         "--fast", "--workdir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "bitwise-identical" in out.stdout
+
+
+# =============================================== lint + catalog enforcement
+def test_new_train_metrics_declared_and_linted():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint_metrics
+    finally:
+        sys.path.pop(0)
+    from tpustack.obs.catalog import CATALOG
+
+    names = {s.name for s in CATALOG}
+    assert {"tpustack_train_steps_total",
+            "tpustack_train_heartbeat_seconds",
+            "tpustack_train_checkpoint_save_seconds",
+            "tpustack_train_last_saved_step",
+            "tpustack_train_restores_total",
+            "tpustack_train_emergency_saves_total",
+            "tpustack_train_checkpoints_quarantined_total"} <= names
+    assert lint_metrics.lint() == []
+
+
+def test_lint_manifests_train_ckpt_rule(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint_manifests
+    finally:
+        sys.path.pop(0)
+
+    bad = """
+apiVersion: batch/v1
+kind: Job
+metadata: {name: bad-train}
+spec:
+  backoffLimit: 0
+  template:
+    spec:
+      containers:
+        - name: train
+          args: ["--steps=10", "--ckpt-dir=/ckpt/x"]
+          resources:
+            requests: {cpu: "1", memory: 1Gi}
+            limits: {cpu: "1", memory: 1Gi}
+          volumeMounts:
+            - {name: ckpt, mountPath: /ckpt}
+      volumes:
+        - name: ckpt
+          emptyDir: {}
+"""
+    (tmp_path / "bad.yaml").write_text(bad)
+    errors = lint_manifests.lint(root=tmp_path)
+    text = "\n".join(errors)
+    assert "not durable" in text
+    assert "restart budget 0" in text
+    assert "emergency-save window" in text
+
+    good = bad.replace("emptyDir: {}",
+                       "hostPath: {path: /var/lib/x, type: DirectoryOrCreate}")
+    good = good.replace("backoffLimit: 0", "backoffLimit: 3")
+    good = good.replace("    spec:\n      containers:",
+                        "    spec:\n      terminationGracePeriodSeconds: 60\n"
+                        "      containers:")
+    (tmp_path / "bad.yaml").write_text(good)
+    assert lint_manifests.lint(root=tmp_path) == []

@@ -20,6 +20,14 @@ Kubernetes SIGKILLs the pod mid-drain — exactly the failure
 - **Drain consistency**: a container that sets
   ``TPUSTACK_DRAIN_TIMEOUT_S`` must have a ``preStop`` hook (endpoint
   propagation) and a grace period covering ``preStop (5s) + drain``.
+- **Train checkpoint contract**: any Job/CronJob/JobSet container whose
+  args include ``--ckpt-dir`` must mount a *durable* volume at that path
+  (an ``emptyDir`` dies with the pod — the restarted pod would train from
+  step 0), the workload must carry a nonzero restart budget
+  (``backoffLimit`` / JobSet ``failurePolicy.maxRestarts`` — with a zero
+  budget a preempted pod never resumes), and
+  ``terminationGracePeriodSeconds`` must cover the emergency-save window
+  so SIGKILL cannot land mid-flush.
 
 Vendored upstream files (the Flux toolkit export) are skipped — we lint
 what we author.  Runs standalone (``python tools/lint_manifests.py``,
@@ -44,6 +52,16 @@ SKIP_FILES = ("cluster/flux-system/gotk-components.yaml",)
 
 #: seconds the preStop sleep holds before SIGTERM (endpoint propagation)
 PRESTOP_GRACE_S = 5
+
+#: minimum terminationGracePeriodSeconds for a checkpointing trainer: the
+#: SIGTERM handler finishes the in-flight step, then flushes + manifests
+#: the emergency checkpoint (tpustack/train/resilience.py) — SIGKILL
+#: before that completes loses up to save-every steps of work
+TRAIN_CKPT_GRACE_S = 60
+
+#: volume types that survive a pod restart (what --ckpt-dir needs);
+#: emptyDir et al. die with the pod
+DURABLE_VOLUME_KEYS = ("persistentVolumeClaim", "hostPath", "nfs", "csi")
 
 WORKLOAD_KINDS = ("Deployment", "DaemonSet", "Job", "CronJob", "JobSet")
 
@@ -114,6 +132,80 @@ def _check_drain_consistency(where: str, doc, errors: List[str]) -> None:
                     "kubernetes would SIGKILL the pod mid-drain")
 
 
+def _ckpt_dir_of(container):
+    argv = [str(a) for a in ((container.get("command") or [])
+                             + (container.get("args") or []))]
+    for j, a in enumerate(argv):
+        if a.startswith("--ckpt-dir="):
+            return a.split("=", 1)[1]
+        if a == "--ckpt-dir" and j + 1 < len(argv):
+            return argv[j + 1]
+    return None
+
+
+def _restart_budget(doc):
+    kind = doc.get("kind")
+    if kind == "Job":
+        return doc["spec"].get("backoffLimit", 6)  # k8s default is 6
+    if kind == "CronJob":
+        return doc["spec"]["jobTemplate"]["spec"].get("backoffLimit", 6)
+    if kind == "JobSet":
+        # the set restarts as a whole; the inner Jobs' backoffLimit stays 0
+        return (doc["spec"].get("failurePolicy") or {}).get("maxRestarts", 0)
+    return None
+
+
+def _check_train_ckpt_contract(where: str, doc, errors: List[str]) -> None:
+    """Jobs that checkpoint must actually be able to resume: durable
+    volume under --ckpt-dir, a restart budget, and enough grace for the
+    emergency save."""
+    budget = _restart_budget(doc)
+    if budget is None:  # not a Job-shaped workload
+        return
+    for tmpl in _pod_templates(doc):
+        spec = tmpl.get("spec", {})
+        volumes = {v.get("name"): v for v in spec.get("volumes", []) or []}
+        checkpoints = False
+        for container in spec.get("containers", []) or []:
+            ckpt = _ckpt_dir_of(container)
+            if ckpt is None:
+                continue
+            checkpoints = True
+            cname = container.get("name")
+            mount = None
+            for m in container.get("volumeMounts", []) or []:
+                mp = m.get("mountPath", "").rstrip("/")
+                if ckpt == mp or ckpt.startswith(mp + "/"):
+                    mount = m
+                    break
+            if mount is None:
+                errors.append(
+                    f"{where}: container {cname!r} passes --ckpt-dir={ckpt} "
+                    "but mounts no volume at that path")
+            else:
+                vol = volumes.get(mount.get("name")) or {}
+                if not any(k in vol for k in DURABLE_VOLUME_KEYS):
+                    errors.append(
+                        f"{where}: --ckpt-dir={ckpt} volume "
+                        f"{mount.get('name')!r} is not durable "
+                        f"(need one of {DURABLE_VOLUME_KEYS}) — a "
+                        "restarted pod would train from step 0")
+        if not checkpoints:
+            continue
+        # workload/pod-level requirements, reported once per template
+        if not budget:
+            errors.append(
+                f"{where}: checkpointing workload has restart budget 0 "
+                "(backoffLimit / failurePolicy.maxRestarts) — a "
+                "preempted pod never resumes")
+        grace = spec.get("terminationGracePeriodSeconds")
+        if grace is None or float(grace) < TRAIN_CKPT_GRACE_S:
+            errors.append(
+                f"{where}: terminationGracePeriodSeconds ({grace}) < "
+                f"{TRAIN_CKPT_GRACE_S}s emergency-save window — "
+                "SIGKILL could land mid-checkpoint-flush")
+
+
 def lint(root: Path = None) -> List[str]:
     """Return a list of violation strings (empty = clean)."""
     root = Path(root) if root is not None else REPO / "cluster-config"
@@ -139,6 +231,7 @@ def lint(root: Path = None) -> List[str]:
             if doc.get("kind") == "Deployment":
                 _check_deployment(where, doc, errors)
             _check_drain_consistency(where, doc, errors)
+            _check_train_ckpt_contract(where, doc, errors)
     return errors
 
 

@@ -41,6 +41,9 @@ UNIT_SUFFIXES = (
     "depth", "slots", "tokens", "images", "requests", "entries", "prompts",
     # enum gauges (value is a documented small-integer state machine)
     "state",
+    # index gauges (value identifies a position, e.g. the last-saved
+    # training step — a resumed run continues FROM this number)
+    "step",
 )
 _RESERVED_LABELS = {"le", "quantile"}
 

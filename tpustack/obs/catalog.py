@@ -23,6 +23,9 @@ from tpustack.obs.metrics import REGISTRY, Registry
 BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 #: token-count buckets for prompt/generation length histograms
 TOKEN_BUCKETS = (1, 8, 32, 128, 512, 2048, 8192, 32768)
+#: checkpoint-commit buckets: tiny CI saves are ms, a sharded 7B on a PVC
+#: can take minutes
+SAVE_BUCKETS = (0.1, 0.5, 2.0, 10.0, 30.0, 120.0, 600.0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,9 +146,43 @@ CATALOG: Tuple[MetricSpec, ...] = (
                ("server",), unit="seconds"),
     MetricSpec("tpustack_faults_injected_total", "counter",
                "Deterministic TPUSTACK_FAULT_* injections fired, by kind "
-               "(slow_prefill|device_error|dispatch_hang|sigterm).  "
+               "(serving: slow_prefill|device_error|dispatch_hang|sigterm; "
+               "train, server=\"train\": kill_step|corrupt_ckpt).  "
                "Nonzero outside a chaos drill is a config bug.",
                ("server", "kind"), unit="total"),
+
+    # ---- training resilience (tpustack.train.resilience; task ∈
+    # resnet50|bert|llama2|sd15; scraped via the TPUSTACK_METRICS_PORT
+    # sidecar the train-Job manifests wire up) ----
+    MetricSpec("tpustack_train_steps_total", "counter",
+               "Optimizer steps completed.", ("task",), unit="total"),
+    MetricSpec("tpustack_train_heartbeat_seconds", "gauge",
+               "Unix time of the last completed training step.  A Running "
+               "pod whose heartbeat age keeps growing is the train-side "
+               "hung-dispatch signal (Jobs have no liveness probe to "
+               "flip).", ("task",), unit="seconds"),
+    MetricSpec("tpustack_train_checkpoint_save_seconds", "histogram",
+               "Background checkpoint write duration: async save start → "
+               "last write into the committed step dir (saves are async — "
+               "the step loop does not block on this).",
+               ("task",), buckets=SAVE_BUCKETS, unit="seconds"),
+    MetricSpec("tpustack_train_last_saved_step", "gauge",
+               "Step number of the newest durable, manifest-verified "
+               "checkpoint — what a restarted pod would resume from.",
+               ("task",), unit="step"),
+    MetricSpec("tpustack_train_restores_total", "counter",
+               "Checkpoint restores at startup, by outcome (ok = newest "
+               "step verified; fallback = an older step after "
+               "quarantining corrupt newer ones).",
+               ("task", "outcome"), unit="total"),
+    MetricSpec("tpustack_train_emergency_saves_total", "counter",
+               "SIGTERM-triggered emergency checkpoints flushed before "
+               "the resumable exit (code 42).", ("task",), unit="total"),
+    MetricSpec("tpustack_train_checkpoints_quarantined_total", "counter",
+               "Checkpoints that failed integrity verification, renamed "
+               "to <step>.corrupt and skipped at restore.  Nonzero means "
+               "storage corrupted data in flight — see the runbook in "
+               "docs/RESILIENCE.md.", ("task",), unit="total"),
 
     # ---- batch clients (scripts/batch_generate.py via the Job sidecar) ----
     MetricSpec("tpustack_batch_generate_requests_total", "counter",

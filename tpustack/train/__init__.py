@@ -1,3 +1,11 @@
+from tpustack.train.resilience import (
+    EXIT_PREEMPTED,
+    Preempted,
+    PreemptionGuard,
+    ResilientCheckpointer,
+    TrainFaultInjector,
+    install_preemption_guard,
+)
 from tpustack.train.trainer import (
     TrainerConfig,
     TrainState,
@@ -5,4 +13,9 @@ from tpustack.train.trainer import (
     make_train_state,
 )
 
-__all__ = ["TrainerConfig", "TrainState", "make_sharded_train_step", "make_train_state"]
+__all__ = [
+    "EXIT_PREEMPTED", "Preempted", "PreemptionGuard",
+    "ResilientCheckpointer", "TrainFaultInjector", "TrainerConfig",
+    "TrainState", "install_preemption_guard", "make_sharded_train_step",
+    "make_train_state",
+]

@@ -1,0 +1,514 @@
+"""Preemption-safe training: the fault-tolerant layer under the task ladder.
+
+TPU VMs are routinely preempted, nodes drain for upgrades, and Kubernetes
+SIGTERMs training pods mid-step — the serving side survived all of this in
+``tpustack.serving.resilience``; this module is the training twin.  A killed
+trainer must lose at most one step and provably continue the *exact same
+run* (the per-step-seeded data in ``tasks.py`` makes that well-defined;
+``tools/chaos_train.py`` proves it end to end, bitwise).
+
+Four pieces:
+
+- **Preemption guard** — SIGTERM sets a flag (nothing else: signal handlers
+  run between bytecodes on the main thread and must not take locks); the
+  step loop checks it at every step boundary, flushes an *emergency
+  checkpoint*, logs ``emergency checkpoint step=N`` and raises
+  :class:`Preempted` so the process exits :data:`EXIT_PREEMPTED` — a
+  distinct, resumable code the Job's restart budget turns into a resume.
+- **Async, atomic saves** — :class:`ResilientCheckpointer` schedules Orbax
+  saves in the background (save latency stops costing steps/sec) and the
+  loop's ``finalize()`` barrier runs on *every* exit path, so no path can
+  strand an uncommitted checkpoint.  Orbax commits by atomic rename, so a
+  step directory either exists completely or not at all.
+- **Integrity-verified restore** — after a save commits, a manifest of
+  per-file SHA-256 checksums (``tpustack.manifest.json``) is written into
+  the step directory.  On restore, a failed verification *quarantines* the
+  step (rename to ``<step>.corrupt``) and falls back to the newest good
+  one instead of crashing or silently training from garbage.
+- **Deterministic fault injection** — ``TPUSTACK_FAULT_TRAIN_KILL_STEP``
+  delivers a *real* SIGTERM to the process at an exact step boundary;
+  ``TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT`` flips bytes in the checkpoint
+  committed for an exact step (after its manifest is written, so restore
+  *must* catch it).  Count-exact, never probabilistic — the PR-3 contract.
+
+Env knobs:
+
+=================================== ==== ===================================
+``TPUSTACK_FAULT_TRAIN_KILL_STEP``  0    inject: real SIGTERM at the
+                                         boundary where exactly N steps
+                                         are complete (once per run — a
+                                         marker under the checkpoint dir
+                                         stops a resumed Job re-killing
+                                         itself at the same boundary)
+``TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT`` 0  inject: corrupt the checkpoint
+                                         committed for step N after its
+                                         manifest lands
+=================================== ==== ===================================
+
+Metrics (obs catalog, scraped via the ``TPUSTACK_METRICS_PORT`` sidecar):
+save-duration histogram, last-saved-step gauge, restore / emergency /
+quarantine counters, a per-step heartbeat gauge, and the shared
+``tpustack_faults_injected_total{server="train"}``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from tpustack.obs import catalog as obs_catalog
+# the serving resilience layer is this module's twin (same PR-3 fault
+# contract); share its env parsing instead of forking a copy
+from tpustack.serving.resilience import _env_int
+from tpustack.utils import get_logger
+
+log = get_logger("train.resilience")
+
+#: the distinct, resumable exit code a preempted trainer exits with.  The
+#: train Jobs' restart budget (``backoffLimit`` / JobSet ``maxRestarts``)
+#: turns any nonzero exit into a restart; 42 in the logs says "emergency
+#: checkpoint flushed, safe to resume" as opposed to a real failure.
+EXIT_PREEMPTED = 42
+
+#: per-file checksum manifest written into each step dir after commit
+MANIFEST_NAME = "tpustack.manifest.json"
+
+#: non-step bookkeeping (fault markers) lives under this dot-dir so the
+#: Orbax step scan never sees it
+STATE_SUBDIR = ".tpustack"
+
+
+class Preempted(SystemExit):
+    """Raised at a step boundary after the emergency checkpoint is durable;
+    exits the process with :data:`EXIT_PREEMPTED`."""
+
+    def __init__(self, step: int):
+        super().__init__(EXIT_PREEMPTED)
+        self.step = step
+
+
+# ------------------------------------------------------------ preemption
+class PreemptionGuard:
+    """SIGTERM → ``requested`` flag, checked at step boundaries.
+
+    The handler only sets a plain bool — a GIL-atomic store that can never
+    block, unlike ``Event.set()`` whose internal Condition lock could
+    deadlock if a second SIGTERM interrupts the first handler mid-set.
+    The expensive work — emergency save, barrier, exit — happens in the
+    step loop's own frame where it is safe to block."""
+
+    def __init__(self):
+        self._requested = False
+
+    def request(self) -> None:
+        self._requested = True
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+
+_GUARD: Optional[PreemptionGuard] = None
+
+
+def install_preemption_guard() -> PreemptionGuard:
+    """Install the SIGTERM handler and return the (fresh) guard.  Main
+    thread only (python signal contract); elsewhere the guard is returned
+    un-armed so training still runs, just without graceful preemption."""
+    global _GUARD
+    guard = PreemptionGuard()
+    try:
+        signal.signal(signal.SIGTERM, lambda signum, frame: guard.request())
+    except ValueError:  # pragma: no cover - non-main thread
+        log.warning("not in main thread; SIGTERM emergency-checkpoint "
+                    "handler not installed")
+    _GUARD = guard
+    return guard
+
+
+def get_guard() -> Optional[PreemptionGuard]:
+    return _GUARD
+
+
+# ------------------------------------------------------------- heartbeat
+_METRICS: Optional[Dict[str, Any]] = None
+
+
+def _default_metrics() -> Dict[str, Any]:
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = obs_catalog.build(None)
+    return _METRICS
+
+
+def beat(task: str) -> None:
+    """Per-step heartbeat: steps counter + last-step unix time.  A scrape
+    seeing ``now() - heartbeat`` grow with the pod Running is the train-side
+    hung-dispatch signal (the serving watchdog's cheaper cousin — Jobs have
+    no liveness probe to flip, but the alert rule reads the same)."""
+    m = _default_metrics()
+    m["tpustack_train_steps_total"].labels(task=task).inc()
+    m["tpustack_train_heartbeat_seconds"].labels(task=task).set(time.time())
+
+
+# ----------------------------------------------------- integrity manifest
+def _iter_files(step_dir: str):
+    for root, _dirs, files in os.walk(step_dir):
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            rel = os.path.relpath(full, step_dir)
+            if rel == MANIFEST_NAME:
+                continue
+            yield rel, full
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_manifest(step_dir: str) -> Dict[str, Any]:
+    """Checksum every file under ``step_dir`` and write the manifest
+    atomically (tmp + rename — a torn manifest must read as *absent*, not
+    as a verification failure of a good checkpoint)."""
+    files = {rel: {"sha256": _sha256(full), "bytes": os.path.getsize(full)}
+             for rel, full in _iter_files(step_dir)}
+    manifest = {"version": 1, "files": files,
+                "total_bytes": sum(f["bytes"] for f in files.values())}
+    tmp = os.path.join(step_dir, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(step_dir, MANIFEST_NAME))
+    return manifest
+
+
+def verify_manifest(step_dir: str) -> Tuple[bool, str]:
+    """``(ok, reason)``.  A checkpoint without a manifest passes as
+    ``"unverified"`` (pre-manifest checkpoints, or a kill in the tiny
+    window between commit and manifest write — the bytes Orbax committed
+    atomically are still almost certainly good, and refusing them would
+    throw away real progress)."""
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.isdir(step_dir):
+        return False, "step directory missing"
+    if not os.path.exists(path):
+        return True, "unverified (no manifest)"
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        expected = manifest["files"]
+    except (ValueError, KeyError) as e:
+        return False, f"unreadable manifest: {e}"
+    on_disk = dict(_iter_files(step_dir))
+    missing = sorted(set(expected) - set(on_disk))
+    if missing:
+        return False, f"missing files: {missing[:3]}"
+    extra = sorted(set(on_disk) - set(expected))
+    if extra:
+        return False, f"unexpected files: {extra[:3]}"
+    for rel, meta in expected.items():
+        full = on_disk[rel]
+        if not isinstance(meta, dict):
+            return False, f"malformed manifest entry: {rel}"
+        if os.path.getsize(full) != meta.get("bytes"):
+            return False, f"size mismatch: {rel}"
+        if _sha256(full) != meta.get("sha256"):
+            return False, f"checksum mismatch: {rel}"
+    return True, "ok"
+
+
+# --------------------------------------------------------- fault injection
+class TrainFaultInjector:
+    """Deterministic train-side faults, keyed on exact step numbers.
+
+    ``maybe_kill`` delivers a *real* ``SIGTERM`` to our own pid — the test
+    exercises the actual handler → emergency-save → exit-42 path, not a
+    simulation.  A marker file under the checkpoint dir records the firing
+    so the restarted Job (same env!) doesn't re-kill itself at the same
+    boundary forever."""
+
+    def __init__(self, env=None):
+        env = os.environ if env is None else env
+        self.kill_step = _env_int(env, "TPUSTACK_FAULT_TRAIN_KILL_STEP", 0)
+        self.corrupt_step = _env_int(env, "TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT", 0)
+        #: metrics hook (kind -> counted); set by the checkpointer
+        self.on_inject = None
+        #: marker-file directory; set by the checkpointer when there is one
+        self.state_dir: Optional[str] = None
+        self._kill_fired = False
+
+    @property
+    def active(self) -> bool:
+        return bool(self.kill_step or self.corrupt_step)
+
+    def _note(self, kind: str) -> None:
+        log.warning("fault injected: %s", kind)
+        if self.on_inject is not None:
+            self.on_inject(kind)
+
+    def _kill_marker(self) -> Optional[str]:
+        if self.state_dir is None:
+            return None
+        return os.path.join(self.state_dir, f"kill_{self.kill_step}")
+
+    def maybe_kill(self, completed_steps: int) -> None:
+        """Real SIGTERM when exactly ``kill_step`` steps are complete."""
+        if not self.kill_step or self._kill_fired:
+            return
+        if completed_steps != self.kill_step:
+            return
+        marker = self._kill_marker()
+        if marker is not None and os.path.exists(marker):
+            self._kill_fired = True  # already killed here in a prior life
+            return
+        self._kill_fired = True
+        if marker is not None:
+            os.makedirs(os.path.dirname(marker), exist_ok=True)
+            with open(marker, "w") as f:
+                f.write(f"SIGTERM injected at step {completed_steps}\n")
+        self._note("kill_step")
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_corrupt(self, step: int, step_dir: str) -> None:
+        """Flip bytes in the step's largest data file — *after* the
+        manifest landed, so the manifest holds the good hashes and restore
+        must detect the damage."""
+        if not self.corrupt_step or step != self.corrupt_step:
+            return
+        victims = sorted(_iter_files(step_dir),
+                         key=lambda rf: (-os.path.getsize(rf[1]), rf[0]))
+        if not victims:
+            return
+        _rel, full = victims[0]
+        with open(full, "r+b") as f:
+            head = f.read(64)
+            f.seek(0)
+            f.write(bytes(b ^ 0xFF for b in head))
+        self._note("corrupt_ckpt")
+        log.warning("corrupted checkpoint step=%d file=%s", step, _rel)
+
+
+# -------------------------------------------------------- the checkpointer
+class ResilientCheckpointer:
+    """Async Orbax saves + integrity manifests + verified restore with
+    quarantine fallback.  One per training run (``tasks._maybe_restore``).
+
+    Lifecycle per step: ``save(step, state)`` schedules a background save
+    and returns immediately; ``poll()`` (cheap, called every step) notices
+    committed saves — Orbax's atomic rename makes the step directory's
+    existence the commit marker — writes their manifests and observes the
+    save-duration histogram.  ``finalize()`` is the barrier: the step loop
+    runs it on every exit path so no path can strand an uncommitted save."""
+
+    def __init__(self, directory: str, *, task: str = "train",
+                 save_every: int = 50, max_to_keep: int = 3,
+                 registry=None, env=None,
+                 fault: Optional[TrainFaultInjector] = None):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.task = task
+        self.metrics = (obs_catalog.build(registry) if registry is not None
+                        else _default_metrics())
+        self.fault = fault if fault is not None else TrainFaultInjector(env)
+        self.fault.state_dir = os.path.join(self.directory, STATE_SUBDIR)
+        self.fault.on_inject = (
+            lambda kind: self.metrics["tpustack_faults_injected_total"]
+            .labels(server="train", kind=kind).inc())
+        self.mngr = ocp.CheckpointManager(
+            self.directory, options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, save_interval_steps=save_every,
+                enable_async_checkpointing=True))
+        #: saves scheduled but not yet manifest-finalized: [(step, t0)]
+        self._pending = []
+        #: manifest/hash jobs running off the step loop (joined by finalize)
+        self._manifest_threads = []
+        self._manifest_errors = []
+        self.last_requested_step: Optional[int] = None
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    # ------------------------------------------------------------- saving
+    def save(self, step: int, state, force: bool = False) -> bool:
+        """Schedule an async save (honours ``save_interval_steps`` unless
+        ``force``).  Returns whether a save was actually scheduled."""
+        saved = self.mngr.save(step, args=self._ocp.args.StandardSave(state),
+                               force=force)
+        # t0 AFTER the schedule call: orbax blocks in save() until the
+        # PREVIOUS async save commits, and that wait is not THIS save's
+        # duration
+        if saved:
+            self._pending.append((step, time.time()))
+            self.last_requested_step = step
+        return saved
+
+    def poll(self) -> None:
+        """Notice whatever the background saver has committed since the
+        last call and hand each committed step to a manifest worker thread
+        (hashing, metrics, the corruption fault).  Never blocks — neither
+        on in-progress saves nor on hashing."""
+        still = []
+        for step, t0 in self._pending:
+            d = self._step_dir(step)
+            if os.path.isdir(d):
+                self._commit(step, d, t0)
+            elif step != self.last_requested_step:
+                # evicted by max_to_keep before we ever saw it commit
+                log.info("checkpoint step=%d evicted before finalize", step)
+            else:
+                still.append((step, t0))
+        self._pending = still
+
+    def _commit(self, step: int, step_dir: str, t0: float) -> None:
+        """Kick off manifest hashing for a committed step on a worker
+        thread: SHA-256ing a multi-GB checkpoint on the step-loop thread
+        would re-introduce exactly the stall async saves remove."""
+        t = threading.Thread(target=self._finalize_step,
+                             args=(step, step_dir, t0), daemon=True,
+                             name=f"tpustack-manifest-{step}")
+        self._manifest_threads.append(t)
+        t.start()
+
+    def _finalize_step(self, step: int, step_dir: str, t0: float) -> None:
+        # commit instant ≈ the step dir's mtime (the atomic rename lands a
+        # fully-written tree; its last top-level write is the metadata
+        # finalize) — poll() only NOTICES at the next step boundary, and
+        # that lag must not inflate the histogram
+        try:
+            dt = max(0.0, os.path.getmtime(step_dir) - t0)
+        except OSError:
+            dt = max(0.0, time.time() - t0)
+        try:
+            manifest = write_manifest(step_dir)
+        except OSError as e:  # e.g. max_to_keep gc raced the hashing
+            if os.path.isdir(step_dir):
+                self._manifest_errors.append(f"step {step}: {e}")
+                log.error("manifest for step=%d failed: %s", step, e)
+            return
+        self.metrics["tpustack_train_checkpoint_save_seconds"].labels(
+            task=self.task).observe(dt)
+        self.metrics["tpustack_train_last_saved_step"].labels(
+            task=self.task).set(step)
+        log.info("checkpoint step=%d durable: %d files %.1f MB in %.2fs",
+                 step, len(manifest["files"]),
+                 manifest["total_bytes"] / 1e6, dt)
+        self.fault.maybe_corrupt(step, step_dir)
+
+    def finalize(self, raise_errors: bool = True) -> None:
+        """Block until every scheduled save is committed and manifested.
+        ``raise_errors=False`` is for the already-failing exit path, where
+        a secondary save error must not mask the real exception."""
+        try:
+            self.mngr.wait_until_finished()
+        except BaseException as e:
+            log.error("checkpoint flush failed: %s", e)
+            if raise_errors:
+                raise
+        self.poll()
+        for t in self._manifest_threads:
+            t.join()
+        self._manifest_threads = []
+        if self._pending:
+            log.error("checkpoint steps %s never committed",
+                      [s for s, _ in self._pending])
+            self._pending = []
+        if self._manifest_errors:
+            errors, self._manifest_errors = self._manifest_errors, []
+            if raise_errors:
+                raise RuntimeError(
+                    f"checkpoint manifests failed: {errors}")
+
+    def emergency_save(self, step: int, state) -> None:
+        """Flush the preemption checkpoint synchronously and durably.  Skips
+        the save when ``step`` was already requested (e.g. SIGTERM landed
+        right after a periodic save boundary) but still drives it to
+        commit + manifest."""
+        if self.last_requested_step != step:
+            self.save(step, state, force=True)
+        self.finalize(raise_errors=True)
+        self.metrics["tpustack_train_emergency_saves_total"].labels(
+            task=self.task).inc()
+
+    # ------------------------------------------------------------ restore
+    def restore_latest(self, abstract_state) -> Tuple[Any, Optional[int]]:
+        """Restore the newest checkpoint that passes integrity verification,
+        quarantining (``<step>.corrupt``) every newer one that doesn't.
+        Returns ``(state, step)`` or ``(None, None)`` for a fresh start —
+        an empty or partially-written checkpoint directory is a fresh
+        start, never a crash."""
+        try:
+            candidates = sorted(self.mngr.all_steps(), reverse=True)
+        except Exception as e:
+            log.warning("checkpoint dir unreadable (%s); starting fresh", e)
+            return None, None
+        # iterate the candidate steps OURSELVES (newest first) rather than
+        # re-asking the manager after each quarantine: a failed quarantine
+        # rename (read-only volume) must degrade to "skip it", never to an
+        # infinite latest_step()/quarantine loop
+        for n, step in enumerate(candidates):
+            step_dir = self._step_dir(step)
+            ok, reason = verify_manifest(step_dir)
+            if not ok:
+                self.quarantine(step, reason)
+                continue
+            verified = reason == "ok"
+            try:
+                state = self.mngr.restore(
+                    step, args=self._ocp.args.StandardRestore(abstract_state))
+            except Exception as e:
+                if verified:
+                    # the bytes are provably the ones we wrote — a restore
+                    # failure here is a config/topology mismatch (different
+                    # model flags against the same --ckpt-dir), NOT
+                    # corruption.  Quarantining would destructively rename
+                    # good history and silently restart from step 0; fail
+                    # loudly instead.
+                    raise RuntimeError(
+                        f"checkpoint step={step} passed integrity "
+                        f"verification but restore failed — config/topology "
+                        f"mismatch against this --ckpt-dir?") from e
+                self.quarantine(step, f"restore failed: {e}")
+                continue
+            if not verified:
+                log.warning("checkpoint step=%d accepted %s", step, reason)
+            outcome = "fallback" if n else "ok"
+            self.metrics["tpustack_train_restores_total"].labels(
+                task=self.task, outcome=outcome).inc()
+            self.last_requested_step = step
+            return state, step
+        return None, None
+
+    def quarantine(self, step: int, reason: str) -> None:
+        """Rename the bad step out of Orbax's sight and re-scan."""
+        src = self._step_dir(step)
+        dst = src + ".corrupt"
+        k = 1
+        while os.path.exists(dst):
+            k += 1
+            dst = f"{src}.corrupt{k}"
+        log.error("checkpoint step=%d failed verification (%s) — "
+                  "quarantined to %s, falling back to the previous good "
+                  "step", step, reason, os.path.basename(dst))
+        try:
+            os.rename(src, dst)
+        except OSError as e:  # already gone — nothing to quarantine
+            log.warning("quarantine rename failed: %s", e)
+        self.metrics["tpustack_train_checkpoints_quarantined_total"].labels(
+            task=self.task).inc()
+        self.mngr.reload()
+
+    def all_steps(self):
+        return sorted(self.mngr.all_steps())

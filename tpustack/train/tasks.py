@@ -6,10 +6,12 @@ in ``cluster-config/jobs/``):
     python -m tpustack.train.tasks llama2   --steps 100 --batch 16 --fsdp 8 --tp 2
 
 Each task: synthetic data (the reference ships no datasets; throughput is the
-metric), the shared sharded train step, Orbax checkpoint/resume (the
-checkpoint/restore subsystem the reference lacked entirely — SURVEY.md §5),
-and a steps/sec + examples/sec report on stdout.  ``llama2`` initialises
-``jax.distributed`` from JobSet env when NUM_PROCESSES>1.
+metric), the shared sharded train step, preemption-safe Orbax
+checkpoint/resume via ``tpustack.train.resilience`` (async atomic saves,
+integrity-verified restore with corrupt-step quarantine, SIGTERM →
+emergency checkpoint → resumable exit 42 — see docs/RESILIENCE.md
+"Training"), and a steps/sec + examples/sec report on stdout.  ``llama2``
+initialises ``jax.distributed`` from JobSet env when NUM_PROCESSES>1.
 """
 
 from __future__ import annotations
@@ -23,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpustack.train import resilience
 from tpustack.utils import get_logger
 
 log = get_logger("train.tasks")
@@ -35,53 +38,93 @@ def _report(step: int, metrics: Dict[str, Any], t0: float, n_done: int,
              step, float(metrics["loss"]), n_done / dt, n_done * batch / dt)
 
 
-def _maybe_restore(ckpt_dir: Optional[str], state, save_every: int = 50):
+def _state_step(state) -> int:
+    return int(state["step"] if isinstance(state, dict) else state.step)
+
+
+def _maybe_restore(ckpt_dir: Optional[str], state, save_every: int = 50,
+                   task: str = "train"):
+    """Build the resilient checkpointer and restore the newest checkpoint
+    that passes integrity verification (corrupt steps are quarantined, an
+    empty/partially-written directory is a fresh start, never a crash)."""
     if not ckpt_dir:
         return state, None
-    import orbax.checkpoint as ocp
-
-    mngr = ocp.CheckpointManager(ckpt_dir, options=ocp.CheckpointManagerOptions(
-        max_to_keep=3, save_interval_steps=save_every))
-    latest = mngr.latest_step()
-    if latest is not None:
-        shardings = jax.tree.map(lambda x: getattr(x, "sharding", None), state)
-        state = mngr.restore(latest, args=ocp.args.StandardRestore(state))
+    ckpt = resilience.ResilientCheckpointer(ckpt_dir, task=task,
+                                            save_every=save_every)
+    shardings = jax.tree.map(lambda x: getattr(x, "sharding", None), state)
+    restored, latest = ckpt.restore_latest(state)
+    if restored is not None:
         # orbax does not re-apply every leaf's sharding (scalars come back on
         # one device); re-place so the jitted step sees a consistent mesh
         state = jax.tree.map(
             lambda x, s: jax.device_put(x, s) if s is not None else x,
-            state, shardings)
+            restored, shardings)
         log.info("Resumed from checkpoint step %d", latest)
-    return state, mngr
+    return state, ckpt
 
 
-def _maybe_save(mngr, step: int, state, force: bool = False) -> None:
-    if mngr is None:
-        return
-    import orbax.checkpoint as ocp
-
-    mngr.save(step, args=ocp.args.StandardSave(state), force=force)
-
-
-def _train_loop(state, mngr, step, make_batch, args) -> Any:
+def _train_loop(state, ckpt, step, make_batch, args, task: str = "train") -> Any:
     """The shared step loop: resume-deterministic data (per-step seeded),
     per-step rng (``fold_in`` — tasks whose loss samples noise must see
-    FRESH randomness each step), periodic report, checkpointing."""
+    FRESH randomness each step), periodic report, async checkpointing with
+    a barrier on every exit path, and preemption-aware emergency saves.
+
+    At each step boundary (``i`` steps complete): fire the injected kill
+    if armed, then honour a pending SIGTERM — flush an emergency
+    checkpoint of the current state and exit ``EXIT_PREEMPTED``.  The
+    resumed run restores exactly ``i`` steps and replays the identical
+    data/rng stream, so an interrupted run is bitwise-identical to an
+    uninterrupted one (``tools/chaos_train.py`` asserts this)."""
     rng = jax.random.PRNGKey(2)
     t0 = None
-    start = int(state.step)
-    for i in range(start, args.steps):
-        batch = make_batch(np.random.RandomState(i))
-        state, metrics = step(state, batch, jax.random.fold_in(rng, i))
-        if i == start:
-            jax.block_until_ready(metrics["loss"])
-            t0 = time.time()
-        elif (i + 1) % 10 == 0 or i == args.steps - 1:
-            jax.block_until_ready(metrics["loss"])
-            _report(i + 1, metrics, t0, i - start, args.batch)
-        _maybe_save(mngr, i + 1, state, force=i == args.steps - 1)
-    if mngr is not None:
-        mngr.wait_until_finished()
+    start = _state_step(state)
+    guard = resilience.get_guard()
+    try:
+        for i in range(start, args.steps):
+            if ckpt is not None:
+                ckpt.fault.maybe_kill(i)
+            if guard is not None and guard.requested:
+                if ckpt is not None and jax.process_count() == 1:
+                    ckpt.emergency_save(i, state)
+                    log.warning("emergency checkpoint step=%d — exiting %d "
+                                "(resumable)", i, resilience.EXIT_PREEMPTED)
+                elif ckpt is not None:
+                    # orbax saves are COLLECTIVE in a multi-process run: a
+                    # one-sided save from the preempted worker would hang at
+                    # the cross-process barrier until SIGKILL.  Exit
+                    # promptly; the JobSet restart resumes the whole set
+                    # from the last periodic checkpoint.
+                    log.warning("preempted at step=%d in a %d-process run — "
+                                "skipping the (collective) emergency save, "
+                                "resuming from the last periodic checkpoint; "
+                                "exiting %d", i, jax.process_count(),
+                                resilience.EXIT_PREEMPTED)
+                else:
+                    log.warning("preempted at step=%d with no --ckpt-dir "
+                                "(nothing to save) — exiting %d", i,
+                                resilience.EXIT_PREEMPTED)
+                raise resilience.Preempted(i)
+            batch = make_batch(np.random.RandomState(i))
+            state, metrics = step(state, batch, jax.random.fold_in(rng, i))
+            if i == start:
+                jax.block_until_ready(metrics["loss"])
+                t0 = time.time()
+            elif (i + 1) % 10 == 0 or i == args.steps - 1:
+                jax.block_until_ready(metrics["loss"])
+                _report(i + 1, metrics, t0, i - start, args.batch)
+            resilience.beat(task)
+            if ckpt is not None:
+                ckpt.save(i + 1, state, force=i == args.steps - 1)
+                ckpt.poll()
+    except BaseException:
+        # the barrier must run on EVERY exit path (an exception between the
+        # last save and the barrier would strand an uncommitted checkpoint)
+        # but a secondary flush error must not mask the real one
+        if ckpt is not None:
+            ckpt.finalize(raise_errors=False)
+        raise
+    if ckpt is not None:
+        ckpt.finalize(raise_errors=True)
     return state, start
 
 
@@ -146,10 +189,12 @@ def run_sd15(args) -> None:
     tcfg = TrainerConfig(learning_rate=args.lr, remat=args.remat)
     state, _ = make_train_state(pipe.params["unet"], tcfg, mesh=mesh,
                                 rules=rules)
-    state, mngr = _maybe_restore(args.ckpt_dir, state, args.save_every)
+    state, ckpt = _maybe_restore(args.ckpt_dir, state, args.save_every,
+                                 task="sd15")
     step = make_sharded_train_step(loss_fn, tcfg, mesh=mesh,
                                    batch_spec=BATCH_SPEC)
-    state, start = _train_loop(state, mngr, step, make_batch, args)
+    state, start = _train_loop(state, ckpt, step, make_batch, args,
+                               task="sd15")
 
     if args.export_dir:
         from tpustack.models.sd15.weights import save_sd15_safetensors
@@ -164,13 +209,18 @@ def run_sd15(args) -> None:
 
 
 def run_resnet50(args) -> None:
-    """Config #3: ResNet-50, 1 chip.  BatchNorm stats threaded explicitly."""
+    """Config #3: ResNet-50, 1 chip.  BatchNorm stats threaded explicitly
+    through a dict state so the shared resilient loop checkpoints them."""
     import optax
 
     from tpustack.models.resnet import ResNet50
     from tpustack.train.trainer import TrainerConfig, make_optimizer
 
-    model = ResNet50(num_classes=args.classes,
+    # --tiny: one bottleneck block per stage, two stages — the chaos/CI
+    # config (tools/chaos_train.py, tests/test_train_resilience.py): full
+    # ResNet-50 compiles for ~30s on CPU, this compiles in ~2s
+    stage_sizes = (1, 1) if args.tiny else (3, 4, 6, 3)
+    model = ResNet50(num_classes=args.classes, stage_sizes=stage_sizes,
                      dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     size = args.image_size
     rng = jax.random.PRNGKey(0)
@@ -179,54 +229,44 @@ def run_resnet50(args) -> None:
     params, batch_stats = variables["params"], variables["batch_stats"]
     tcfg = TrainerConfig(learning_rate=args.lr)
     opt = make_optimizer(tcfg)
-    opt_state = opt.init(params)
 
-    # Checkpoint/resume: the k8s Job mounts /ckpt on the PVC and passes
-    # --ckpt-dir (cluster-config/jobs/train-resnet50.yaml); a pod restart
-    # (Recreate/backoff) continues from the latest saved step.
-    ckpt = {"step": jnp.zeros((), jnp.int32), "params": params,
-            "batch_stats": batch_stats, "opt_state": opt_state}
-    ckpt, mngr = _maybe_restore(args.ckpt_dir, ckpt, args.save_every)
-    params, batch_stats, opt_state = (
-        ckpt["params"], ckpt["batch_stats"], ckpt["opt_state"])
-    start = int(ckpt["step"])
+    # Checkpoint/resume: the k8s Job mounts /ckpt on a durable volume and
+    # passes --ckpt-dir (cluster-config/jobs/train-resnet50.yaml); a pod
+    # restart (backoffLimit) continues from the latest verified step.
+    state = {"step": jnp.zeros((), jnp.int32), "params": params,
+             "batch_stats": batch_stats, "opt_state": opt.init(params)}
+    state, ckpt = _maybe_restore(args.ckpt_dir, state, args.save_every,
+                                 task="resnet50")
 
     @jax.jit
-    def step_fn(params, batch_stats, opt_state, images, labels):
+    def step_fn(state, batch, rng):
         def loss_fn(p):
             logits, mut = model.apply(
-                {"params": p, "batch_stats": batch_stats}, images, True,
-                mutable=["batch_stats"])
-            onehot = jax.nn.one_hot(labels, args.classes)
+                {"params": p, "batch_stats": state["batch_stats"]},
+                batch["images"], True, mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(batch["labels"], args.classes)
             loss = optax.softmax_cross_entropy(logits, onehot).mean()
             return loss, mut["batch_stats"]
 
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, new_stats, opt_state, {"loss": loss}
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"])
+        updates, opt_state = opt.update(grads, state["opt_state"],
+                                        state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"step": state["step"] + 1, "params": params,
+                "batch_stats": new_stats, "opt_state": opt_state}, \
+            {"loss": loss}
 
-    t0 = None
-    for i in range(start, args.steps):
+    def make_batch(data_rng):
         # per-step seed so a resumed run continues the exact data stream an
         # uninterrupted run would have seen
-        data_rng = np.random.RandomState(i)
-        images = jnp.asarray(data_rng.rand(args.batch, size, size, 3), jnp.float32)
-        labels = jnp.asarray(data_rng.randint(0, args.classes, args.batch))
-        params, batch_stats, opt_state, metrics = step_fn(
-            params, batch_stats, opt_state, images, labels)
-        if i == start:
-            jax.block_until_ready(metrics["loss"])
-            t0 = time.time()  # exclude compile from throughput
-        elif (i + 1) % 10 == 0 or i == args.steps - 1:
-            jax.block_until_ready(metrics["loss"])
-            _report(i + 1, metrics, t0, i - start, args.batch)
-        _maybe_save(mngr, i + 1,
-                    {"step": jnp.asarray(i + 1, jnp.int32), "params": params,
-                     "batch_stats": batch_stats, "opt_state": opt_state},
-                    force=i == args.steps - 1)
-    if mngr is not None:
-        mngr.wait_until_finished()
+        return {"images": jnp.asarray(data_rng.rand(args.batch, size, size, 3),
+                                      jnp.float32),
+                "labels": jnp.asarray(data_rng.randint(0, args.classes,
+                                                       args.batch))}
+
+    state, start = _train_loop(state, ckpt, step_fn, make_batch, args,
+                               task="resnet50")
     log.info("resnet50 done: %d steps", args.steps - start)
 
 
@@ -354,10 +394,11 @@ def _generic_lm_task(args, kind: str) -> None:
 
     tcfg = TrainerConfig(learning_rate=args.lr, remat=args.remat)
     state, specs = make_train_state(params, tcfg, mesh=mesh, rules=rules)
-    state, mngr = _maybe_restore(args.ckpt_dir, state, args.save_every)
+    state, ckpt = _maybe_restore(args.ckpt_dir, state, args.save_every,
+                                 task=kind)
     step = make_sharded_train_step(loss_fn, tcfg, mesh=mesh,
                                    batch_spec=BATCH_SPEC)
-    state, start = _train_loop(state, mngr, step, make_batch, args)
+    state, start = _train_loop(state, ckpt, step, make_batch, args, task=kind)
     log.info("%s done: %d steps on mesh %s", kind, args.steps - start,
              dict(zip(mesh.axis_names, mesh.devices.shape)))
 
@@ -387,7 +428,7 @@ def main(argv=None) -> int:
     p.add_argument("--no-bf16", dest="bf16", action="store_false")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--tiny", action="store_true",
-                   help="tiny model config (CI / smoke)")
+                   help="tiny model config (CI / smoke / chaos harness)")
     p.add_argument("--ckpt-dir", default="")
     p.add_argument("--save-every", type=int, default=50,
                    help="checkpoint save interval in steps")
@@ -404,6 +445,11 @@ def main(argv=None) -> int:
 
     obs_device.install()
     maybe_start_metrics_sidecar()
+
+    # Preemption guard: SIGTERM → emergency checkpoint at the next step
+    # boundary → exit EXIT_PREEMPTED (42), which the Job's restart budget
+    # turns into a resume (docs/RESILIENCE.md "Training")
+    resilience.install_preemption_guard()
 
     if args.task == "resnet50":
         run_resnet50(args)
