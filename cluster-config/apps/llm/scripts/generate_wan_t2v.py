@@ -31,6 +31,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 from datetime import datetime
 from pathlib import Path
 
@@ -114,12 +115,24 @@ def retry_delay_s(attempt, retry_after, backoff_s=0.5, jitter=0.25,
     return base + rng.uniform(0, jitter * base)
 
 
-def get_json(base_url, path, payload=None, timeout=30, retries=0):
+def make_traceparent(trace_id=None):
+    """Client-originated W3C trace context (``00-<trace>-<span>-01``):
+    one trace id per item, a fresh span id per attempt — the server's
+    ``GET /debug/traces/<trace_id>`` then shows the item's whole
+    submit→worker→publish span tree.  Stdlib-only, like the rest of this
+    client."""
+    tid = trace_id or uuid.uuid4().hex
+    return f"00-{tid}-{uuid.uuid4().hex[:16]}-01", tid
+
+
+def get_json(base_url, path, payload=None, timeout=30, retries=0,
+             headers=None):
     url = urllib.parse.urljoin(base_url, path)
     data = json.dumps(payload).encode() if payload is not None else None
-    headers = {"Content-Type": "application/json"} if data else {}
+    base_headers = {"Content-Type": "application/json"} if data else {}
+    base_headers.update(headers or {})
     for attempt in range(retries + 1):
-        req = urllib.request.Request(url, data=data, headers=headers)
+        req = urllib.request.Request(url, data=data, headers=base_headers)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return json.loads(resp.read().decode())
@@ -210,11 +223,12 @@ def already_done(run_dir: Path, prefix: str) -> list:
                   if p.is_file() and p.stat().st_size > 0)
 
 
-def submit(base_url, graph, client_id, retries=4):
+def submit(base_url, graph, client_id, retries=4, trace_id=None):
+    header, _ = make_traceparent(trace_id)
     try:
         resp = get_json(base_url, "/prompt",
                         payload={"prompt": graph, "client_id": client_id},
-                        retries=retries)
+                        retries=retries, headers={"traceparent": header})
     except urllib.error.HTTPError as e:
         # surface the server's JSON error body, not just "400 Bad Request"
         try:
@@ -391,9 +405,11 @@ def main(argv=None):
                 filename_prefix=prefix, save_webm=want_webm,
                 save_webp=want_webp, save_images=want_images,
                 batch_size=args.batch_size)
-            print(f"[{i}/{args.count}] queueing (seed={seed})...")
+            trace_id = uuid.uuid4().hex
+            print(f"[{i}/{args.count}] queueing (seed={seed}, "
+                  f"trace {trace_id})...")
             pid = submit(args.server_url, graph, client_id,
-                         retries=args.retries)
+                         retries=args.retries, trace_id=trace_id)
             entry = wait_for_result(args.server_url, pid,
                                     retries=args.retries)
             files = result_files(entry)

@@ -20,6 +20,12 @@ a restarted Job idempotent against the server's resilience layer:
 - **resume** — an output file that already exists (non-empty) is skipped
   without a request, so a Job restarted after SIGTERM/preemption only pays
   for the images it has not produced yet (``--no-resume`` disables).
+
+Every request also ORIGINATES W3C trace context: a per-image trace id sent
+as ``traceparent`` (retries share the id, so the server-side trace shows
+every attempt).  The id is printed with each result — paste it into the
+server's ``GET /debug/traces/<trace_id>`` to see where that one image
+spent its time (docs/OBSERVABILITY.md "Tracing").
 """
 
 from __future__ import annotations
@@ -30,6 +36,7 @@ import sys
 import threading
 import time
 import traceback
+import uuid
 from pathlib import Path
 
 import requests
@@ -62,6 +69,15 @@ def retry_delay_s(attempt: int, retry_after: str | None,
 _tls = threading.local()
 
 
+def make_traceparent(trace_id: str | None = None) -> tuple[str, str]:
+    """Client-originated W3C trace context (``00-<trace>-<span>-01``): a
+    fresh span id per attempt under one trace id per image, so the
+    server's ``/debug/traces/<trace_id>`` shows the whole retry story.
+    Stdlib-only — this script must stay standalone-runnable."""
+    tid = trace_id or uuid.uuid4().hex
+    return f"00-{tid}-{uuid.uuid4().hex[:16]}-01", tid
+
+
 def _progress_counter():
     """Client-progress counter for the in-cluster Job's /metrics sidecar
     (``TPUSTACK_METRICS_PORT``).  None on workstations without the tpustack
@@ -83,14 +99,17 @@ def _thread_session() -> requests.Session:
 
 
 def _post_with_retries(url: str, payload: dict, name: str,
-                       retries: int = 5) -> requests.Response:
+                       retries: int = 5,
+                       trace_id: str | None = None) -> requests.Response:
     """POST with shed/drain-aware retries: 429/503 honour ``Retry-After``
     (exponential backoff + jitter otherwise) and connection errors retry
     the same way — a rolling update's drain window looks like both."""
     last_exc: Exception | None = None
     for attempt in range(retries + 1):
+        header, trace_id = make_traceparent(trace_id)
         try:
-            resp = _thread_session().post(url, json=payload, timeout=600)
+            resp = _thread_session().post(url, json=payload, timeout=600,
+                                          headers={"traceparent": header})
         except requests.exceptions.ConnectionError as e:
             last_exc = e
             if attempt == retries:
@@ -114,16 +133,18 @@ def _post_with_retries(url: str, payload: dict, name: str,
 def _one_request(url: str, payload: dict, target: Path, name: str,
                  retries: int = 5) -> bool:
     counter = _progress_counter()
+    trace_id = uuid.uuid4().hex  # fixed up front so failures print it too
     try:
-        resp = _post_with_retries(url, payload, name, retries=retries)
+        resp = _post_with_retries(url, payload, name, retries=retries,
+                                  trace_id=trace_id)
         target.write_bytes(resp.content)
         gen_time = resp.headers.get("X-Gen-Time", "?")
-        print(f"    {name} done in {gen_time}")
+        print(f"    {name} done in {gen_time} (trace {trace_id})")
         if counter is not None:
             counter.labels(outcome="ok").inc()
         return True
     except requests.exceptions.RequestException as e:
-        print(f"    Request failed for {name}: {e}")
+        print(f"    Request failed for {name}: {e} (trace {trace_id})")
         traceback.print_exc()
     except Exception as e:
         print(f"    Unexpected error for {name}: {e}")

@@ -79,3 +79,21 @@ def test_extras_degrade_on_tool_failure(monkeypatch):
     assert "error" in out["shared_prefix"]
     wan = bench._wan_extras(lambda *a: None)
     assert "error" in wan
+
+
+def test_run_tool_nonzero_exit_is_error_record(monkeypatch):
+    """ADVICE r5: a tool that exits nonzero after printing a stale JSON-
+    looking line must be recorded as an error (with the stderr tail), not
+    trusted as a measurement."""
+    bench = load_bench()
+
+    def fake_run(cmd, capture_output, text, timeout):
+        return subprocess.CompletedProcess(
+            cmd, 3, stdout=json.dumps({"metric": "stale", "value": 1}) + "\n",
+            stderr="Traceback ...\nRuntimeError: device fell over")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    out = bench._run_tool(lambda *a: None, "t", ["tools/bench_llm.py"])
+    assert out["error"] == "exit code 3"
+    assert "device fell over" in out["stderr_tail"]
+    assert "metric" not in out and "value" not in out

@@ -386,3 +386,16 @@ def test_attention_partials_int8_scales_match_main_path():
                                        k_scale=ks, v_scale=vs)
     got = merge_attention_partials(p1, p2, jnp.float32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-6)
+
+
+def test_panel_block_q_default_gated_on_seq_and_head_dim():
+    """ADVICE r5: the panel kernel's block_q=256 default was compile/VMEM-
+    verified only at D=128 — its VMEM bound (scores + K/V panels) scales
+    with D, so a larger head_dim must fall back to the verified 128."""
+    from tpustack.ops.pallas.flash_attention import _default_block_q
+
+    assert _default_block_q(False, 2560, 128) == 256   # verified config
+    assert _default_block_q(False, 6144, 128) == 256   # verified edge
+    assert _default_block_q(False, 6272, 128) == 128   # past the S bound
+    assert _default_block_q(False, 2560, 160) == 128   # unverified D
+    assert _default_block_q(True, 2560, 128) == 1024   # streaming kernel

@@ -369,3 +369,46 @@ def test_batch_quantized_generator():
                                       seed=0)
     solo, _ = qgen.generate([5, 6, 7], max_new_tokens=5, sample=GREEDY, seed=0)
     assert outs[0] == solo
+
+
+def test_server_seed_coercion_and_rejection(gen):
+    """ADVICE r5: JSON clients round-trip integer seeds as floats (7.0) —
+    those must coerce to int and reproduce, while non-numeric seeds get a
+    400 instead of silently going random (losing the reproducibility the
+    client asked for)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    server = LLMServer(generator=gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test", max_batch=4)
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            outs = []
+            for seed in (7, 7.0):  # int and its JSON-float spelling
+                r = await client.post("/completion", json={
+                    "prompt": "hello", "n_predict": 4, "seed": seed,
+                    "temperature": 0.9})
+                assert r.status == 200, await r.text()
+                outs.append((await r.json())["content"])
+            assert outs[0] == outs[1], "seed 7.0 must behave as seed 7"
+            for bad in ("abc", 7.5, True):
+                r = await client.post("/completion", json={
+                    "prompt": "hello", "n_predict": 4, "seed": bad})
+                assert r.status == 400, (bad, await r.text())
+                assert "seed" in (await r.json())["error"]
+            # the OpenAI surface rejects identically
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "seed": "abc"})
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

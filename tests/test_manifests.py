@@ -352,7 +352,10 @@ def test_podmonitoring_selects_real_workloads():
     seen = 0
     for res in kust["resources"]:
         for pm in _load_all(mon / res):
-            assert pm["kind"] == "PodMonitoring", res
+            if pm["kind"] != "PodMonitoring":
+                # the monitoring dir also carries the SLO rule CRs —
+                # validated structurally by tools/lint_manifests.py
+                continue
             sel = pm["spec"]["selector"]["matchLabels"]
             match = [d for d in deployments.values()
                      if d["metadata"]["namespace"] == pm["metadata"]["namespace"]
@@ -369,6 +372,29 @@ def test_podmonitoring_selects_real_workloads():
                     f"containerPort {port_names}")
             seen += 1
     assert seen >= 3
+
+
+def test_slo_rules_and_prober_wired():
+    """The SLO layer is reconciled: rules in the monitoring kustomization
+    with the multi-window burn-rate alert pairs + prober alerts, and the
+    prober CronJob in the jobs kustomization targeting all three
+    Services."""
+    mon = CLUSTER / "apps" / "monitoring"
+    kust = _load_all(mon / "kustomization.yaml")[0]
+    assert "slo-rules.yaml" in kust["resources"]
+    rules = _load_all(mon / "slo-rules.yaml")[0]
+    alerts = {r["alert"] for g in rules["spec"]["groups"]
+              for r in g["rules"] if "alert" in r}
+    assert {"TpustackAvailabilityFastBurn", "TpustackAvailabilitySlowBurn",
+            "TpustackLatencyFastBurn", "TpustackLatencySlowBurn",
+            "TpustackProbeDown", "TpustackProbeStale"} <= alerts
+    jobs_kust = _load_all(CLUSTER / "jobs" / "kustomization.yaml")[0]
+    assert "prober-cronjob.yaml" in jobs_kust["resources"]
+    prober = _load_all(CLUSTER / "jobs" / "prober-cronjob.yaml")[0]
+    cmd = " ".join(prober["spec"]["jobTemplate"]["spec"]["template"]["spec"]
+                   ["containers"][0]["command"])
+    for flag in ("--llm=", "--sd=", "--graph="):
+        assert flag in cmd, cmd
 
 
 def test_flux_monitoring_kustomization_wired():

@@ -1,0 +1,336 @@
+"""SLO layer + black-box prober + the CI lint extensions that guard them:
+
+- ``tools/slo_report.py`` — exposition parsing, availability/latency SLIs,
+  burn-rate math, delta windows, integration with the real obs registry.
+- ``tools/probe.py`` — per-target checks with an injected fetch (no
+  network), metric export, round output schema.
+- ``tools/lint_metrics.py`` — catalog ↔ OBSERVABILITY.md table, both ways.
+- ``tools/lint_manifests.py`` — monitoring-rules validation (shape,
+  severities, catalog cross-check) + the prober CronJob contract.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import importlib
+
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+# ------------------------------------------------------------- slo_report
+SCRAPE = textwrap.dedent("""\
+    # HELP tpustack_http_requests_total requests
+    # TYPE tpustack_http_requests_total counter
+    tpustack_http_requests_total{server="llm",endpoint="/completion",status="200"} 980
+    tpustack_http_requests_total{server="llm",endpoint="/completion",status="400"} 10
+    tpustack_http_requests_total{server="llm",endpoint="/completion",status="500"} 10
+    tpustack_http_request_latency_seconds_bucket{server="llm",endpoint="/completion",le="30"} 950
+    tpustack_http_request_latency_seconds_bucket{server="llm",endpoint="/completion",le="+Inf"} 1000
+    tpustack_http_request_latency_seconds_count{server="llm",endpoint="/completion"} 1000
+    """)
+
+
+def test_parse_exposition_labels_and_values():
+    slo = _tool("slo_report")
+    samples = slo.parse_exposition(SCRAPE)
+    key = ("tpustack_http_requests_total",
+           (("endpoint", "/completion"), ("server", "llm"),
+            ("status", "500")))
+    assert samples[key] == 10.0
+
+
+def test_availability_and_latency_slis():
+    slo = _tool("slo_report")
+    samples = slo.parse_exposition(SCRAPE)
+    good, total = slo.availability_sli(samples, "llm")
+    assert (good, total) == (990.0, 1000.0)  # 4xx counts as good
+    fast, lat_total = slo.latency_sli(samples, "llm", 30.0)
+    assert (fast, lat_total) == (950.0, 1000.0)
+
+
+def test_burn_rate_math():
+    slo = _tool("slo_report")
+    # SLI 99% against SLO 99.5%: burning 1% bad into a 0.5% budget = 2x
+    assert slo.burn_rate(0.99, 0.995) == pytest.approx(2.0)
+    assert slo.burn_rate(1.0, 0.995) == 0.0
+    # the classic page threshold: error ratio 7.2% on a 0.5% budget
+    assert slo.burn_rate(1 - 0.072, 0.995) == pytest.approx(14.4)
+
+
+def test_report_verdicts():
+    slo = _tool("slo_report")
+    rep = slo.report(slo.parse_exposition(SCRAPE))
+    llm = rep["llm"]
+    assert llm["availability"]["ok"] is False  # 99.0% < 99.5%
+    assert llm["availability"]["burn_rate"] == pytest.approx(2.0)
+    assert llm["latency"]["ok"] is True        # exactly 95%
+    # servers with no traffic in the window report ok/no-traffic
+    assert rep["sd"]["availability"]["sli"] is None
+    assert rep["sd"]["availability"]["ok"] is True
+
+
+def test_delta_window_is_what_rate_sees():
+    slo = _tool("slo_report")
+    prev = slo.parse_exposition(SCRAPE)
+    cur = {k: v * 2 for k, v in prev.items()}
+    window = slo.delta(cur, prev)
+    rep = slo.report(window)
+    # the window doubles both good and bad → same ratios as lifetime
+    assert rep["llm"]["availability"]["events"] == 1000
+    assert rep["llm"]["availability"]["burn_rate"] == pytest.approx(2.0)
+    # a counter reset must clamp at 0, not go negative
+    assert all(v >= 0 for v in slo.delta(prev, cur).values())
+
+
+def test_latency_threshold_must_be_bucket_bound():
+    slo = _tool("slo_report")
+    samples = slo.parse_exposition(SCRAPE)
+    with pytest.raises(ValueError, match="bucket bound"):
+        slo.latency_sli(samples, "llm", 31.0)
+
+
+def test_report_against_real_registry_exposition():
+    """End-to-end: counters observed through the real obs registry parse
+    and report without special-casing (le rendering, label order)."""
+    from tpustack.obs import Registry
+    from tpustack.obs import catalog
+
+    slo = _tool("slo_report")
+    reg = Registry()
+    m = catalog.build(reg)
+    for _ in range(99):
+        m["tpustack_http_requests_total"].labels(
+            server="sd", endpoint="/generate", status="200").inc()
+        m["tpustack_http_request_latency_seconds"].labels(
+            server="sd", endpoint="/generate").observe(0.2)
+    m["tpustack_http_requests_total"].labels(
+        server="sd", endpoint="/generate", status="500").inc()
+    m["tpustack_http_request_latency_seconds"].labels(
+        server="sd", endpoint="/generate").observe(45.0)
+    rep = slo.report(slo.parse_exposition(reg.render()))
+    sd = rep["sd"]
+    assert sd["availability"]["sli"] == pytest.approx(0.99)
+    assert sd["latency"]["sli"] == pytest.approx(0.99)
+    assert sd["availability"]["ok"] is False and sd["latency"]["ok"] is True
+
+
+def test_slo_report_cli_json(tmp_path):
+    import subprocess
+
+    scrape = tmp_path / "scrape.txt"
+    scrape.write_text(SCRAPE)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "slo_report.py"),
+         "--file", str(scrape), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1  # availability SLO missed → CI-visible
+    rep = json.loads(proc.stdout)
+    assert rep["llm"]["availability"]["burn_rate"] == pytest.approx(2.0)
+
+
+# ------------------------------------------------------------------ probe
+def _fake_fetch(responses):
+    """fetch stub: {(method, path-suffix): (status, body_bytes)}."""
+    calls = []
+
+    def fetch(method, url, body=None, headers=None, timeout=10.0):
+        calls.append((method, url, headers))
+        for (m, suffix), (status, payload) in responses.items():
+            if m == method and url.endswith(suffix):
+                return status, {}, payload
+        return 404, {}, b"not found"
+
+    fetch.calls = calls
+    return fetch
+
+
+PNG = b"\x89PNG\r\n\x1a\n" + b"0" * 16
+
+
+def test_probe_all_green_and_metrics():
+    probe = _tool("probe")
+    from tpustack.obs import Registry
+    from tpustack.obs import catalog
+
+    reg = Registry()
+    fetch = _fake_fetch({
+        ("GET", "/healthz"): (200, b"{}"),
+        ("GET", "/readyz"): (200, b"{}"),
+        ("POST", "/completion"): (200, b'{"content": "pong"}'),
+        ("POST", "/generate"): (200, PNG),
+        ("POST", "/prompt"): (200, b'{"prompt_id": "p1"}'),
+        ("GET", "/history/p1"): (200, json.dumps({"p1": {
+            "status": {"completed": True, "status_str": "success"},
+            "outputs": {}}}).encode()),
+    })
+    out = probe.run_round(
+        {"llm": "http://llm", "sd": "http://sd", "graph": "http://graph"},
+        metrics=catalog.build(reg), fetch=fetch, timeout=5)
+    assert out["up"] == {"llm": True, "sd": True, "graph": True}
+    for target in ("llm", "sd", "graph"):
+        assert out["targets"][target]["inference"]["ok"], out["targets"]
+        assert len(out["targets"][target]["inference"]["trace_id"]) == 32
+        assert reg.get_sample_value(
+            "tpustack_probe_up_state", {"target": target}) == 1
+        assert reg.get_sample_value(
+            "tpustack_probe_attempts_total",
+            {"target": target, "check": "inference", "outcome": "ok"}) == 1
+        assert reg.get_sample_value(
+            "tpustack_probe_last_success_seconds",
+            {"target": target}) > 0
+    # inference probes carry client-originated trace context
+    assert any(h and "traceparent" in h for _, _, h in fetch.calls)
+
+
+def test_probe_failure_modes():
+    probe = _tool("probe")
+    from tpustack.obs import Registry
+    from tpustack.obs import catalog
+
+    reg = Registry()
+    fetch = _fake_fetch({
+        ("GET", "/healthz"): (200, b"{}"),
+        ("GET", "/readyz"): (503, b"{}"),          # draining
+        ("POST", "/generate"): (200, b"not a png"),  # wrong payload
+    })
+    out = probe.run_round({"sd": "http://sd"},
+                          metrics=catalog.build(reg), fetch=fetch, timeout=5)
+    assert out["up"] == {"sd": False}
+    checks = out["targets"]["sd"]
+    assert checks["healthz"]["ok"] is True
+    assert checks["readyz"]["ok"] is False
+    assert checks["inference"]["error"] == "not a PNG"
+    assert reg.get_sample_value("tpustack_probe_up_state",
+                                {"target": "sd"}) == 0
+    assert reg.get_sample_value(
+        "tpustack_probe_attempts_total",
+        {"target": "sd", "check": "readyz", "outcome": "failed"}) == 1
+
+
+def test_probe_connection_error_is_failed_not_crash():
+    probe = _tool("probe")
+
+    def fetch(method, url, body=None, headers=None, timeout=10.0):
+        raise OSError("connection refused")
+
+    out = probe.run_round({"llm": "http://down"}, fetch=fetch,
+                          inference=False, timeout=5)
+    assert out["up"] == {"llm": False}
+    assert "connection refused" in out["targets"]["llm"]["healthz"]["error"]
+
+
+# ------------------------------------------------- lint_metrics doc check
+def test_lint_metrics_doc_table_in_sync():
+    lm = _tool("lint_metrics")
+    assert lm.lint_docs() == []
+
+
+def test_lint_metrics_catches_undocumented_metric(monkeypatch):
+    lm = _tool("lint_metrics")
+    from tpustack.obs import catalog as cat
+
+    bogus = cat.MetricSpec("tpustack_bogus_new_total", "counter", "h",
+                           unit="total")
+    monkeypatch.setattr("tpustack.obs.catalog.CATALOG",
+                        cat.CATALOG + (bogus,))
+    errors = lm.lint()
+    assert any("tpustack_bogus_new_total" in e and "missing from" in e
+               for e in errors)
+
+
+def test_lint_metrics_catches_stale_doc_row(tmp_path):
+    lm = _tool("lint_metrics")
+    doc = tmp_path / "OBSERVABILITY.md"
+    with open(lm.DOC_PATH) as f:
+        doc.write_text(f.read() + "\n| `tpustack_ghost_total` | counter "
+                                  "| — | x | deleted metric |\n")
+    errors = lm.lint_docs(str(doc))
+    assert any("tpustack_ghost_total" in e and "not declared" in e
+               for e in errors)
+
+
+# --------------------------------------------- lint_manifests rules check
+def test_lint_manifests_green_on_repo():
+    lmf = _tool("lint_manifests")
+    assert lmf.lint() == []
+
+
+BAD_RULES = textwrap.dedent("""\
+    apiVersion: monitoring.googleapis.com/v1
+    kind: ClusterRules
+    metadata: {name: bad}
+    spec:
+      groups:
+        - name: g
+          rules:
+            - record: no_colons_here
+              expr: up
+            - alert: NoSeverity
+              expr: tpustack_nonexistent_total > 0
+              annotations: {summary: s}
+            - alert: NoSummary
+              expr: up
+              labels: {severity: page}
+            - alert: Both
+              record: x:y
+              expr: up
+            - alert: NoExpr
+              labels: {severity: page}
+              annotations: {summary: s}
+    """)
+
+
+def test_lint_manifests_catches_bad_rules(tmp_path):
+    lmf = _tool("lint_manifests")
+    (tmp_path / "rules.yaml").write_text(BAD_RULES)
+    errors = lmf.lint(root=tmp_path)
+    joined = "\n".join(errors)
+    assert "colon-namespaced" in joined
+    assert "severity" in joined
+    assert "summary" in joined
+    assert "exactly one of record/alert" in joined
+    assert "missing expr" in joined
+    assert "tpustack_nonexistent_total" in joined
+
+
+BAD_PROBER = textwrap.dedent("""\
+    apiVersion: batch/v1
+    kind: CronJob
+    metadata: {name: prober, namespace: smoke}
+    spec:
+      schedule: "*/2 * * * *"
+      jobTemplate:
+        spec:
+          template:
+            spec:
+              restartPolicy: Never
+              containers:
+                - name: prober
+                  image: x
+                  command: [python, /app/tools/probe.py, --llm=http://x]
+                  resources:
+                    requests: {cpu: 100m, memory: 256Mi}
+                    limits: {cpu: 500m, memory: 1Gi}
+    """)
+
+
+def test_lint_manifests_catches_prober_without_metrics(tmp_path):
+    lmf = _tool("lint_manifests")
+    (tmp_path / "prober.yaml").write_text(BAD_PROBER)
+    errors = lmf.lint(root=tmp_path)
+    joined = "\n".join(errors)
+    assert "TPUSTACK_METRICS_PORT" in joined
+    assert "prometheus.io/scrape" in joined
+    assert "concurrencyPolicy" in joined

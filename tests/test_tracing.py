@@ -1,0 +1,475 @@
+"""Distributed tracing: span trees with W3C traceparent propagation, the
+bounded /debug/traces store, slow/error always-keep capture, and the
+ISSUE's acceptance bar — one request through each server (and a traced
+train step) yields a retrievable trace whose spans cover the hot path
+with correct parent links and the client-sent traceparent as root."""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpustack.obs import Registry
+from tpustack.obs.trace import (Tracer, current_span, format_traceparent,
+                                parse_traceparent, SpanContext)
+
+CLIENT_TRACE = "ab" * 16
+CLIENT_SPAN = "12" * 8
+CLIENT_TP = f"00-{CLIENT_TRACE}-{CLIENT_SPAN}-01"
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ------------------------------------------------------------ traceparent
+def test_traceparent_roundtrip():
+    ctx = parse_traceparent(CLIENT_TP)
+    assert ctx == SpanContext(CLIENT_TRACE, CLIENT_SPAN)
+    assert format_traceparent(ctx) == CLIENT_TP
+    # case-insensitive per spec (we normalise to lowercase)
+    assert parse_traceparent(CLIENT_TP.upper()) == ctx
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-id-01",
+    f"00-{'0' * 32}-{CLIENT_SPAN}-01",   # all-zero trace id is invalid
+    f"00-{CLIENT_TRACE}-{'0' * 16}-01",  # all-zero span id is invalid
+    f"ff-{CLIENT_TRACE}-{CLIENT_SPAN}-01",  # version 0xff is invalid
+    f"00-{CLIENT_TRACE}-{CLIENT_SPAN}",  # missing flags
+])
+def test_traceparent_malformed_is_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+# ----------------------------------------------------------- tracer store
+def test_span_tree_parents_and_events():
+    tr = Tracer(slow_s=999)
+    root = tr.start_span("root", parent=parse_traceparent(CLIENT_TP))
+    child = tr.start_span("child", parent=root)
+    child.add_event("hello", k=1)
+    grand = tr.start_span("grand", parent=child.context)
+    grand.end()
+    child.end()
+    root.end()
+    rec = tr.get(CLIENT_TRACE)
+    assert rec is not None and rec["n_spans"] == 3
+    by_name = {s["name"]: s for s in rec["spans"]}
+    assert by_name["root"]["parent_id"] == CLIENT_SPAN
+    assert by_name["child"]["parent_id"] == by_name["root"]["span_id"]
+    assert by_name["grand"]["parent_id"] == by_name["child"]["span_id"]
+    assert by_name["child"]["events"][0]["name"] == "hello"
+    # the nested tree mirrors the parent links (root is the local root —
+    # its remote parent is unknown locally)
+    tree = rec["tree"]
+    assert len(tree) == 1 and tree[0]["name"] == "root"
+    assert tree[0]["children"][0]["children"][0]["name"] == "grand"
+
+
+def test_trace_open_until_last_span_ends():
+    """The graph server's shape: the HTTP root ends in ~1ms while a worker
+    span lives on — the trace must not finalize (or drop late spans)."""
+    tr = Tracer(slow_s=999)
+    root = tr.start_span("root", parent=None)
+    worker = tr.start_span("worker", parent=root.context)
+    root.end()
+    assert tr.get(root.trace_id) is None  # worker still open
+    worker.end()
+    rec = tr.get(root.trace_id)
+    assert rec is not None and rec["n_spans"] == 2
+
+
+def test_ring_buffer_bounded_and_slow_error_kept():
+    tr = Tracer(max_recent=4, slow_s=0.01)
+    slow_id = None
+    err_id = None
+    for i in range(10):
+        sp = tr.start_span(f"t{i}", parent=None)
+        if i == 1:
+            time.sleep(0.015)  # past slow_s → always kept
+            slow_id = sp.trace_id
+        if i == 2:
+            err_id = sp.trace_id
+            sp.end(status="error")
+            continue
+        sp.end()
+    s = tr.summaries()
+    assert len(s["recent"]) == 4  # ring bound holds
+    # the slow and errored traces outlived the ring churn in `kept`
+    kept_ids = {t["trace_id"] for t in s["kept"]}
+    assert slow_id in kept_ids and err_id in kept_ids
+    assert tr.get(slow_id)["slow"] is True
+    assert tr.get(err_id)["status"] == "error"
+    assert s["captured"]["slow"] == 1 and s["captured"]["error"] == 1
+    # slowest is sorted descending
+    durs = [t["duration_s"] for t in s["slowest"]]
+    assert durs == sorted(durs, reverse=True)
+
+
+def test_late_spans_merge_into_finalized_trace():
+    """A span starting AFTER its trace finalized (a 504'd request's root
+    ended while engine spans were still coming) must merge into the stored
+    record, not fork a duplicate trace under the same id."""
+    tr = Tracer(slow_s=999)
+    root = tr.start_span("root", parent=None)
+    tid = root.trace_id
+    root.end()  # trace finalizes with 1 span
+    late = tr.start_span("wave", parent=root.context)  # re-opens live entry
+    late.end()
+    rec = tr.get(tid)
+    assert rec["n_spans"] == 2
+    assert [s["name"] for s in rec["spans"]] == ["root", "wave"]
+    # exactly ONE record for the id across every store view
+    s = tr.summaries()
+    assert sum(1 for t in s["recent"] if t["trace_id"] == tid) == 1
+    # captured counted once, not once per fragment
+    assert s["captured"] == {"ok": 1}
+
+
+def test_add_span_explicit_timing():
+    tr = Tracer(slow_s=999)
+    root = tr.start_span("root", parent=None)
+    tr.add_span("phase", root.context, start_unix=root.start_unix,
+                duration_s=1.5, attrs={"batch": 3})
+    root.end()
+    rec = tr.get(root.trace_id)
+    phase = [s for s in rec["spans"] if s["name"] == "phase"][0]
+    assert phase["duration_s"] == 1.5 and phase["attrs"]["batch"] == 3
+
+
+def test_live_eviction_captures_incomplete():
+    tr = Tracer(max_live=2, slow_s=999)
+    leaked = [tr.start_span(f"leaked{i}", parent=None)  # never ended
+              for i in range(3)]
+    # the 3rd concurrently-open trace pushed the oldest out of the live
+    # table — captured as-is with status "incomplete", not lost
+    assert tr.get(leaked[0].trace_id)["status"] == "incomplete"
+    assert tr.summaries()["captured"]["incomplete"] == 1
+
+
+def test_span_events_bounded():
+    tr = Tracer(slow_s=999)
+    sp = tr.start_span("s", parent=None)
+    for i in range(200):
+        sp.add_event("e", i=i)
+    sp.end()
+    rec = tr.get(sp.trace_id)
+    span = rec["spans"][0]
+    from tpustack.obs.trace import MAX_EVENTS_PER_SPAN
+
+    assert len(span["events"]) == MAX_EVENTS_PER_SPAN
+    assert span["attrs"]["events_dropped"] == 200 - MAX_EVENTS_PER_SPAN
+
+
+def test_span_if_active_is_noop_outside_requests():
+    tr = Tracer(slow_s=999)
+    with tr.span_if_active("phase") as sp:
+        assert sp is None
+    assert tr.summaries()["recent"] == []  # no junk one-span traces
+
+
+# ------------------------------------------------- llm (the acceptance bar)
+@pytest.fixture(scope="module")
+def llm_gen():
+    import jax.numpy as jnp
+
+    from tpustack.models.llama import LlamaConfig
+    from tpustack.models.llm_generate import Generator
+
+    return Generator(LlamaConfig.tiny(max_seq=64), dtype=jnp.float32, seed=3)
+
+
+async def _await_trace(tracer, trace_id, tries=150):
+    for _ in range(tries):
+        rec = tracer.get(trace_id)
+        if rec is not None:
+            return rec
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"trace {trace_id} never finalized")
+
+
+def test_llm_trace_covers_queue_prefill_wave_detokenize(llm_gen):
+    """One /completion through the continuous engine yields a retrievable
+    trace: client traceparent as root, queue→prefill→wave→detokenize spans
+    with correct parent links, prefix-cache event annotated."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    tracer = Tracer(slow_s=999)
+    server = LLMServer(generator=llm_gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test", max_batch=4,
+                       registry=Registry(), tracer=tracer)
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion", json={
+                "prompt": "hello trace", "n_predict": 4, "temperature": 0},
+                headers={"traceparent": CLIENT_TP})
+            assert r.status == 200, await r.text()
+            assert r.headers["X-Trace-Id"] == CLIENT_TRACE
+            rec = await _await_trace(tracer, CLIENT_TRACE)
+            # the store is served over HTTP too
+            r2 = await client.get(f"/debug/traces/{CLIENT_TRACE}")
+            assert r2.status == 200
+            assert (await r2.json())["trace_id"] == CLIENT_TRACE
+            r3 = await client.get("/debug/traces")
+            listing = await r3.json()
+            assert any(t["trace_id"] == CLIENT_TRACE
+                       for t in listing["recent"])
+            return rec
+        finally:
+            await client.close()
+
+    rec = _run(scenario())
+    by_name = {s["name"]: s for s in rec["spans"]}
+    root = by_name["POST /completion"]
+    assert root["parent_id"] == CLIENT_SPAN  # client's span is the parent
+    for phase in ("queue_wait", "prefill", "wave", "detokenize"):
+        assert phase in by_name, sorted(by_name)
+        assert by_name[phase]["parent_id"] == root["span_id"], phase
+    assert by_name["prefill"]["attrs"]["prompt_tokens"] > 0
+    assert by_name["wave"]["attrs"]["generated_tokens"] >= 1
+    # the prefix-cache lookup annotated the root span
+    assert any(e["name"] == "prefix_cache" for e in root["events"])
+
+
+def test_llm_trace_without_traceparent_gets_fresh_id(llm_gen):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    tracer = Tracer(slow_s=999)
+    server = LLMServer(generator=llm_gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test", max_batch=4,
+                       registry=Registry(), tracer=tracer)
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion", json={
+                "prompt": "no header", "n_predict": 2, "temperature": 0})
+            assert r.status == 200
+            tid = r.headers["X-Trace-Id"]
+            assert len(tid) == 32
+            rec = await _await_trace(tracer, tid)
+            assert rec["spans"][0]["parent_id"] is None  # we originated it
+            # health endpoints stay untraced without a traceparent
+            await client.get("/healthz")
+            assert all("healthz" not in t["name"]
+                       for t in tracer.summaries()["recent"])
+        finally:
+            await client.close()
+
+    _run(scenario())
+
+
+# ----------------------------------------------------------------------- sd
+class _StubDev:
+    def __init__(self, value):
+        self._value = value
+
+    def __array__(self, dtype=None, copy=None):
+        return self._value
+
+    def block_until_ready(self):
+        return self
+
+
+class _StubPipe:
+    def generate_async(self, prompt, *, steps=30, guidance_scale=7.5,
+                       seed=None, width=512, height=512, negative_prompt="",
+                       batch_size=1, mesh=None):
+        prompts = [prompt] * batch_size if isinstance(prompt, str) else list(prompt)
+        return _StubDev(np.zeros((len(prompts), height, width, 3), np.uint8))
+
+
+def test_sd_trace_covers_queue_batch_denoise_encode():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.serving.sd_server import SDServer
+
+    tracer = Tracer(slow_s=999)
+    server = SDServer(pipeline=_StubPipe(), mesh=None, batch_window_ms=5,
+                      max_batch=4, registry=Registry(), tracer=tracer)
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/generate",
+                json={"prompt": "s", "steps": 2, "width": 32, "height": 32},
+                headers={"traceparent": CLIENT_TP})
+            assert r.status == 200
+            return await _await_trace(tracer, CLIENT_TRACE)
+        finally:
+            await client.close()
+
+    rec = _run(scenario())
+    by_name = {s["name"]: s for s in rec["spans"]}
+    root = by_name["POST /generate"]
+    assert root["parent_id"] == CLIENT_SPAN
+    for phase in ("queue_wait", "batch_build", "denoise_vae", "png_encode"):
+        assert phase in by_name, sorted(by_name)
+        assert by_name[phase]["parent_id"] == root["span_id"], phase
+    assert by_name["batch_build"]["attrs"]["batch"] >= 1
+
+
+# -------------------------------------------------------------------- graph
+def test_graph_trace_covers_prompt_nodes_finalize(tmp_path):
+    """Accept-and-poll: /prompt answers immediately, the worker publishes
+    later — the client's trace id must still collect the node + finalize
+    spans (the tracer holds the trace open until the prompt span ends)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.serving.graph_server import GraphServer, WanRuntime
+
+    tracer = Tracer(slow_s=999)
+    server = GraphServer(runtime=WanRuntime(models_dir=str(tmp_path / "m"),
+                                            output_dir=str(tmp_path / "o")),
+                         registry=Registry(), tracer=tracer)
+    try:
+        async def scenario():
+            client = TestClient(TestServer(server.build_app()))
+            await client.start_server()
+            try:
+                r = await client.post(
+                    "/prompt",
+                    json={"prompt": {"1": {"class_type": "CLIPTextEncode",
+                                           "inputs": {"text": "x"}}}},
+                    headers={"traceparent": CLIENT_TP})
+                assert r.status == 200
+                pid = (await r.json())["prompt_id"]
+                for _ in range(150):  # wait for the worker to publish
+                    h = await client.get(f"/history/{pid}")
+                    entry = (await h.json()).get(pid)
+                    if entry and entry["status"]["completed"]:
+                        assert entry["status"]["status_str"] == "success"
+                        break
+                    await asyncio.sleep(0.02)
+                else:
+                    raise AssertionError("prompt never completed")
+                return await _await_trace(tracer, CLIENT_TRACE)
+            finally:
+                await client.close()
+
+        rec = _run(scenario())
+    finally:
+        server.shutdown()
+    by_name = {s["name"]: s for s in rec["spans"]}
+    root = by_name["POST /prompt"]
+    assert root["parent_id"] == CLIENT_SPAN
+    prompt = by_name["prompt"]
+    assert prompt["parent_id"] == root["span_id"]
+    assert by_name["node_CLIPTextEncode"]["parent_id"] == prompt["span_id"]
+    assert by_name["finalize"]["parent_id"] == prompt["span_id"]
+
+
+# -------------------------------------------------------------------- train
+def test_train_step_trace_via_sidecar(monkeypatch):
+    """A traced train step is retrievable through the metrics sidecar's
+    /debug/traces — the exposition path train Jobs actually have."""
+    import jax.numpy as jnp
+
+    from tpustack.obs import trace as obs_trace
+    from tpustack.obs.http import start_metrics_sidecar
+    from tpustack.train.tasks import _train_loop
+
+    tracer = Tracer(slow_s=999)
+    monkeypatch.setattr(obs_trace, "TRACER", tracer)
+
+    def step(state, batch, rng):
+        return dict(state, step=state["step"] + 1), {"loss": jnp.float32(0.5)}
+
+    class Args:
+        steps = 2
+        batch = 1
+
+    state, start = _train_loop({"step": 0}, None, step, lambda rng: {},
+                               Args(), task="toy")
+    assert state["step"] == 2 and start == 0
+    steps = [t for t in tracer.summaries()["recent"]
+             if t["name"] == "train_step"]
+    assert len(steps) == 2
+    rec = tracer.get(steps[0]["trace_id"])
+    assert rec["spans"][0]["attrs"]["task"] == "toy"
+
+    srv = start_metrics_sidecar(0, Registry(), host="127.0.0.1",
+                                tracer=tracer)
+    try:
+        port = srv.server_address[1]
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces", timeout=5).read())
+        assert any(t["name"] == "train_step" for t in body["recent"])
+        one = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces/{steps[0]['trace_id']}",
+            timeout=5).read())
+        assert one["spans"][0]["name"] == "train_step"
+    finally:
+        srv.shutdown()
+
+
+def test_checkpoint_commit_span_recorded(monkeypatch, tmp_path):
+    """A durable checkpoint commit lands a checkpoint_commit trace."""
+    from tpustack.obs import trace as obs_trace
+    from tpustack.train.resilience import ResilientCheckpointer
+
+    tracer = Tracer(slow_s=999)
+    monkeypatch.setattr(obs_trace, "TRACER", tracer)
+    import jax.numpy as jnp
+
+    ckpt = ResilientCheckpointer(str(tmp_path / "ck"), task="toy",
+                                 save_every=1)
+    ckpt.save(1, {"w": jnp.zeros((2,))}, force=True)
+    ckpt.finalize(raise_errors=True)
+    commits = [t for t in tracer.summaries()["recent"]
+               if t["name"] == "checkpoint_commit"]
+    assert len(commits) == 1
+    rec = tracer.get(commits[0]["trace_id"])
+    attrs = rec["spans"][0]["attrs"]
+    assert attrs["task"] == "toy" and attrs["step"] == 1
+    assert attrs["files"] >= 1
+
+
+# -------------------------------------------------- resilience annotations
+def test_shed_lands_as_span_event(llm_gen):
+    """A backpressure shed annotates the request's trace — the client can
+    see WHY its request bounced from its own trace id."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    tracer = Tracer(slow_s=999)
+    server = LLMServer(generator=llm_gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test", max_batch=4,
+                       registry=Registry(), tracer=tracer)
+    server.resilience.max_queue_depth = 1
+    server._solo_waiting = 5  # queue_depth() = 5 ≥ 1 → shed
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion",
+                                  json={"prompt": "x", "n_predict": 2},
+                                  headers={"traceparent": CLIENT_TP})
+            assert r.status == 429
+            return await _await_trace(tracer, CLIENT_TRACE)
+        finally:
+            server._solo_waiting = 0
+            await client.close()
+
+    rec = _run(scenario())
+    root = rec["spans"][0]
+    sheds = [e for e in root["events"] if e["name"] == "shed"]
+    assert sheds and sheds[0]["reason"] == "backpressure"

@@ -28,6 +28,18 @@ Kubernetes SIGKILLs the pod mid-drain — exactly the failure
   budget a preempted pod never resumes), and
   ``terminationGracePeriodSeconds`` must cover the emergency-save window
   so SIGKILL cannot land mid-flush.
+- **Monitoring rules** (GMP ``Rules``/``ClusterRules``, upstream
+  ``PrometheusRule``): every group has a name and rules; every rule is
+  exactly a recording (``record:``, colon-namespaced name) or an alert
+  (``alert:`` with an ``expr``, a ``severity`` label and a ``summary``
+  annotation — an alert nobody can triage is noise); and every
+  ``tpustack_*`` metric an expr references exists in the catalog
+  (``tpustack/obs/catalog.py``) — an alert on a typo'd metric never
+  fires, which is worse than no alert.
+- **Prober contract**: a CronJob running ``tools/probe.py`` must export
+  its ``tpustack_probe_*`` metrics (``TPUSTACK_METRICS_PORT`` env +
+  ``prometheus.io/*`` scrape annotations) and pin ``concurrencyPolicy``
+  (overlapping probe pods double-count attempts).
 
 Vendored upstream files (the Flux toolkit export) are skipped — we lint
 what we author.  Runs standalone (``python tools/lint_manifests.py``,
@@ -39,9 +51,10 @@ exit 1 on violations) and inside the tier-1 suite
 from __future__ import annotations
 
 import os
+import re
 import sys
 from pathlib import Path
-from typing import List
+from typing import List, Optional, Set
 
 import yaml
 
@@ -64,6 +77,116 @@ TRAIN_CKPT_GRACE_S = 60
 DURABLE_VOLUME_KEYS = ("persistentVolumeClaim", "hostPath", "nfs", "csi")
 
 WORKLOAD_KINDS = ("Deployment", "DaemonSet", "Job", "CronJob", "JobSet")
+
+#: monitoring-rule CR kinds: GMP managed-collection flavours + the
+#: prometheus-operator upstream
+RULES_KINDS = ("Rules", "ClusterRules", "GlobalRules", "PrometheusRule")
+
+#: recording-rule naming: level:metric:operations (Prometheus convention)
+_RECORD_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*(:[a-zA-Z0-9_]+)+$")
+
+#: tpustack metric tokens inside a PromQL expr (histogram suffixes are
+#: normalized back to the family name before the catalog check)
+_EXPR_METRIC_RE = re.compile(r"\btpustack_[a-z0-9_]+")
+
+_ALERT_SEVERITIES = {"page", "ticket", "info", "warning", "critical"}
+
+
+def _catalog_metric_names() -> Optional[Set[str]]:
+    """Declared metric names (plus histogram sample suffixes), or None if
+    the package cannot be imported (the lint still runs structurally)."""
+    sys.path.insert(0, str(REPO))
+    try:
+        from tpustack.obs.catalog import CATALOG
+    except Exception:
+        return None
+    finally:
+        sys.path.pop(0)
+    names: Set[str] = set()
+    for spec in CATALOG:
+        names.add(spec.name)
+        if spec.type == "histogram":
+            names.update(f"{spec.name}{sfx}"
+                         for sfx in ("_bucket", "_sum", "_count"))
+    return names
+
+
+def _check_monitoring_rules(where: str, doc, errors: List[str],
+                            catalog: Optional[Set[str]]) -> None:
+    groups = (doc.get("spec") or {}).get("groups")
+    if not groups:
+        errors.append(f"{where}: rules CR without spec.groups")
+        return
+    for gi, group in enumerate(groups):
+        gname = group.get("name") or f"#{gi}"
+        if not group.get("name"):
+            errors.append(f"{where}: group #{gi} has no name")
+        rules = group.get("rules")
+        if not rules:
+            errors.append(f"{where}: group {gname!r} has no rules")
+            continue
+        for ri, rule in enumerate(rules):
+            rid = rule.get("record") or rule.get("alert") or f"#{ri}"
+            rwhere = f"{where}/{gname}/{rid}"
+            record, alert = rule.get("record"), rule.get("alert")
+            if bool(record) == bool(alert):
+                errors.append(f"{rwhere}: rule must set exactly one of "
+                              "record/alert")
+                continue
+            expr = rule.get("expr")
+            if not isinstance(expr, str) or not expr.strip():
+                errors.append(f"{rwhere}: missing expr")
+                continue
+            if record and not _RECORD_NAME_RE.match(record):
+                errors.append(f"{rwhere}: recording rule name must be "
+                              "colon-namespaced (level:metric:operations)")
+            if alert:
+                severity = (rule.get("labels") or {}).get("severity")
+                if severity not in _ALERT_SEVERITIES:
+                    errors.append(
+                        f"{rwhere}: alert severity label must be one of "
+                        f"{sorted(_ALERT_SEVERITIES)}, got {severity!r}")
+                if not (rule.get("annotations") or {}).get("summary"):
+                    errors.append(f"{rwhere}: alert needs an annotations."
+                                  "summary (operators triage from it)")
+            if catalog is not None:
+                for token in set(_EXPR_METRIC_RE.findall(expr)):
+                    if token not in catalog:
+                        errors.append(
+                            f"{rwhere}: expr references {token}, which is "
+                            "not in tpustack/obs/catalog.py — the rule "
+                            "would never fire")
+
+
+def _is_prober(container) -> bool:
+    argv = [str(a) for a in ((container.get("command") or [])
+                             + (container.get("args") or []))]
+    return any("probe.py" in a for a in argv)
+
+
+def _check_prober_contract(where: str, doc, errors: List[str]) -> None:
+    if doc.get("kind") != "CronJob":
+        return
+    for tmpl in _pod_templates(doc):
+        spec = tmpl.get("spec", {})
+        probers = [c for c in spec.get("containers", []) or []
+                   if _is_prober(c)]
+        if not probers:
+            continue
+        annotations = (tmpl.get("metadata") or {}).get("annotations") or {}
+        if annotations.get("prometheus.io/scrape") != "true":
+            errors.append(f"{where}: prober pod template missing "
+                          "prometheus.io/scrape annotations — its "
+                          "tpustack_probe_* metrics would never be scraped")
+        for c in probers:
+            if _env_value(c, "TPUSTACK_METRICS_PORT") is None:
+                errors.append(
+                    f"{where}: prober container {c.get('name')!r} does not "
+                    "set TPUSTACK_METRICS_PORT (no sidecar, no metrics)")
+        if not doc["spec"].get("concurrencyPolicy"):
+            errors.append(f"{where}: prober CronJob must pin "
+                          "concurrencyPolicy (overlapping probe pods "
+                          "double-count attempts)")
 
 
 def _pod_templates(doc):
@@ -210,6 +333,7 @@ def lint(root: Path = None) -> List[str]:
     """Return a list of violation strings (empty = clean)."""
     root = Path(root) if root is not None else REPO / "cluster-config"
     errors: List[str] = []
+    catalog = _catalog_metric_names()
     for path in sorted(root.rglob("*.yaml")):
         rel = path.relative_to(root).as_posix()
         if rel in SKIP_FILES:
@@ -221,17 +345,25 @@ def lint(root: Path = None) -> List[str]:
                 errors.append(f"{rel}: unparseable YAML: {e}")
                 continue
         for doc in docs:
-            if not isinstance(doc, dict) or doc.get("kind") not in WORKLOAD_KINDS:
+            if not isinstance(doc, dict):
                 continue
-            where = f"{rel}/{doc.get('kind')}/{doc['metadata'].get('name')}"
+            kind = doc.get("kind")
+            if kind in RULES_KINDS:
+                where = f"{rel}/{kind}/{doc['metadata'].get('name')}"
+                _check_monitoring_rules(where, doc, errors, catalog)
+                continue
+            if kind not in WORKLOAD_KINDS:
+                continue
+            where = f"{rel}/{kind}/{doc['metadata'].get('name')}"
             for tmpl in _pod_templates(doc):
                 for container in (tmpl.get("spec", {}).get("containers")
                                   or []):
                     _check_resources(where, container, errors)
-            if doc.get("kind") == "Deployment":
+            if kind == "Deployment":
                 _check_deployment(where, doc, errors)
             _check_drain_consistency(where, doc, errors)
             _check_train_ckpt_contract(where, doc, errors)
+            _check_prober_contract(where, doc, errors)
     return errors
 
 

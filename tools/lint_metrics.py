@@ -13,7 +13,11 @@ metrics are declared — against the naming contract:
 - label names are snake_case and never repeat a reserved name (``le``,
   ``quantile``, anything ``__``-prefixed);
 - histogram buckets are strictly ascending and finite;
-- help strings exist; names are unique.
+- help strings exist; names are unique;
+- the catalog and the ``docs/OBSERVABILITY.md`` metric table agree BOTH
+  ways: every declared metric has a documented row, and every documented
+  row names a declared metric — a metric shipped without operator docs
+  (or a doc row for a deleted metric) fails CI.
 
 Runs standalone (``python tools/lint_metrics.py``, exit 1 on violations)
 and inside the tier-1 suite (``tests/test_obs.py`` imports ``lint()``), so
@@ -47,12 +51,51 @@ UNIT_SUFFIXES = (
 )
 _RESERVED_LABELS = {"le", "quantile"}
 
+#: the operator-facing metric table this lint keeps in lock-step with the
+#: catalog
+DOC_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
+
+#: a doc table row: | `tpustack_...` | type | ...
+_DOC_ROW_RE = re.compile(r"^\|\s*`(tpustack_[a-z0-9_]+)`\s*\|")
+
+
+def documented_metrics(doc_path: str = DOC_PATH) -> List[str]:
+    """Metric names from the OBSERVABILITY.md table (first backticked
+    ``tpustack_*`` cell of each table row)."""
+    names: List[str] = []
+    with open(doc_path) as f:
+        for line in f:
+            m = _DOC_ROW_RE.match(line.strip())
+            if m:
+                names.append(m.group(1))
+    return names
+
+
+def lint_docs(doc_path: str = DOC_PATH) -> List[str]:
+    """Catalog ↔ doc-table cross-check, both directions."""
+    from tpustack.obs.catalog import CATALOG
+
+    errors: List[str] = []
+    try:
+        documented = set(documented_metrics(doc_path))
+    except OSError as e:
+        return [f"cannot read {doc_path}: {e}"]
+    declared = {spec.name for spec in CATALOG}
+    for name in sorted(declared - documented):
+        errors.append(f"{name}: declared in the catalog but missing from "
+                      f"the {os.path.basename(doc_path)} metric table")
+    for name in sorted(documented - declared):
+        errors.append(f"{name}: documented in {os.path.basename(doc_path)} "
+                      "but not declared in the catalog")
+    return errors
+
 
 def lint() -> List[str]:
     """Return a list of violation strings (empty = clean)."""
     from tpustack.obs.catalog import CATALOG
 
-    errors: List[str] = []
+    errors: List[str] = lint_docs()
     seen = set()
     for spec in CATALOG:
         where = f"{spec.name}:"

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Black-box prober: exercise each serving app the way a CLIENT does.
+
+The SLO burn-rate alerts (``cluster-config/apps/monitoring/slo-rules.yaml``)
+are computed from the servers' OWN counters — a wedged pod that stops
+serving also stops reporting, and the alert goes quiet exactly when it
+matters.  This prober closes that hole from the outside: every round it
+hits ``/healthz``, ``/readyz`` and a tiny real inference on each target,
+exports the results as ``tpustack_probe_*`` metrics (catalog-declared)
+through the ``TPUSTACK_METRICS_PORT`` sidecar, and prints one JSON line
+per round.  ``cluster-config/jobs/prober-cronjob.yaml`` runs it on a
+schedule with scrape annotations.
+
+Checks per target kind:
+
+- ``llm``   — GET /healthz, GET /readyz, POST /completion (1 greedy token)
+- ``sd``    — GET /healthz, GET /readyz, POST /generate (1 step, 64x64)
+- ``graph`` — GET /healthz, GET /readyz, POST /prompt with a
+  CLIPTextEncode-only graph, polled to success via /history — a full
+  submit→worker→publish round trip with no device work.
+
+Inference probes send a W3C ``traceparent`` (the tracing layer's client
+contract), so a failing probe's trace id — printed in the JSON line — can
+be pulled from the server's ``GET /debug/traces/<trace_id>`` while the
+incident is still warm.
+
+Usage::
+
+    python tools/probe.py --llm http://localhost:8080 \
+        --sd http://localhost:8000 --graph http://localhost:8181 \
+        [--count 6 --interval 15] [--no-inference] [--json]
+
+Exit code: 0 when the FINAL round was fully green, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: a graph the worker executes end-to-end without touching the pipeline
+#: (CLIPTextEncode is symbolic) — the cheapest full queue round trip
+PROBE_GRAPH = {"1": {"class_type": "CLIPTextEncode",
+                     "inputs": {"text": "probe"}}}
+
+#: Fetch signature: (method, url, body_json_or_None, headers, timeout)
+#: → (status:int, headers:dict, body:bytes).  Injectable for tests.
+Fetch = Callable[..., Tuple[int, Dict[str, str], bytes]]
+
+
+def _urllib_fetch(method: str, url: str, body: Optional[dict] = None,
+                  headers: Optional[Dict[str, str]] = None,
+                  timeout: float = 30.0):
+    data = json.dumps(body).encode() if body is not None else None
+    hdrs = {"Content-Type": "application/json"} if data else {}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=data, headers=hdrs, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def make_traceparent() -> Tuple[str, str]:
+    # unlike the deliberately stdlib-only batch clients, the prober already
+    # imports tpustack — use the canonical helpers so it can never drift
+    # from the parser it is probing
+    from tpustack.obs.trace import (SpanContext, format_traceparent,
+                                    new_span_id, new_trace_id)
+
+    tid = new_trace_id()
+    return format_traceparent(SpanContext(tid, new_span_id())), tid
+
+
+# ------------------------------------------------------------------ checks
+def _http_check(fetch: Fetch, method: str, url: str, body=None,
+                headers=None, timeout=30.0, expect: int = 200,
+                validate=None) -> Dict[str, object]:
+    t0 = time.perf_counter()
+    try:
+        status, _, payload = fetch(method, url, body, headers, timeout)
+    except Exception as e:  # DNS, refused, timeout — the black-box verdict
+        return {"ok": False, "latency_s": round(time.perf_counter() - t0, 4),
+                "error": f"{type(e).__name__}: {e}"}
+    out: Dict[str, object] = {
+        "ok": status == expect,
+        "latency_s": round(time.perf_counter() - t0, 4)}
+    if status != expect:
+        out["error"] = f"status {status} (want {expect})"
+    elif validate is not None:
+        err = validate(payload)
+        if err:
+            out["ok"] = False
+            out["error"] = err
+    return out
+
+
+def _validate_json_key(key: str):
+    def check(payload: bytes) -> Optional[str]:
+        try:
+            body = json.loads(payload.decode())
+        except ValueError:
+            return "response is not JSON"
+        return None if key in body else f"response missing {key!r}"
+    return check
+
+
+def _validate_png(payload: bytes) -> Optional[str]:
+    return None if payload[:8] == b"\x89PNG\r\n\x1a\n" else "not a PNG"
+
+
+def _probe_graph_inference(fetch: Fetch, base: str, headers,
+                           timeout: float) -> Dict[str, object]:
+    """submit → poll /history to completion: a full accept→worker→publish
+    round trip (the probe graph is symbolic, so no device work)."""
+    t0 = time.perf_counter()
+
+    def fail(error: str) -> Dict[str, object]:
+        return {"ok": False, "latency_s": round(time.perf_counter() - t0, 4),
+                "error": error}
+
+    try:
+        status, _, payload = fetch(
+            "POST", base + "/prompt",
+            {"prompt": PROBE_GRAPH, "client_id": "probe"}, headers, timeout)
+        if status != 200:
+            return fail(f"status {status} (want 200)")
+        pid = json.loads(payload.decode()).get("prompt_id")
+        if not pid:
+            return fail("response missing 'prompt_id'")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            _, _, hist = fetch("GET", f"{base}/history/{pid}", None, None, 10)
+            entry = json.loads(hist.decode()).get(pid)
+            if entry and entry.get("status", {}).get("completed"):
+                if entry["status"].get("status_str") == "success":
+                    return {"ok": True, "latency_s": round(
+                        time.perf_counter() - t0, 4)}
+                return fail(str(entry["status"].get("messages")))
+            time.sleep(0.2)
+        return fail("prompt never completed within timeout")
+    except Exception as e:
+        return fail(f"{type(e).__name__}: {e}")
+
+
+def probe_target(kind: str, base: str, fetch: Fetch = _urllib_fetch,
+                 inference: bool = True,
+                 timeout: float = 60.0) -> Dict[str, dict]:
+    """Run one target's checks; returns {check: {ok, latency_s, error?,
+    trace_id? (inference)}}."""
+    base = base.rstrip("/")
+    checks: Dict[str, dict] = {
+        "healthz": _http_check(fetch, "GET", base + "/healthz", timeout=10),
+        "readyz": _http_check(fetch, "GET", base + "/readyz", timeout=10),
+    }
+    if not inference:
+        return checks
+    header, tid = make_traceparent()
+    hdrs = {"traceparent": header}
+    if kind == "llm":
+        res = _http_check(
+            fetch, "POST", base + "/completion",
+            body={"prompt": "ping", "n_predict": 1, "temperature": 0},
+            headers=hdrs, timeout=timeout,
+            validate=_validate_json_key("content"))
+    elif kind == "sd":
+        res = _http_check(
+            fetch, "POST", base + "/generate",
+            body={"prompt": "probe", "steps": 1, "width": 64, "height": 64},
+            headers=hdrs, timeout=timeout, validate=_validate_png)
+    elif kind == "graph":
+        res = _probe_graph_inference(fetch, base, hdrs, timeout)
+    else:
+        raise ValueError(f"unknown probe kind {kind!r}")
+    res["trace_id"] = tid
+    checks["inference"] = res
+    return checks
+
+
+# ----------------------------------------------------------------- metrics
+def _export(metrics, target: str, checks: Dict[str, dict]) -> bool:
+    up = all(c["ok"] for c in checks.values())
+    for check, c in checks.items():
+        metrics["tpustack_probe_attempts_total"].labels(
+            target=target, check=check,
+            outcome="ok" if c["ok"] else "failed").inc()
+        metrics["tpustack_probe_latency_seconds"].labels(
+            target=target, check=check).observe(c["latency_s"])
+    metrics["tpustack_probe_up_state"].labels(target=target).set(
+        1 if up else 0)
+    if up:
+        metrics["tpustack_probe_last_success_seconds"].labels(
+            target=target).set(time.time())
+    return up
+
+
+def run_round(targets: Dict[str, str], metrics=None,
+              fetch: Fetch = _urllib_fetch, inference: bool = True,
+              timeout: float = 60.0) -> Dict[str, object]:
+    """One probe round over every target; returns the JSON-line payload."""
+    results: Dict[str, dict] = {}
+    up: Dict[str, bool] = {}
+    for kind, base in targets.items():
+        checks = probe_target(kind, base, fetch=fetch, inference=inference,
+                              timeout=timeout)
+        results[kind] = checks
+        ok = all(c["ok"] for c in checks.values())
+        up[kind] = (ok if metrics is None
+                    else _export(metrics, kind, checks))
+    return {"ts": round(time.time(), 3), "up": up, "targets": results}
+
+
+def main(argv: List[str] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--llm", help="LLM server base URL")
+    p.add_argument("--sd", help="SD server base URL")
+    p.add_argument("--graph", help="graph server base URL")
+    p.add_argument("--count", type=int, default=1,
+                   help="probe rounds to run (default 1; the CronJob runs "
+                        "several per invocation so the sidecar is "
+                        "scrapeable for most of the schedule window)")
+    p.add_argument("--interval", type=float, default=15.0,
+                   help="seconds between rounds (default 15)")
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="per-inference-check timeout (default 60)")
+    p.add_argument("--no-inference", action="store_true",
+                   help="health/ready checks only (no device work)")
+    args = p.parse_args(argv)
+
+    targets = {k: v for k, v in
+               (("llm", args.llm), ("sd", args.sd), ("graph", args.graph))
+               if v}
+    if not targets:
+        p.error("give at least one of --llm/--sd/--graph")
+
+    # metrics through the shared catalog + the stdlib sidecar — the same
+    # exposition path every batch/train Job uses (TPUSTACK_METRICS_PORT)
+    from tpustack.obs import catalog
+    from tpustack.obs.http import maybe_start_metrics_sidecar
+
+    metrics = catalog.build()
+    maybe_start_metrics_sidecar()
+
+    last_ok = False
+    for i in range(args.count):
+        if i:
+            time.sleep(args.interval)
+        round_result = run_round(targets, metrics=metrics,
+                                 inference=not args.no_inference,
+                                 timeout=args.timeout)
+        last_ok = all(round_result["up"].values())
+        print(json.dumps(round_result), flush=True)
+    return 0 if last_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

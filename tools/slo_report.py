@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""SLO attainment + burn-rate report from a ``/metrics`` scrape.
+
+The Prometheus side of the SLO story lives in
+``cluster-config/apps/monitoring/slo-rules.yaml`` (recording rules +
+multi-window burn-rate alerts); this tool computes the SAME math offline —
+from a saved scrape, a live ``/metrics`` URL, or a ``bench.py`` driver
+artifact — so an operator (or CI) can answer "are we inside the error
+budget" without a Prometheus in the loop.
+
+Definitions (the Google SRE-workbook shape):
+
+- **availability SLI** — non-5xx responses / all responses, per server,
+  from ``tpustack_http_requests_total``.
+- **latency SLI** — responses faster than the server's threshold / all,
+  from the ``tpustack_http_request_latency_seconds`` histogram's
+  cumulative ``le`` buckets (the threshold must be a bucket bound).
+- **burn rate** — (1 - SLI) / (1 - SLO): 1.0 burns the whole budget in
+  exactly one SLO window, 14.4 burns a 30-day budget in 2 days (the
+  classic page threshold over 1h), 6 in 5 days (ticket over 6h).
+
+Windows: counters in one scrape are lifetime-cumulative; pass a SECOND,
+earlier scrape with ``--prev`` and the report becomes the delta window
+between them — that is exactly what ``rate()`` gives the alert rules.
+
+Usage::
+
+    python tools/slo_report.py --file scrape.txt [--prev older.txt] [--json]
+    python tools/slo_report.py --url http://localhost:8080/metrics
+    python tools/slo_report.py --bench BENCH_r05.json
+
+Exit code: 0 when every SLI meets its SLO over the report window, 1
+otherwise (CI-friendly), 2 for usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: SLO targets per server — mirror slo-rules.yaml; latency thresholds MUST
+#: be exact bucket bounds of tpustack_http_request_latency_seconds
+#: (DEFAULT_BUCKETS).  graph's /prompt is accept-and-poll (answers in ms),
+#: hence the much tighter latency bound than the inference servers.
+SLOS: Dict[str, Dict[str, float]] = {
+    "llm": {"availability": 0.995, "latency": 0.95, "latency_threshold_s": 30.0},
+    "sd": {"availability": 0.995, "latency": 0.95, "latency_threshold_s": 30.0},
+    "graph": {"availability": 0.995, "latency": 0.95, "latency_threshold_s": 1.0},
+}
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+Sample = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def parse_exposition(text: str) -> Dict[Sample, float]:
+    """Prometheus text exposition → {(name, sorted-label-pairs): value}.
+    Tolerant: comment/blank/unparseable lines are skipped (a report tool
+    must survive a scrape captured mid-write)."""
+    out: Dict[Sample, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labelstr, value = m.group(1), m.group(2) or "", m.group(3)
+        labels = tuple(sorted(
+            (k, v.replace(r"\"", '"').replace(r"\n", "\n")
+             .replace("\\\\", "\\"))
+            for k, v in _LABEL_RE.findall(labelstr)))
+        try:
+            out[(name, labels)] = float(value)
+        except ValueError:
+            continue  # e.g. NaN spelled oddly — skip, don't die
+    return out
+
+
+def delta(cur: Dict[Sample, float],
+          prev: Optional[Dict[Sample, float]]) -> Dict[Sample, float]:
+    """Counter-style window: cur - prev per sample, clamped at 0 (a counter
+    reset — pod restart between scrapes — must not go negative).  Samples
+    absent from prev count from 0 (new label combination)."""
+    if not prev:
+        return dict(cur)
+    return {k: max(0.0, v - prev.get(k, 0.0)) for k, v in cur.items()}
+
+
+def _sum_where(samples: Dict[Sample, float], name: str,
+               match: Dict[str, str] = None,
+               match_re: Dict[str, str] = None) -> float:
+    total = 0.0
+    for (n, labels), v in samples.items():
+        if n != name:
+            continue
+        d = dict(labels)
+        if match and any(d.get(k) != want for k, want in match.items()):
+            continue
+        if match_re and any(not re.fullmatch(rx, d.get(k, ""))
+                            for k, rx in match_re.items()):
+            continue
+        total += v
+    return total
+
+
+def availability_sli(samples: Dict[Sample, float],
+                     server: str) -> Tuple[float, float]:
+    """(good, total) requests for one server — good = non-5xx.  4xx counts
+    as good: a client error is not the server failing its SLO."""
+    total = _sum_where(samples, "tpustack_http_requests_total",
+                       match={"server": server})
+    bad = _sum_where(samples, "tpustack_http_requests_total",
+                     match={"server": server}, match_re={"status": r"5\d\d"})
+    return total - bad, total
+
+
+def latency_sli(samples: Dict[Sample, float], server: str,
+                threshold_s: float) -> Tuple[float, float]:
+    """(fast, total) requests from the latency histogram's cumulative
+    ``le=threshold`` bucket.  Raises if the threshold is not an exact
+    bucket bound — silently interpolating would fake precision."""
+    total = _sum_where(samples, "tpustack_http_request_latency_seconds_count",
+                       match={"server": server})
+    fast = 0.0
+    found = False
+    for (n, labels), v in samples.items():
+        if n != "tpustack_http_request_latency_seconds_bucket":
+            continue
+        d = dict(labels)
+        if d.get("server") != server:
+            continue
+        try:
+            le = float(d.get("le", "nan").replace("+Inf", "inf"))
+        except ValueError:
+            continue
+        if le == threshold_s:
+            fast += v
+            found = True
+    if total and not found:
+        raise ValueError(
+            f"latency threshold {threshold_s}s is not a bucket bound of "
+            "tpustack_http_request_latency_seconds — pick one of "
+            "DEFAULT_BUCKETS (tpustack/obs/metrics.py)")
+    return fast, total
+
+
+def burn_rate(sli: float, slo: float) -> float:
+    """(1-SLI)/(1-SLO): 1.0 = burning the budget exactly at the sustainable
+    rate; >1 exhausts it early.  inf when the SLO is 100% and anything
+    failed."""
+    bad, budget = 1.0 - sli, 1.0 - slo
+    if budget <= 0:
+        return math.inf if bad > 0 else 0.0
+    return bad / budget
+
+
+def report(samples: Dict[Sample, float],
+           slos: Dict[str, Dict[str, float]] = None) -> Dict[str, dict]:
+    """Per-server SLO verdicts over whatever window ``samples`` represents
+    (lifetime for one scrape, the delta window with ``--prev``)."""
+    out: Dict[str, dict] = {}
+    for server, cfg in (slos or SLOS).items():
+        good, total = availability_sli(samples, server)
+        fast, lat_total = latency_sli(samples, server,
+                                      cfg["latency_threshold_s"])
+        entry: Dict[str, dict] = {}
+        for kind, (num, den, slo) in {
+            "availability": (good, total, cfg["availability"]),
+            "latency": (fast, lat_total, cfg["latency"]),
+        }.items():
+            if den == 0:
+                entry[kind] = {"sli": None, "slo": slo, "events": 0,
+                               "burn_rate": None, "ok": True,
+                               "note": "no traffic in window"}
+                continue
+            sli = num / den
+            br = burn_rate(sli, slo)
+            entry[kind] = {
+                "sli": round(sli, 6), "slo": slo, "events": int(den),
+                "bad_events": int(den - num),
+                "error_budget_consumed": round(br, 4),  # fraction-of-window
+                "burn_rate": round(br, 4),
+                "ok": sli >= slo,
+            }
+            if kind == "latency":
+                entry[kind]["threshold_s"] = cfg["latency_threshold_s"]
+        out[server] = entry
+    return out
+
+
+def bench_report(artifact: dict,
+                 slos: Dict[str, Dict[str, float]] = None) -> dict:
+    """Sanity view over a bench.py driver artifact: does the measured p99
+    batch latency clear the SD latency threshold?  A bench artifact has
+    percentiles, not counters — this is a threshold check, not a burn
+    rate."""
+    slos = slos or SLOS
+    pcts = artifact.get("batch_latency_percentiles_s") or {}
+    threshold = slos["sd"]["latency_threshold_s"]
+    p99 = pcts.get("p99")
+    return {
+        "metric": artifact.get("metric"),
+        "p99_s": p99,
+        "latency_threshold_s": threshold,
+        "ok": (p99 is not None and p99 <= threshold),
+        "note": "bench artifacts carry percentiles, not counters — "
+                "threshold check only, no burn rate",
+    }
+
+
+def _read(source: str) -> str:
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            return resp.read().decode()
+    with open(source) as f:
+        return f.read()
+
+
+def _print_human(rep: Dict[str, dict]) -> None:
+    for server, entry in rep.items():
+        print(f"{server}:")
+        for kind, r in entry.items():
+            if r["sli"] is None:
+                print(f"  {kind:<13} —           (no traffic)")
+                continue
+            mark = "OK  " if r["ok"] else "FAIL"
+            extra = (f" (≤{r['threshold_s']}s)"
+                     if "threshold_s" in r else "")
+            print(f"  {kind:<13} {mark} sli={r['sli']:.4%} "
+                  f"slo={r['slo']:.2%}{extra} burn={r['burn_rate']:.2f} "
+                  f"({r['bad_events']}/{r['events']} bad)")
+
+
+def main(argv: List[str] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--file", help="saved /metrics scrape (text exposition)")
+    src.add_argument("--url", help="live /metrics URL to scrape now")
+    src.add_argument("--bench", help="bench.py driver artifact (JSON)")
+    p.add_argument("--prev", help="earlier scrape — report the delta window "
+                                  "between the two (what rate() sees)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    if args.bench:
+        with open(args.bench) as f:
+            rep = bench_report(json.load(f))
+        print(json.dumps(rep, indent=None if args.as_json else 2))
+        return 0 if rep["ok"] else 1
+
+    samples = parse_exposition(_read(args.file or args.url))
+    prev = parse_exposition(_read(args.prev)) if args.prev else None
+    rep = report(delta(samples, prev))
+    if args.as_json:
+        print(json.dumps(rep))
+    else:
+        _print_human(rep)
+    ok = all(r["ok"] for entry in rep.values() for r in entry.values())
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

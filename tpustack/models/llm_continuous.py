@@ -112,6 +112,12 @@ class SlotRequest:
     arrays) to ``on_prefill_kv`` — the server's cache-insert hook.  All
     three default to None: the no-cache path is byte-for-byte the
     pre-prefix-cache engine.
+
+    ``span_ctx``: the request's trace context (``tpustack.obs.trace
+    .SpanContext``).  Engine threads don't inherit the handler's
+    contextvars, so the server passes the handle explicitly; when set
+    (and the engine has a tracer) the request's prefill/wave spans parent
+    under its HTTP root span.
     """
 
     ids: List[int]
@@ -124,11 +130,12 @@ class SlotRequest:
     prefix: Optional[Tuple[int, list]] = None
     kv_extract: Optional[Tuple[int, int]] = None
     on_prefill_kv: Optional[Callable[[list], None]] = None
+    span_ctx: Optional[object] = None
 
 
 class _Slot:
     __slots__ = ("req", "out", "budget", "gen_id", "t0", "prefill_s",
-                 "dispatched", "done", "pending", "cached")
+                 "dispatched", "done", "pending", "cached", "span")
 
     def __init__(self):
         self.req: Optional[SlotRequest] = None
@@ -141,6 +148,8 @@ class _Slot:
         self.done = True
         self.pending = False  # admission dispatched, firsts not yet fetched
         self.cached = 0  # prompt tokens restored from the prefix KV cache
+        self.span = None  # active trace span: prefill until resolve, wave
+        # from resolve to retire (None when the request carries no context)
 
 
 class _PendingWave:
@@ -170,12 +179,17 @@ class ContinuousEngine:
 
     def __init__(self, gen: Generator, slots: int = 8, chunk: int = 32,
                  stop_tokens: Tuple[int, ...] = (), depth: int = 2,
-                 on_progress: Optional[Callable[[str], None]] = None):
+                 on_progress: Optional[Callable[[str], None]] = None,
+                 tracer=None):
         self.gen = gen
         self.B = slots
         self.chunk = chunk
         self.stop_tokens = stop_tokens
         self.depth = depth
+        # distributed tracing (tpustack.obs.trace.Tracer): per-request
+        # prefill/wave spans parented to each SlotRequest's span_ctx.  None
+        # disables — the bench/CLI paths stay span-free.
+        self.tracer = tracer
         # resilience hook (tpustack.serving.resilience): called with
         # "prefill" immediately before an admission dispatch and "wave"
         # after each chunk-block fetch — the wave boundaries at which drain
@@ -187,6 +201,7 @@ class ContinuousEngine:
         self._to_park: List[int] = []  # retirements awaiting a fused park
         self._pending: List[_PendingWave] = []
         self._retired_tokens = 0
+        self._fetch_marks: List[Tuple[float, int]] = []
 
     # ------------------------------------------------------------ device state
     def _fresh_state(self):
@@ -240,6 +255,15 @@ class ContinuousEngine:
             valid.append((i, req, budget))
         if not valid:
             return gen_ctr
+        if self.tracer is not None:
+            for i, req, budget in valid:
+                if req.span_ctx is None:
+                    continue
+                slots[i].span = self.tracer.start_span(
+                    "prefill", parent=req.span_ctx,
+                    attrs={"slot": i, "prompt_tokens": len(req.ids),
+                           "cached_tokens": slots[i].cached,
+                           "budget": budget})
         if self._on_progress is not None:
             self._on_progress("prefill")
 
@@ -397,11 +421,25 @@ class ContinuousEngine:
         live = self._live(slots)
         for (i, req, budget), first in zip(wave.rows, firsts):
             s = slots[i]
-            if s.req is not req:  # impossible today (pending slots can't be
-                continue          # reassigned); guard against future edits
+            if s.req is not req:
+                # impossible today (pending slots can't be reassigned), but
+                # the guard must fail SAFE if a future edit trips it: a slot
+                # left flagged pending while its wave is dropped would never
+                # be resolved or reused again
+                log.error("resolve: slot %d holds a different request than "
+                          "its pending wave (engine invariant violated); "
+                          "clearing pending", i)
+                s.pending = False
+                continue
             s.pending = False
             s.prefill_s = t_first
             s.out = [first]
+            if s.span is not None:
+                s.span.set_attribute("prefill_s", round(t_first, 6))
+                s.span.end()
+                s.span = (self.tracer.start_span("wave", parent=req.span_ctx,
+                                                 attrs={"slot": i})
+                          if self.tracer is not None else None)
             if req.on_tokens is not None:
                 req.on_tokens([first])
             if first in self.stop_tokens or budget <= 1 or req.cancelled():
@@ -449,6 +487,10 @@ class ContinuousEngine:
         s = slots[i]
         req, out = s.req, s.out
         s.req, s.done, s.pending = None, True, False
+        if s.span is not None:
+            s.span.set_attribute("generated_tokens", len(out))
+            s.span.end()
+            s.span = None
         self._retired_tokens += len(out)  # incl. the admission-sampled first
         if park:
             # coalesced: applied in ONE _slot_update before the next dispatch
@@ -506,7 +548,7 @@ class ContinuousEngine:
         # (wall time, tokens consumed so far) at each block fetch: the
         # steady-state decode rate is the slope between the first and last
         # marks — what the bench reports alongside end-to-end tokens/s
-        fetch_marks: List[Tuple[float, int]] = []
+        self._fetch_marks: List[Tuple[float, int]] = []
 
         def admit_free() -> None:
             nonlocal gen_ctr, admitted
@@ -528,6 +570,33 @@ class ContinuousEngine:
             return (s.req is not None and not s.done
                     and 1 + s.dispatched < s.budget)
 
+        try:
+            self._run_loop(state, slots, chain, admit_free, dispatch_ok)
+        except BaseException:
+            # a failed run (injected device error, shutdown) must not leak
+            # open spans — their trace would sit in the live table until
+            # eviction instead of being captured as the error it is
+            for s in slots:
+                if s.span is not None:
+                    s.span.end(status="error")
+                    s.span = None
+            raise
+
+        dt = time.time() - t_start
+        n_tok = self._retired_tokens
+        stats = {"requests": admitted, "generated_tokens": n_tok,
+                 "wall_s": dt,
+                 "tokens_per_s": n_tok / dt if dt > 0 else 0.0}
+        fetch_marks = self._fetch_marks
+        if len(fetch_marks) >= 2:
+            (t0m, c0), (t1m, c1) = fetch_marks[0], fetch_marks[-1]
+            if t1m > t0m:
+                stats["steady_tokens_per_s"] = (c1 - c0) / (t1m - t0m)
+        return stats
+
+    def _run_loop(self, state, slots, chain, admit_free, dispatch_ok):
+        g = self.gen
+        fetch_marks = self._fetch_marks
         while True:
             # parks MUST land before admissions: a freshly admitted slot's
             # state would otherwise be zeroed by its predecessor's park
@@ -597,18 +666,9 @@ class ContinuousEngine:
                     if t in self.stop_tokens or len(s.out) >= s.budget:
                         s.done = True
                         break
+                if accepted and s.span is not None:
+                    s.span.add_event("wave", tokens=len(accepted))
                 if accepted and s.req.on_tokens is not None:
                     s.req.on_tokens(accepted)
                 if s.done:
                     self._retire(state, slots, i, live)
-
-        dt = time.time() - t_start
-        n_tok = self._retired_tokens
-        stats = {"requests": admitted, "generated_tokens": n_tok,
-                 "wall_s": dt,
-                 "tokens_per_s": n_tok / dt if dt > 0 else 0.0}
-        if len(fetch_marks) >= 2:
-            (t0m, c0), (t1m, c1) = fetch_marks[0], fetch_marks[-1]
-            if t1m > t0m:
-                stats["steady_tokens_per_s"] = (c1 - c0) / (t1m - t0m)
-        return stats

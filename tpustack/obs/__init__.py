@@ -7,7 +7,9 @@ The serving-stack observability layer (vLLM/TGI posture, zero new deps):
 - :mod:`tpustack.obs.catalog` — every exported metric, declared once;
   linted by ``tools/lint_metrics.py``.
 - :mod:`tpustack.obs.trace` — request-ids (contextvar, stamped on every
-  log line) + per-phase span timings.
+  log line), per-phase span timings, and the distributed-tracing
+  subsystem (Span/Tracer, W3C ``traceparent``, bounded trace store
+  behind ``GET /debug/traces``).
 - :mod:`tpustack.obs.device` — scrape-time HBM / compile-cache collectors.
 - :mod:`tpustack.obs.http` — ``GET /metrics`` handler, aiohttp
   instrumentation middleware, stdlib sidecar for batch jobs.
@@ -17,11 +19,14 @@ See ``docs/OBSERVABILITY.md`` for the metric catalog and scrape wiring.
 
 from tpustack.obs.metrics import (CONTENT_TYPE, DEFAULT_BUCKETS, REGISTRY,
                                   Counter, Gauge, Histogram, Registry)
-from tpustack.obs.trace import (Trace, bind_request_id, current_request_id,
-                                new_request_id)
+from tpustack.obs.trace import (TRACER, Span, SpanContext, Trace, Tracer,
+                                bind_request_id, current_request_id,
+                                current_span, format_traceparent,
+                                new_request_id, parse_traceparent)
 
 __all__ = [
-    "CONTENT_TYPE", "DEFAULT_BUCKETS", "REGISTRY", "Counter", "Gauge",
-    "Histogram", "Registry", "Trace", "bind_request_id",
-    "current_request_id", "new_request_id",
+    "CONTENT_TYPE", "DEFAULT_BUCKETS", "REGISTRY", "TRACER", "Counter",
+    "Gauge", "Histogram", "Registry", "Span", "SpanContext", "Trace",
+    "Tracer", "bind_request_id", "current_request_id", "current_span",
+    "format_traceparent", "new_request_id", "parse_traceparent",
 ]

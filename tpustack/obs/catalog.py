@@ -184,6 +184,34 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "storage corrupted data in flight — see the runbook in "
                "docs/RESILIENCE.md.", ("task",), unit="total"),
 
+    # ---- distributed tracing (tpustack.obs.trace; /debug/traces store) ----
+    MetricSpec("tpustack_traces_captured_total", "counter",
+               "Traces finalized into the in-process store, by kind (ok | "
+               "slow = past TPUSTACK_TRACE_SLOW_S, always kept | error = "
+               "a span errored, always kept | incomplete = spans never "
+               "ended, evicted from the live table).", ("kind",),
+               unit="total"),
+
+    # ---- black-box prober (tools/probe.py, the prober CronJob sidecar) ----
+    MetricSpec("tpustack_probe_attempts_total", "counter",
+               "Prober checks run, by target (llm|sd|graph), check "
+               "(healthz|readyz|inference) and outcome (ok|failed).",
+               ("target", "check", "outcome"), unit="total"),
+    MetricSpec("tpustack_probe_latency_seconds", "histogram",
+               "Black-box check latency as a client sees it (DNS + TCP + "
+               "serve), per target and check.",
+               ("target", "check"), unit="seconds"),
+    MetricSpec("tpustack_probe_up_state", "gauge",
+               "1 when the target's most recent full probe round passed "
+               "every check, else 0 — the outside-in availability signal "
+               "the SLO burn-rate alerts cannot provide (a wedged server "
+               "stops reporting its own error ratio).",
+               ("target",), unit="state"),
+    MetricSpec("tpustack_probe_last_success_seconds", "gauge",
+               "Unix time of the target's last fully-green probe round; "
+               "alert when now() minus this grows past the probe cadence.",
+               ("target",), unit="seconds"),
+
     # ---- batch clients (scripts/batch_generate.py via the Job sidecar) ----
     MetricSpec("tpustack_batch_generate_requests_total", "counter",
                "batch_generate client requests, by outcome (ok|failed).",

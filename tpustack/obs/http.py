@@ -5,14 +5,24 @@ Three pieces:
 - ``make_metrics_handler(registry)`` — an aiohttp handler serving the
   Prometheus text exposition on ``GET /metrics`` (the three serving apps
   mount it).
-- ``instrument(server_name, registry)`` — an aiohttp middleware that stamps
-  every request with a request-id (honouring an inbound ``X-Request-Id``),
-  binds it to the logging contextvar, counts the request into
-  ``tpustack_http_requests_total`` and observes its end-to-end latency.
+- ``instrument(server_name, registry, tracer)`` — an aiohttp middleware
+  that stamps every request with a request-id (honouring an inbound
+  ``X-Request-Id``), binds it to the logging contextvar, counts the
+  request into ``tpustack_http_requests_total``, observes its end-to-end
+  latency, and opens the request's ROOT SPAN (honouring an inbound W3C
+  ``traceparent``, so the client's trace id follows the request through
+  the engine; the trace id is echoed as ``X-Trace-Id``).  Health/metrics
+  endpoints are only traced when the caller sent a ``traceparent`` —
+  the ring buffer must hold real work, not kubelet probes.
+- ``add_debug_trace_routes(app, tracer)`` — mounts ``GET /debug/traces``
+  (recent + slowest + always-kept summaries) and
+  ``GET /debug/traces/{trace_id}`` (full span tree) on a server app.
 - ``start_metrics_sidecar(port, registry)`` — a stdlib ``http.server`` on a
   daemon thread, for processes that are NOT aiohttp apps (batch Jobs,
   trainers): set ``TPUSTACK_METRICS_PORT`` and the same registry becomes
-  scrapeable without pulling a web framework into a batch workload.
+  scrapeable without pulling a web framework into a batch workload.  The
+  sidecar also serves ``/debug/traces`` from the process-wide tracer, so
+  a trainer's per-step and checkpoint-commit spans are inspectable.
 
 The endpoint label uses the matched ROUTE template (``/history/{prompt_id}``
 not ``/history/abc123``) so label cardinality stays bounded under real
@@ -26,8 +36,21 @@ import time
 from typing import Optional
 
 from tpustack.obs import catalog
+from tpustack.obs import trace as obs_trace
 from tpustack.obs.metrics import CONTENT_TYPE, REGISTRY, Registry
 from tpustack.obs.trace import bind_request_id
+
+#: endpoints whose steady-state chatter (kubelet probes, Prometheus
+#: scrapes) must not churn the trace ring buffer; traced only when the
+#: caller explicitly sent a traceparent
+UNTRACED_ENDPOINTS = frozenset({
+    "/metrics", "/health", "/healthz", "/readyz",
+    "/debug/traces", "/debug/traces/{trace_id}", "__unmatched__",
+    # poll loops (the wan client hits /history every few seconds for
+    # minutes per prompt) — the prompt's real work is traced via its
+    # "prompt" span, not the polls
+    "/queue", "/history/{prompt_id}",
+})
 
 
 def render(registry: Optional[Registry] = None) -> str:
@@ -54,16 +77,29 @@ def _endpoint_label(request) -> str:
     return canonical or "__unmatched__"
 
 
-def instrument(server_name: str, registry: Optional[Registry] = None):
-    """aiohttp middleware: request-id + request counter + latency histogram.
+def instrument(server_name: str, registry: Optional[Registry] = None,
+               tracer: Optional[obs_trace.Tracer] = None):
+    """aiohttp middleware: request-id + root span + counters + latency.
 
     Latency covers the handler including streaming bodies (SSE completions
     count their full stream duration — that IS the request latency a client
     sees).  Exceptions count as their mapped status (HTTPException) or 500.
+
+    The root span honours an inbound ``traceparent`` (the client's span
+    becomes this span's parent, so one trace id follows client → server →
+    engine) and is exposed to handlers via the ``current_span`` contextvar
+    and ``request["trace_span"]``; engine work on executor threads parents
+    under it through explicitly passed :class:`SpanContext` handles.
     """
     from aiohttp import web
 
     m = catalog.build(registry)
+    tracer = tracer if tracer is not None else obs_trace.TRACER
+    if tracer is not obs_trace.TRACER or registry is None:
+        # wire capture counting only when tracer and registry pair up:
+        # a private-registry app falling back to the PROCESS tracer must
+        # not redirect every other app's capture counts into its registry
+        tracer.wire_metrics(registry)
     requests_total = m["tpustack_http_requests_total"]
     latency = m["tpustack_http_request_latency_seconds"]
     in_flight = m["tpustack_http_in_flight_requests"]
@@ -73,6 +109,16 @@ def instrument(server_name: str, registry: Optional[Registry] = None):
         rid = bind_request_id(request.headers.get("X-Request-Id"))
         request["request_id"] = rid
         endpoint = _endpoint_label(request)
+        remote = obs_trace.parse_traceparent(
+            request.headers.get("traceparent"))
+        span = token = None
+        if remote is not None or endpoint not in UNTRACED_ENDPOINTS:
+            span = tracer.start_span(
+                f"{request.method} {endpoint}", parent=remote,
+                attrs={"server": server_name, "http.method": request.method,
+                       "http.endpoint": endpoint, "request_id": rid})
+            token = obs_trace.current_span.set(span)
+            request["trace_span"] = span
         in_flight.labels(server=server_name).inc()
         t0 = time.perf_counter()
         status = 500
@@ -84,6 +130,8 @@ def instrument(server_name: str, registry: Optional[Registry] = None):
             # (request["request_id"]); mutating here would be a no-op
             if not getattr(resp, "prepared", False):
                 resp.headers.setdefault("X-Request-Id", rid)
+                if span is not None:
+                    resp.headers.setdefault("X-Trace-Id", span.trace_id)
             return resp
         except web.HTTPException as e:
             status = e.status
@@ -95,19 +143,54 @@ def instrument(server_name: str, registry: Optional[Registry] = None):
                                   status=str(status)).inc()
             latency.labels(server=server_name, endpoint=endpoint).observe(
                 time.perf_counter() - t0)
+            if span is not None:
+                obs_trace.current_span.reset(token)
+                span.set_attribute("http.status", status)
+                span.end(status="error" if status >= 500 else "ok")
 
     return middleware
 
 
+def add_debug_trace_routes(app, tracer: Optional[obs_trace.Tracer] = None):
+    """Mount the trace-store endpoints on a server app:
+
+    - ``GET /debug/traces`` → recent + slowest + always-kept summaries
+    - ``GET /debug/traces/{trace_id}`` → full record: flat ``spans`` (with
+      parent links) plus the nested ``tree``
+    """
+    from aiohttp import web
+
+    tr = tracer if tracer is not None else obs_trace.TRACER
+
+    async def list_traces(request: web.Request) -> web.Response:
+        return web.json_response(tr.summaries())
+
+    async def get_trace(request: web.Request) -> web.Response:
+        record = tr.get(request.match_info["trace_id"])
+        if record is None:
+            return web.json_response({"error": "trace not found (evicted "
+                                      "or never finalized)"}, status=404)
+        return web.json_response(record)
+
+    app.router.add_get("/debug/traces", list_traces)
+    app.router.add_get("/debug/traces/{trace_id}", get_trace)
+
+
 def start_metrics_sidecar(port: int,
                           registry: Optional[Registry] = None,
-                          host: str = "0.0.0.0"):
-    """Serve ``GET /metrics`` (and ``/healthz``) from a daemon thread using
-    only the stdlib — batch Jobs and trainers stay aiohttp-free.  Returns
-    the ``HTTPServer`` (callers may ``.shutdown()`` it; Jobs just exit)."""
+                          host: str = "0.0.0.0",
+                          tracer: Optional[obs_trace.Tracer] = None):
+    """Serve ``GET /metrics`` (plus ``/healthz`` and the trace-store debug
+    endpoints) from a daemon thread using only the stdlib — batch Jobs and
+    trainers stay aiohttp-free.  Returns the ``HTTPServer`` (callers may
+    ``.shutdown()`` it; Jobs just exit)."""
     import http.server
+    import json as _json
 
     reg = registry or REGISTRY
+    tr = tracer if tracer is not None else obs_trace.TRACER
+    if tr is not obs_trace.TRACER or registry is None:
+        tr.wire_metrics(registry)  # sidecar processes count captures too
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - stdlib contract
@@ -119,6 +202,16 @@ def start_metrics_sidecar(port: int,
             elif path == "/healthz":
                 body = b'{"ok": true}\n'
                 self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+            elif path == "/debug/traces":
+                body = _json.dumps(tr.summaries()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+            elif path.startswith("/debug/traces/"):
+                record = tr.get(path.rsplit("/", 1)[-1])
+                body = _json.dumps(record or {"error": "trace not found"}
+                                   ).encode()
+                self.send_response(200 if record else 404)
                 self.send_header("Content-Type", "application/json")
             else:
                 body = b"not found\n"

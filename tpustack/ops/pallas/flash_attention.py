@@ -208,6 +208,25 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 PANEL_MAX_KV = 8704
 
 
+def _default_block_q(streaming: bool, kv_tokens: int, d: int) -> int:
+    """Default q-block: streaming takes 1024 (HBM-traffic bound — see the
+    wrapper docstring); the panel kernel takes 256 where that config is
+    compile/VMEM-verified and 128 everywhere else.
+
+    block_q 256 wins ~8% over 128 at serving shapes (v5e, S=2560 D=128:
+    154 vs 143 TFLOP/s with the folded q scale — more MXU work per grid
+    step against the same VPU softmax setup), but the panel's VMEM bound
+    — [block_q, S] f32 scores + the K/V panels — scales with BOTH S and D:
+    256 at S=8704 fails to compile (measured r4), and every 256 compile
+    check ran at D=128, so a larger head_dim must not inherit the
+    unverified config (ADVICE r5).  256 therefore requires S ≤ 6144 AND
+    d ≤ 128 (compile-verified on-chip across 4608/5120/6144 at D=128,
+    matching block_q=128 exactly); anything else stays at 128."""
+    if streaming:
+        return 1024
+    return 256 if (kv_tokens <= 6144 and d <= 128) else 128
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -272,15 +291,7 @@ def flash_attention(
     streaming = (k.shape[1] > panel_max_kv or q_offset is not None
                  or kv_len is not None)
     if block_q is None:
-        # Panel kernel: block_q 256 wins ~8% over 128 at serving shapes
-        # (v5e, S=2560 D=128: 154 vs 143 TFLOP/s with the folded q scale —
-        # more MXU work per grid step against the same VPU softmax setup),
-        # but its [block_q, S] f32 scores + K/V panels stop fitting VMEM as
-        # S approaches PANEL_MAX_KV (256 at 8704 fails to compile,
-        # measured r4) — stay at 128 beyond the 6144 bound, which is
-        # compile-verified on-chip across the range (4608/5120/6144 all
-        # build and match block_q=128 exactly at D=128).
-        block_q = 1024 if streaming else (256 if k.shape[1] <= 6144 else 128)
+        block_q = _default_block_q(streaming, k.shape[1], q.shape[-1])
     if block_k is None:
         block_k = 1024 if streaming else 512
     return _flash_attention(q, k, v, causal=causal, scale=scale,

@@ -57,6 +57,7 @@ from tpustack.obs import Trace
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import http as obs_http
+from tpustack.obs import trace as obs_trace
 from tpustack.serving.resilience import ResilienceManager
 from tpustack.utils import get_logger
 from tpustack.utils.image import array_to_png
@@ -287,9 +288,10 @@ class GraphExecutor:
     """Topologically executes a ComfyUI-style ``{id: {class_type, inputs}}``
     graph.  Node functions are methods ``node_<ClassType>``."""
 
-    def __init__(self, runtime: WanRuntime, registry=None):
+    def __init__(self, runtime: WanRuntime, registry=None, tracer=None):
         self.rt = runtime
         self.metrics = obs_catalog.build(registry)
+        self.tracer = tracer if tracer is not None else obs_trace.TRACER
         self._counter_lock = threading.Lock()
         self._counter = self._scan_counter()
 
@@ -549,7 +551,8 @@ class GraphExecutor:
         return info
 
     # -- execution -----------------------------------------------------------
-    def execute(self, graph: Dict[str, Any], sample_hook=None):
+    def execute(self, graph: Dict[str, Any], sample_hook=None,
+                trace_parent=None):
         """Run a graph; returns ``(outputs, finish)``.
 
         ``outputs`` is the ComfyUI-style dict keyed by node id — complete,
@@ -562,6 +565,12 @@ class GraphExecutor:
         ``sample_hook(spec) -> Frames``: when given, VAEDecode records its
         SampleSpec through it instead of dispatching — the worker batches
         compatible specs from several queued graphs into one device program.
+
+        ``trace_parent``: the prompt's trace span (worker thread — no
+        contextvar); when set, each node's execute gets its own child span
+        so a trace shows where graph RESOLUTION spent its time (VAEDecode
+        under the worker's sample hook is plan-only here — device time
+        lands in the ``finalize`` span's fetch).
         """
         for nid, node in graph.items():
             if not isinstance(node, dict):
@@ -597,7 +606,18 @@ class GraphExecutor:
                     inputs[key] = val
             fn = getattr(self, f"node_{node['class_type']}")
             t0 = time.perf_counter()
-            out = fn(inputs, ctx)
+            node_span = (self.tracer.start_span(
+                f"node_{node['class_type']}", parent=trace_parent,
+                attrs={"node_id": nid}) if trace_parent is not None else None)
+            try:
+                out = fn(inputs, ctx)
+            except BaseException as e:
+                if node_span is not None:
+                    node_span.set_attribute("error", str(e))
+                    node_span.end(status="error")
+                raise
+            if node_span is not None:
+                node_span.end()
             # per-node execute span; note under the worker's sample hook
             # VAEDecode is plan-only here — its device time shows up as the
             # dispatch/finalize phases, not in this histogram
@@ -649,14 +669,22 @@ class GraphServer:
     fetch + encode overlaps k+1's sampling (the same one-in-flight pattern
     as the SD15 micro-batcher; +~15% back-to-back video throughput)."""
 
-    def __init__(self, runtime: Optional[WanRuntime] = None, registry=None):
+    def __init__(self, runtime: Optional[WanRuntime] = None, registry=None,
+                 tracer=None):
         self.rt = runtime or WanRuntime()
         self._registry = registry
         self.metrics = obs_catalog.build(registry)
         obs_device.install(registry)
-        self.executor = GraphExecutor(self.rt, registry=registry)
+        self.tracer = tracer if tracer is not None else obs_trace.TRACER
+        self.executor = GraphExecutor(self.rt, registry=registry,
+                                      tracer=self.tracer)
         self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
         self._pending: Dict[str, Dict] = {}
+        # accept-and-poll tracing: /prompt returns in ~1ms while the worker
+        # runs minutes, so the HTTP root span ends long before the work —
+        # each accepted prompt opens a "prompt" child span here, ended by
+        # the worker at publish; the tracer holds the trace open until then
+        self._prompt_spans: Dict[str, obs_trace.Span] = {}
         self._history: Dict[str, HistoryEntry] = {}
         self._running: List[str] = []  # dispatched, not yet finalized
         self._no_batch: set = set()  # signatures whose batched build failed
@@ -735,12 +763,13 @@ class GraphServer:
             self.metrics["tpustack_graph_queue_depth"].set(self._queue.qsize())
 
             # plan every graph (cheap — device work deferred to the hook)
-            plans = []  # (pid, entry, outputs, finish, specs)
+            plans = []  # (pid, entry, outputs, finish, specs, pspan)
             for pid in pids:
                 with self._lock:
                     graph = self._pending.pop(pid, None)
                     self._running.append(pid)
                     entry = self._history[pid]
+                    pspan = self._prompt_spans.pop(pid, None)
                 deadline = self._deadline_at.pop(pid, None)
                 if deadline is not None and time.monotonic() > deadline:
                     # expired while queued: refuse to start it (its device
@@ -749,6 +778,9 @@ class GraphServer:
                     self.resilience.note_deadline("queued")
                     self.metrics["tpustack_graph_prompts_total"].labels(
                         status="error").inc()
+                    if pspan is not None:
+                        pspan.add_event("deadline_exceeded", phase="queued")
+                        pspan.end(status="error")
                     with self._lock:
                         entry.status_str = "error"
                         entry.messages.append(
@@ -767,20 +799,24 @@ class GraphServer:
                     return fr
 
                 try:
-                    outputs, finish = self.executor.execute(graph,
-                                                            sample_hook=hook)
+                    outputs, finish = self.executor.execute(
+                        graph, sample_hook=hook, trace_parent=pspan)
                 except Exception as e:  # noqa: BLE001 — via /history
                     log.exception("prompt %s failed", pid)
                     self._t_submit.pop(pid, None)
                     self.metrics["tpustack_graph_prompts_total"].labels(
                         status="error").inc()
+                    if pspan is not None:
+                        pspan.set_attribute("error",
+                                            f"{type(e).__name__}: {e}")
+                        pspan.end(status="error")
                     with self._lock:
                         entry.status_str = "error"
                         entry.messages.append(f"{type(e).__name__}: {e}")
                         entry.completed = True
                         self._running.remove(pid)
                     continue
-                plans.append((pid, entry, outputs, finish, specs))
+                plans.append((pid, entry, outputs, finish, specs, pspan))
 
             plan = self._dispatch_plan(self._group_specs(plans))
             if in_flight and self._any_cold(plan):
@@ -794,8 +830,8 @@ class GraphServer:
                 self.resilience.progress("wave")
             for f in in_flight:
                 self._finalize(*f)
-            in_flight = [(pid, entry, outputs, finish)
-                         for pid, entry, outputs, finish, _ in plans]
+            in_flight = [(pid, entry, outputs, finish, pspan)
+                         for pid, entry, outputs, finish, _, pspan in plans]
         for f in in_flight:
             self._finalize(*f)
 
@@ -807,7 +843,7 @@ class GraphServer:
 
     def _group_specs(self, plans):
         groups: Dict[Tuple, List[Tuple[SampleSpec, Frames]]] = {}
-        for _, _, _, _, specs in plans:
+        for _, _, _, _, specs, _ in plans:
             for spec, fr in specs:
                 groups.setdefault(self._spec_key(spec), []).append((spec, fr))
         return groups
@@ -817,6 +853,10 @@ class GraphServer:
         known-unbatchable signatures) so cold-compile checks judge the
         batch sizes that will really run, not the pre-split group size."""
         plan = []
+        if not groups:
+            # a wave of device-free graphs (text-encode-only probes) must
+            # not force the multi-minute pipeline build
+            return plan
         pipe = self.rt.pipeline()
         for key, members in groups.items():
             width, height, frames_n = key[0], key[1], key[2]
@@ -910,13 +950,17 @@ class GraphServer:
         tr.observe_into(self.metrics["tpustack_request_phase_latency_seconds"],
                         server="graph")
 
-    def _finalize(self, pid, entry, outputs, finish):
+    def _finalize(self, pid, entry, outputs, finish, pspan=None):
         """Run deferred saves (fetch + encode + write) and publish."""
         self.resilience.beat()  # publishing is progress too
         tr = Trace()
+        fspan = (self.tracer.start_span("finalize", parent=pspan)
+                 if pspan is not None else None)
         try:
             with tr.span("finalize"):
                 finish()
+            if fspan is not None:
+                fspan.end()
             tr.observe_into(
                 self.metrics["tpustack_request_phase_latency_seconds"],
                 server="graph")
@@ -924,6 +968,8 @@ class GraphServer:
                 entry.outputs = outputs       # completed+non-success as failure
                 entry.status_str = "success"
                 entry.completed = True
+            if pspan is not None:
+                pspan.end()  # publishes the trace (last open span)
             self.metrics["tpustack_graph_prompts_total"].labels(
                 status="success").inc()
             # the Retry-After basis: true submit→publish wall time
@@ -933,6 +979,11 @@ class GraphServer:
                     time.monotonic() - t_submit)
         except Exception as e:  # noqa: BLE001 — surfaced via /history
             log.exception("prompt %s failed", pid)
+            if fspan is not None:
+                fspan.end(status="error")
+            if pspan is not None:
+                pspan.set_attribute("error", f"{type(e).__name__}: {e}")
+                pspan.end(status="error")
             self.metrics["tpustack_graph_prompts_total"].labels(
                 status="error").inc()
             with self._lock:
@@ -991,9 +1042,17 @@ class GraphServer:
         pid = str(uuid.uuid4())
         entry = HistoryEntry(prompt_id=pid,
                              client_id=str(body.get("client_id", "")))
+        parent = obs_trace.current_span.get()
         with self._lock:
             self._history[pid] = entry
             self._pending[pid] = graph
+            if parent is not None:
+                # deliberately NOT ended here: the worker ends it at
+                # publish, so the client's trace id covers the accepted
+                # prompt's whole submit→publish lifetime even though this
+                # HTTP request answers in ~1ms
+                self._prompt_spans[pid] = self.tracer.start_span(
+                    "prompt", parent=parent, attrs={"prompt_id": pid})
         if deadline_s is not None:
             self._deadline_at[pid] = time.monotonic() + deadline_s
         self._t_submit[pid] = time.monotonic()
@@ -1037,8 +1096,10 @@ class GraphServer:
     def build_app(self) -> web.Application:
         app = web.Application(
             client_max_size=4 << 20,
-            middlewares=[obs_http.instrument("graph", self._registry),
+            middlewares=[obs_http.instrument("graph", self._registry,
+                                             tracer=self.tracer),
                          self.resilience.middleware({"/prompt"})])
+        obs_http.add_debug_trace_routes(app, self.tracer)
         app.router.add_get("/queue", self.queue_state)
         app.router.add_get("/object_info", self.object_info)
         app.router.add_get("/metrics",

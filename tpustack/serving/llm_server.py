@@ -65,6 +65,7 @@ from aiohttp import web
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import http as obs_http
+from tpustack.obs import trace as obs_trace
 from tpustack.serving.resilience import (DeadlineExceeded,
                                          InjectedDeviceError,
                                          ResilienceManager)
@@ -85,9 +86,18 @@ def _or_default(value, default):
 def _normalize_seed(seed):
     """llama.cpp request convention: a negative seed (clients routinely
     send -1) means "draw a random one" — map it to None so the engine
-    picks a fresh seed; anything non-int is ignored likewise."""
-    if isinstance(seed, bool) or not isinstance(seed, int):
+    picks a fresh seed.  An integral float coerces to int (JSON clients
+    round-trip 7 as 7.0); anything else raises ValueError → a 400,
+    instead of silently going random and losing the reproducibility the
+    client asked for (ADVICE r5)."""
+    if seed is None:
         return None
+    if isinstance(seed, bool) or not isinstance(seed, (int, float)):
+        raise ValueError(f"seed must be an integer, got {seed!r}")
+    if isinstance(seed, float):
+        if not seed.is_integer():
+            raise ValueError(f"seed must be an integer, got {seed!r}")
+        seed = int(seed)
     return seed if seed >= 0 else None
 
 
@@ -153,7 +163,7 @@ class _PendingCompletion:
 
     __slots__ = ("ids", "n_predict", "sample", "future", "cancel",
                  "stream_put", "seed", "prefix", "kv_extract", "on_prefill_kv",
-                 "phase")
+                 "phase", "span_ctx", "queue_span")
 
     def __init__(self, ids, n_predict, sample, future, stream_put=None,
                  seed=None, prefix=None, kv_extract=None, on_prefill_kv=None):
@@ -173,6 +183,12 @@ class _PendingCompletion:
         self.prefix = prefix
         self.kv_extract = kv_extract
         self.on_prefill_kv = on_prefill_kv
+        # distributed tracing: the request's HTTP root-span context (engine
+        # threads parent their prefill/wave spans under it) and the
+        # queue_wait span, open from enqueue until feed() hands the request
+        # to a slot
+        self.span_ctx = None
+        self.queue_span = None
 
 
 class LLMServer:
@@ -208,12 +224,15 @@ class LLMServer:
     def __init__(self, generator=None, tokenizer=None, model_name: str = "tpustack",
                  max_batch: Optional[int] = None,
                  batch_window_ms: Optional[float] = None,
-                 registry=None, prefix_cache=_PREFIX_FROM_ENV):
+                 registry=None, prefix_cache=_PREFIX_FROM_ENV, tracer=None):
         # metrics registry: tests pass a fresh Registry for isolation; the
         # default is the process-wide one /metrics exposes
         self._registry = registry
         self.metrics = obs_catalog.build(registry)
         obs_device.install(registry)
+        # distributed tracing: same isolation contract as the registry —
+        # tests pass a fresh Tracer, production shares the process default
+        self.tracer = tracer if tracer is not None else obs_trace.TRACER
         # cross-request prefix KV cache (tpustack.serving.prefix_cache):
         # tests pass an instance (tiny chunk) or None (hard off); serving
         # builds from TPUSTACK_PREFIX_CACHE{,_MB,_CHUNK}, default ON —
@@ -292,6 +311,11 @@ class LLMServer:
         self.metrics["tpustack_llm_prefix_cache_lookups_total"].labels(
             result="hit" if m.length else "miss").inc()
         self.metrics["tpustack_llm_prefix_cached_tokens"].observe(m.length)
+        span = obs_trace.current_span.get()
+        if span is not None:  # hit/miss as a span annotation: the trace
+            span.add_event("prefix_cache",  # answers "why was THIS prefill
+                           result="hit" if m.length else "miss",  # short"
+                           cached_tokens=m.length)
         prefix = (m.length, m.kv, m.key) if m.length else None
         upto = pc.snap(len(ids))
         if upto <= m.length:
@@ -358,6 +382,15 @@ class LLMServer:
         return self.max_batch > 1
 
     async def _enqueue_raw(self, req: _PendingCompletion) -> None:
+        # runs in the handler's context: capture the request's root span so
+        # the engine thread (no contextvar inheritance) can parent its
+        # prefill/wave spans, and open queue_wait — closed by feed() when
+        # the request gets a slot
+        parent = obs_trace.current_span.get()
+        if parent is not None:
+            req.span_ctx = parent.context
+            req.queue_span = self.tracer.start_span("queue_wait",
+                                                    parent=parent)
         if self._wake is None:
             self._wake = asyncio.Event()
         if self._batch_task is None or self._batch_task.done():
@@ -421,7 +454,8 @@ class LLMServer:
                            on_tokens=on_tokens, on_done=on_done,
                            cancelled=r.cancel.is_set, seed=r.seed,
                            prefix=r.prefix, kv_extract=r.kv_extract,
-                           on_prefill_kv=r.on_prefill_kv)
+                           on_prefill_kv=r.on_prefill_kv,
+                           span_ctx=r.span_ctx)
 
     async def _batch_loop(self):
         """Run the continuous engine whenever requests are queued: the
@@ -444,7 +478,8 @@ class LLMServer:
                     self.gen, slots=self.max_batch,
                     chunk=self.engine_chunk,
                     stop_tokens=(self.tok.eos_id,),
-                    on_progress=self.resilience.progress)
+                    on_progress=self.resilience.progress,
+                    tracer=self.tracer)
 
                 def feed():
                     if self._solo_waiting > 0:
@@ -458,9 +493,14 @@ class LLMServer:
                         self.metrics["tpustack_llm_queue_depth"].set(
                             len(self._queue))
                         if r.cancel.is_set():
+                            if r.queue_span is not None:
+                                r.queue_span.set_attribute("cancelled", True)
+                                r.queue_span.end(status="error")
                             continue  # waiter already cancelled its future
                         handed.append(r)
                         r.phase = "decode"  # now owns a slot (504 phase)
+                        if r.queue_span is not None:
+                            r.queue_span.end()
                         self.metrics["tpustack_llm_running_requests"].inc()
                         return self._slot_request(r, loop)
                     return None
@@ -473,6 +513,8 @@ class LLMServer:
                 while self._queue:
                     handed.append(self._queue.popleft())
                 for r in handed:
+                    if r.queue_span is not None:
+                        r.queue_span.end(status="error")  # idempotent
                     if not r.future.done():
                         r.future.set_exception(exc)
                     if r.stream_put is not None:
@@ -556,7 +598,8 @@ class LLMServer:
         # reconstruction needed
         stats = dict(stats)
         t_detok = time.perf_counter()
-        content = self.tok.decode(out_ids)
+        with self.tracer.span_if_active("detokenize"):
+            content = self.tok.decode(out_ids)
         stats["detokenize_s"] = time.perf_counter() - t_detok
         self._observe_done(len(ids), stats, time.perf_counter() - t_start)
         return content, stats, stopped_eos
@@ -933,12 +976,12 @@ class LLMServer:
             temperature = float(_or_default(body.get("temperature"), 0.8))
             top_k = int(_or_default(body.get("top_k"), 40))
             deadline_s = self.resilience.deadline(body.get("timeout_s"))
+            seed = _normalize_seed(body.get("seed"))
         except (TypeError, ValueError) as e:
             self._reject("bad_parameter")
             return web.json_response({"error": f"invalid parameter: {e}"}, status=400)
         if n_predict < 0:  # llama.cpp: -1 means "until EOS / context limit"
             n_predict = self.gen.cfg.max_seq
-        seed = _normalize_seed(body.get("seed"))
         # llama.cpp's prompt-cache field: absent/true → use the prefix KV
         # cache (when server-enabled); explicit false → this request neither
         # reuses nor populates it
@@ -991,20 +1034,20 @@ class LLMServer:
             n_predict = int(_or_default(body.get("max_tokens"), 128))
             temperature = float(_or_default(body.get("temperature"), 0.8))
             deadline_s = self.resilience.deadline(body.get("timeout_s"))
+            seed = _normalize_seed(body.get("seed"))
         except (TypeError, ValueError) as e:
             return web.json_response(
                 {"error": {"message": f"invalid parameter: {e}"}}, status=400)
         cache_prompt = bool(_or_default(body.get("cache_prompt"), True))
         if body.get("stream"):
             return await self._stream(request, prompt, n_predict, temperature,
-                                      40, _normalize_seed(body.get("seed")),
+                                      40, seed,
                                       fmt="openai", cache_prompt=cache_prompt,
                                       deadline_s=deadline_s)
 
         try:
             content, stats, stopped_eos = await self._complete_routed(
-                prompt, n_predict, temperature, 40,
-                _normalize_seed(body.get("seed")),
+                prompt, n_predict, temperature, 40, seed,
                 cache_prompt=cache_prompt, deadline_s=deadline_s)
         except ValueError as e:
             return web.json_response({"error": {"message": str(e)}}, status=400)
@@ -1033,9 +1076,11 @@ class LLMServer:
 
     def build_app(self) -> web.Application:
         app = web.Application(
-            middlewares=[obs_http.instrument("llm", self._registry),
+            middlewares=[obs_http.instrument("llm", self._registry,
+                                             tracer=self.tracer),
                          self.resilience.middleware(
                              {"/completion", "/v1/chat/completions"})])
+        obs_http.add_debug_trace_routes(app, self.tracer)
         app.router.add_get("/health", self.health)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)

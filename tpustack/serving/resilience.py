@@ -396,6 +396,16 @@ class ResilienceManager:
             server=self.server).set(ra)
         return ra
 
+    def _shed_event(self, reason: str, retry_after: int) -> None:
+        """Annotate the request's span (when one is open — the obs
+        middleware wraps this one) so a shed shows up in the trace a
+        client retrieves with its own trace id, not just in counters."""
+        from tpustack.obs import trace as obs_trace
+
+        span = obs_trace.current_span.get()
+        if span is not None:
+            span.add_event("shed", reason=reason, retry_after_s=retry_after)
+
     def admission_check(self):
         """None to admit, or a ready 503 (draining) / 429 (backpressure)
         ``web.Response`` carrying ``Retry-After``."""
@@ -404,15 +414,19 @@ class ResilienceManager:
         if self.draining:
             self.metrics["tpustack_requests_shed_total"].labels(
                 server=self.server, reason="draining").inc()
+            ra = self.retry_after_s()
+            self._shed_event("draining", ra)
             return web.json_response(
                 {"error": "server draining (shutting down)"}, status=503,
-                headers={"Retry-After": str(self.retry_after_s())})
+                headers={"Retry-After": str(ra)})
         if self.max_queue_depth and self.queue_depth() >= self.max_queue_depth:
             self.metrics["tpustack_requests_shed_total"].labels(
                 server=self.server, reason="backpressure").inc()
+            ra = self.retry_after_s()
+            self._shed_event("backpressure", ra)
             return web.json_response(
                 {"error": "queue full, retry later"}, status=429,
-                headers={"Retry-After": str(self.retry_after_s())})
+                headers={"Retry-After": str(ra)})
         return None
 
     def middleware(self, work_paths):
@@ -459,6 +473,13 @@ class ResilienceManager:
     def note_deadline(self, phase: str) -> None:
         self.metrics["tpustack_deadline_exceeded_total"].labels(
             server=self.server, phase=phase).inc()
+        # handler-context callers (llm/sd) have the request span open —
+        # annotate it; the graph worker thread has none and gets None
+        from tpustack.obs import trace as obs_trace
+
+        span = obs_trace.current_span.get()
+        if span is not None:
+            span.add_event("deadline_exceeded", phase=phase)
         log.warning("request deadline exceeded in phase=%s", phase)
 
     def transient_error_response(self, exc: Exception):

@@ -55,6 +55,7 @@ from pydantic import BaseModel, ValidationError
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import http as obs_http
+from tpustack.obs import trace as obs_trace
 from tpustack.serving.resilience import (DeadlineExceeded,
                                          InjectedDeviceError,
                                          ResilienceManager)
@@ -87,14 +88,20 @@ class _PendingReq:
     seed: Optional[int]
     future: asyncio.Future
     t_enqueue: float = 0.0  # perf_counter at admission → queue_wait phase
+    # distributed tracing: the request's HTTP root-span context (the batch
+    # task serves many requests, so each rider's spans are written from the
+    # shared batch timings against its own parent) + admission wall clock
+    span_ctx: Optional[object] = None
+    t_enqueue_unix: float = 0.0
 
 
 class SDServer:
     def __init__(self, pipeline=None, mesh=None, batch_window_ms: float = None,
-                 max_batch: int = None, registry=None):
+                 max_batch: int = None, registry=None, tracer=None):
         self._registry = registry
         self.metrics = obs_catalog.build(registry)
         obs_device.install(registry)
+        self.tracer = tracer if tracer is not None else obs_trace.TRACER
         if pipeline is None:
             pipeline = self._pipeline_from_env()
         self.pipe = pipeline
@@ -249,10 +256,13 @@ class SDServer:
             req.seed if req.seed is not None else "auto", width, height)
 
         key = (steps, float(guidance), width, height)
+        parent = obs_trace.current_span.get()
         pending = _PendingReq(req.prompt, req.negative_prompt or "",
                               req.seed,
                               asyncio.get_running_loop().create_future(),
-                              t_enqueue=time.perf_counter())
+                              t_enqueue=time.perf_counter(),
+                              span_ctx=parent.context if parent else None,
+                              t_enqueue_unix=time.time())
         try:
             img = await asyncio.wait_for(self._enqueue(key, pending),
                                          deadline_s)
@@ -273,7 +283,8 @@ class SDServer:
         from tpustack.obs import Trace
 
         tr = Trace(request_id=request.get("request_id"))
-        with tr.span("png_encode"):
+        with tr.span("png_encode"), \
+                self.tracer.span_if_active("png_encode"):
             png = array_to_png(img)
         tr.observe_into(self.metrics["tpustack_request_phase_latency_seconds"],
                         server="sd")
@@ -366,6 +377,7 @@ class SDServer:
         steps, guidance, width, height = key
         tr = Trace()  # phase spans for this fused dispatch
         t_build = time.perf_counter()
+        t_build_unix = time.time()
         prompts = [r.prompt for r in batch]
         negs = [r.negative for r in batch]
         seeds = [r.seed for r in batch]
@@ -403,7 +415,9 @@ class SDServer:
                 self._inflight.append(dev_imgs)
             # batch_build: list assembly + the host-side trace/dispatch of
             # the fused program (returns before the device finishes)
-            tr.add("batch_build", time.perf_counter() - t_build)
+            build_s = time.perf_counter() - t_build
+            tr.add("batch_build", build_s)
+            t_denoise = time.perf_counter()
             try:
                 # device wall time: the CFG denoise loop AND the VAE decode
                 # are ONE fused XLA program here, so they are one phase
@@ -425,6 +439,23 @@ class SDServer:
         # failed dispatch must not skew the latency histograms
         tr.observe_into(self.metrics["tpustack_request_phase_latency_seconds"],
                         server="sd")
+        # distributed tracing: one fused program served every rider, so each
+        # request's batch_build/denoise spans carry the SHARED batch timing
+        # (explicit wall clocks — this task is not any rider's context)
+        denoise_s = time.perf_counter() - t_denoise
+        for r in batch:
+            if r.span_ctx is None:
+                continue
+            self.tracer.add_span(
+                "queue_wait", r.span_ctx, r.t_enqueue_unix,
+                max(0.0, t_build_unix - r.t_enqueue_unix))
+            self.tracer.add_span(
+                "batch_build", r.span_ctx, t_build_unix, build_s,
+                attrs={"batch": len(batch), "pad": pad,
+                       "dp": self._mesh_data_size() or 1})
+            self.tracer.add_span(
+                "denoise_vae", r.span_ctx, t_build_unix + build_s, denoise_s,
+                attrs={"steps": steps, "width": width, "height": height})
         # batch boundary: watchdog beat + injected mid-request SIGTERM point
         self.resilience.progress("wave")
         for i, r in enumerate(batch):
@@ -495,8 +526,10 @@ class SDServer:
     def build_app(self) -> web.Application:
         app = web.Application(
             client_max_size=1 << 20,
-            middlewares=[obs_http.instrument("sd", self._registry),
+            middlewares=[obs_http.instrument("sd", self._registry,
+                                             tracer=self.tracer),
                          self.resilience.middleware({"/generate"})])
+        obs_http.add_debug_trace_routes(app, self.tracer)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)
         app.router.add_get("/", self.index)

@@ -402,6 +402,16 @@ class ResilientCheckpointer:
             task=self.task).observe(dt)
         self.metrics["tpustack_train_last_saved_step"].labels(
             task=self.task).set(step)
+        # checkpoint-commit trace span (async save start → durable commit),
+        # served by the metrics sidecar's /debug/traces beside the per-step
+        # spans — a slow PVC shows up as a slow checkpoint_commit trace
+        from tpustack.obs import trace as obs_trace
+
+        obs_trace.TRACER.add_span(
+            "checkpoint_commit", None, t0, dt,
+            attrs={"task": self.task, "step": step,
+                   "files": len(manifest["files"]),
+                   "bytes": manifest["total_bytes"]})
         log.info("checkpoint step=%d durable: %d files %.1f MB in %.2fs",
                  step, len(manifest["files"]),
                  manifest["total_bytes"] / 1e6, dt)

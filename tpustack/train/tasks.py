@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpustack.obs import trace as obs_trace
 from tpustack.train import resilience
 from tpustack.utils import get_logger
 
@@ -104,8 +105,16 @@ def _train_loop(state, ckpt, step, make_batch, args, task: str = "train") -> Any
                                 "(nothing to save) — exiting %d", i,
                                 resilience.EXIT_PREEMPTED)
                 raise resilience.Preempted(i)
-            batch = make_batch(np.random.RandomState(i))
-            state, metrics = step(state, batch, jax.random.fold_in(rng, i))
+            # per-step trace (root span per step, process-wide tracer): the
+            # TPUSTACK_METRICS_PORT sidecar serves these on /debug/traces,
+            # so "which step stalled" is answerable without a debugger.
+            # Covers batch build + the step dispatch — async dispatch means
+            # device time shows up in whichever step the host next syncs in
+            with obs_trace.TRACER.span("train_step", parent=None,
+                                       task=task, step=i):
+                batch = make_batch(np.random.RandomState(i))
+                state, metrics = step(state, batch,
+                                      jax.random.fold_in(rng, i))
             if i == start:
                 jax.block_until_ready(metrics["loss"])
                 t0 = time.time()
