@@ -37,16 +37,18 @@ def test_llm_extras_schema(monkeypatch):
 
     monkeypatch.setattr(subprocess, "run", fake_run)
     out = bench._llm_extras(lambda *a: None)
-    assert set(out) == {"continuous_e2e", "prefill_8k", "shared_prefix"}
+    assert set(out) == {"continuous_e2e", "prefill_8k", "shared_prefix",
+                        "paged"}
     for sub in out.values():
         assert sub["value"] == 1.0
         assert sub["steady_decode_tokens_per_sec"] == 2.0
         assert "ignored_key" not in sub
-    # the three bench_llm invocations: batch-8 continuous + the 8k prefill
-    # + the shared-prefix (prefix KV cache) workload
+    # the four bench_llm invocations: batch-8 continuous + the 8k prefill
+    # + the shared-prefix (prefix KV cache) + the paged-KV sweep workloads
     assert any("--continuous" in c for c in calls)
     assert any("8192" in c for c in calls)
     assert any("--shared-prefix" in c for c in calls)
+    assert any("--paged" in c for c in calls)
 
 
 def test_wan_extras_schema(monkeypatch):
@@ -76,7 +78,7 @@ def test_extras_degrade_on_tool_failure(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     out = bench._llm_extras(lambda *a: None)
     assert "error" in out["continuous_e2e"] and "error" in out["prefill_8k"]
-    assert "error" in out["shared_prefix"]
+    assert "error" in out["shared_prefix"] and "error" in out["paged"]
     wan = bench._wan_extras(lambda *a: None)
     assert "error" in wan
 

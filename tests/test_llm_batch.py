@@ -135,16 +135,22 @@ def test_server_micro_batches_concurrent_completions(gen):
                        max_batch=4)
     calls = {"batch": 0, "solo": 0}
     real_cont, real_fused = gen._decode_scan_cont, gen.generate_fused
+    real_paged = gen._decode_scan_paged
 
     def spy_cont(*a, **kw):
         calls["batch"] += 1
         return real_cont(*a, **kw)
+
+    def spy_paged(*a, **kw):  # engine decode under the paged default
+        calls["batch"] += 1
+        return real_paged(*a, **kw)
 
     def spy_fused(*a, **kw):
         calls["solo"] += 1
         return real_fused(*a, **kw)
 
     gen._decode_scan_cont, gen.generate_fused = spy_cont, spy_fused
+    gen._decode_scan_paged = spy_paged
     prompts = ["alpha", "bee", "gamma!"]
 
     async def scenario():
@@ -163,6 +169,7 @@ def test_server_micro_batches_concurrent_completions(gen):
         results = asyncio.new_event_loop().run_until_complete(scenario())
     finally:
         gen._decode_scan_cont, gen.generate_fused = real_cont, real_fused
+        gen._decode_scan_paged = real_paged
 
     assert calls["batch"] >= 1 and calls["solo"] == 0, calls
     for p, r in zip(prompts, results):
@@ -192,16 +199,22 @@ def test_server_batched_streaming_coalesces(gen):
                        max_batch=4)
     calls = {"batch": 0, "solo": 0}
     real_cont, real_solo = gen._decode_scan_cont, gen.generate
+    real_paged = gen._decode_scan_paged
 
     def spy_cont(*a, **kw):
         calls["batch"] += 1
         return real_cont(*a, **kw)
+
+    def spy_paged(*a, **kw):  # engine decode under the paged default
+        calls["batch"] += 1
+        return real_paged(*a, **kw)
 
     def spy_solo(*a, **kw):
         calls["solo"] += 1
         return real_solo(*a, **kw)
 
     gen._decode_scan_cont, gen.generate = spy_cont, spy_solo
+    gen._decode_scan_paged = spy_paged
     prompts = ["stream one", "stream two!"]
 
     async def read_stream(client, prompt):
@@ -234,6 +247,7 @@ def test_server_batched_streaming_coalesces(gen):
         results = asyncio.new_event_loop().run_until_complete(scenario())
     finally:
         gen._decode_scan_cont, gen.generate = real_cont, real_solo
+        gen._decode_scan_paged = real_paged
 
     assert calls["batch"] >= 1 and calls["solo"] == 0, calls
     for p, (text, final) in zip(prompts, results):

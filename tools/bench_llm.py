@@ -126,6 +126,142 @@ def _shared_prefix_bench(args, gen, cfg, log) -> int:
     return 0
 
 
+def _paged_bench(args, gen, cfg, log) -> int:
+    """``--paged``: the capacity-true-admission workload the paged KV pool
+    exists for — a concurrency sweep over request context footprints
+    (``--req-ctx``, default 1k/4k/8k clipped to ctx) with the SAME HBM
+    budget in both modes: the dense engine reserves ``--dense-slots`` full
+    ``max_seq`` cache lines (its admission cap), the paged engine carves
+    the identical token budget into blocks and admits by ``ceil((prompt +
+    max_new) / block)``.  Reports admitted concurrency, end-to-end
+    tokens/s, p50/p99 TTFT and peak pool utilization per footprint, and
+    asserts greedy outputs identical paged-vs-dense plus a free-block leak
+    check (pool returns to its initial free count after the burst)."""
+    from tpustack.models.llama import init_kv_pool
+    from tpustack.models.llm_continuous import ContinuousEngine, SlotRequest
+    from tpustack.models.llm_generate import SampleConfig
+    from tpustack.serving.kv_pool import KVBlockPool, PagedKVRuntime
+
+    sample = SampleConfig(greedy=True)
+    ctx = cfg.max_seq
+    dense_slots = max(1, args.dense_slots)
+    budget_tokens = dense_slots * ctx  # dense HBM parity
+    block = max(1, min(args.kv_block, ctx))
+    while block > 1 and ctx % block:
+        block //= 2
+    capacity = budget_tokens // block
+    if args.req_ctx:
+        footprints = [int(x) for x in args.req_ctx.split(",")]
+    else:
+        footprints = [1024, 4096, 8192]
+        if args.preset == "tiny":
+            footprints = [ctx // 4, ctx // 2, ctx]
+    footprints = sorted({min(max(f, 8), ctx) for f in footprints})
+
+    def run_fleet(engine, reqs, pool=None):
+        results = {}
+        peak = {"batch": 0, "used": 0}
+        done_t = {}
+
+        def on_done(i, toks, st):
+            results[i] = (toks, st)
+            peak["batch"] = max(peak["batch"], st.get("batch", 0))
+            if pool is not None:
+                peak["used"] = max(peak["used"], pool.n_used)
+            done_t[i] = time.time()
+
+        queue = [SlotRequest(ids=ids, max_new=new, sample=sample,
+                             on_done=lambda t, s, i=i: on_done(i, t, s))
+                 for i, (ids, new) in enumerate(reqs)]
+
+        def feed():
+            if not queue:
+                return None
+            if engine.paged is not None:
+                ids, new = queue[0].ids, queue[0].max_new
+                need = engine.paged.need_blocks(len(ids), new)
+                if not engine.paged.ensure_free(need):
+                    return None  # capacity-true: wait for block release
+            if pool is not None:
+                peak["used"] = max(peak["used"], pool.n_used)
+            return queue.pop(0)
+
+        stats = engine.run(feed)
+        ttfts = sorted(st["prefill_s"] for _, st in results.values())
+        q = lambda p: ttfts[min(len(ttfts) - 1,
+                                int(round(p * (len(ttfts) - 1))))]
+        out = {
+            "admitted_concurrency": peak["batch"],
+            "tokens_per_s": round(stats["tokens_per_s"], 2),
+            "ttft_p50_ms": round(q(0.50) * 1e3, 2),
+            "ttft_p99_ms": round(q(0.99) * 1e3, 2),
+        }
+        if pool is not None:
+            out["pool_utilization_peak"] = round(
+                peak["used"] / max(1, pool.capacity_blocks), 3)
+        return results, out
+
+    sweep = []
+    identical = True
+    leak_ok = True
+    for req_ctx in footprints:
+        blocks_per_req = (req_ctx + block - 1) // block
+        paged_slots = max(dense_slots, min(args.max_paged_slots,
+                                           capacity // blocks_per_req))
+        n_requests = max(args.requests, min(2 * paged_slots, 32))
+        new = min(args.new_tokens, max(4, req_ctx // 8))
+        p_len = req_ctx - new
+        reqs = [([(5 + i) % (cfg.vocab_size - 1) + 1]
+                 + [(11 + i + j) % (cfg.vocab_size - 1) + 1
+                    for j in range(p_len - 1)], new)
+                for i in range(n_requests)]
+
+        warm = [reqs[0]]  # uncounted: compiles prefill/admit/decode for
+        # this (slots, bucket) shape so measured TTFT is compile-warm
+        dense_eng = lambda: ContinuousEngine(gen, slots=dense_slots,
+                                             chunk=min(args.chunk, new))
+        run_fleet(dense_eng(), warm)
+        dense_res, dense = run_fleet(dense_eng(), reqs)
+        pool = KVBlockPool(capacity + 1, block)
+        rt = PagedKVRuntime(
+            init_kv_pool(cfg, capacity + 1, block, dtype=gen.cache_dtype),
+            pool, ctx)
+        paged_eng = lambda: ContinuousEngine(gen, slots=paged_slots,
+                                             chunk=min(args.chunk, new),
+                                             paged=rt)
+        run_fleet(paged_eng(), warm, pool=pool)
+        free0 = pool.n_free
+        paged_res, paged = run_fleet(paged_eng(), reqs, pool=pool)
+        leak_ok = leak_ok and pool.n_free == free0
+        same = all(dense_res[i][0] == paged_res[i][0]
+                   for i in range(n_requests))
+        identical = identical and same
+        sweep.append({"req_ctx": req_ctx, "requests": n_requests,
+                      "paged_slots": paged_slots, "dense": dense,
+                      "paged": paged})
+        log(f"[bench_llm] paged sweep ctx {req_ctx}: dense adm "
+            f"{dense['admitted_concurrency']} @ {dense['tokens_per_s']} "
+            f"tok/s vs paged adm {paged['admitted_concurrency']} @ "
+            f"{paged['tokens_per_s']} tok/s (slots {paged_slots}, "
+            f"util {paged['pool_utilization_peak']}, identical={same})")
+
+    mid = sweep[len(sweep) // 2]
+    print(json.dumps({
+        "metric": f"{args.preset}_{args.quant or 'bf16'}_ctx{args.ctx}"
+                  f"_paged_admitted_concurrency",
+        "value": mid["paged"]["admitted_concurrency"],
+        "unit": "requests",
+        "dense_slot_cap": dense_slots,
+        "block_tokens": block,
+        "pool_blocks": capacity,
+        "mid_req_ctx": mid["req_ctx"],
+        "sweep": sweep,
+        "outputs_identical": identical,
+        "leak_check_ok": leak_ok,
+    }))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="llama2_7b",
@@ -167,7 +303,38 @@ def main() -> int:
                         "(TPUSTACK_PREFIX_CACHE_CHUNK analog)")
     p.add_argument("--prefix-cache-mb", type=int, default=512,
                    help="prefix-cache capacity (TPUSTACK_PREFIX_CACHE_MB)")
+    p.add_argument("--paged", action="store_true",
+                   help="paged-KV concurrency sweep: same HBM budget as "
+                        "--dense-slots full cache lines, carved into "
+                        "--kv-block blocks with capacity-true admission; "
+                        "reports admitted concurrency / tok/s / TTFT / "
+                        "pool utilization paged vs dense per --req-ctx "
+                        "footprint (greedy outputs asserted identical, "
+                        "free-block leak check)")
+    p.add_argument("--tiny", action="store_true",
+                   help="paged-mode CPU smoke shape: --preset tiny with "
+                        "scaled footprints (the tier-1 suite shells this)")
+    p.add_argument("--dense-slots", type=int, default=8,
+                   help="paged mode: the dense engine's slot count — both "
+                        "the dense admission cap AND the shared HBM budget "
+                        "(pool tokens = dense-slots x ctx)")
+    p.add_argument("--kv-block", type=int, default=64,
+                   help="paged mode: block size in tokens "
+                        "(TPUSTACK_KV_BLOCK analog; snapped to divide ctx)")
+    p.add_argument("--req-ctx", default="",
+                   help="paged mode: comma list of request context "
+                        "footprints (prompt+new tokens); default "
+                        "1024,4096,8192 clipped to ctx (tiny: scaled)")
+    p.add_argument("--max-paged-slots", type=int, default=32,
+                   help="paged mode: engine slot ceiling (each slot count "
+                        "compiles its own decode program)")
     args = p.parse_args()
+    if args.tiny:
+        args.preset = "tiny"
+        args.ctx = min(args.ctx, 128)
+        args.dense_slots = min(args.dense_slots, 2)
+        args.kv_block = min(args.kv_block, 16)
+        args.max_paged_slots = min(args.max_paged_slots, 8)
 
     import jax
     import jax.numpy as jnp
@@ -215,6 +382,8 @@ def main() -> int:
         gen = Generator(cfg, params=params, dtype=dtype)
     log(f"[bench_llm] init {time.time() - t0:.1f}s")
 
+    if args.paged:
+        return _paged_bench(args, gen, cfg, log)
     if args.shared_prefix:
         return _shared_prefix_bench(args, gen, cfg, log)
 

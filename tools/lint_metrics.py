@@ -43,6 +43,8 @@ UNIT_SUFFIXES = (
     "seconds", "bytes", "ratio", "celsius", "info",
     # count units (dimensionless gauges/histograms say what they count)
     "depth", "slots", "tokens", "images", "requests", "entries", "prompts",
+    # paged-KV pool accounting (fixed-size KV blocks, kv_pool.py)
+    "blocks",
     # enum gauges (value is a documented small-integer state machine)
     "state",
     # index gauges (value identifies a position, e.g. the last-saved

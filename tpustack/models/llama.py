@@ -403,6 +403,29 @@ def init_kv_caches(cfg: LlamaConfig, batch: int, dtype=jnp.bfloat16):
             for _ in range(cfg.n_layers)]
 
 
+def init_kv_pool(cfg: LlamaConfig, n_blocks: int, block: int,
+                 dtype=jnp.bfloat16):
+    """Per-layer PAGED KV pool tensors: ``[n_blocks, block, kv_heads,
+    head_dim]`` (+ per-vector scales when the cache is int8).  The paged
+    serving substrate (``tpustack.serving.kv_pool``): a sequence's cache
+    line is a block table into these tensors instead of a private
+    ``[max_seq]`` row, so HBM holds exactly the tokens in flight plus the
+    refcounted prefix cache — not ``slots x max_seq`` regardless of use.
+    Block 0 is reserved (idle table entries point at it; nothing writes
+    it), mirroring the dense cache's same-keys layout so the gather view
+    is attention-compatible as-is."""
+    shape = (n_blocks, block, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.kv_quant == "int8":
+        sshape = shape[:-1]
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "k_scale": jnp.zeros(sshape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "v_scale": jnp.zeros(sshape, jnp.float32)}
+                for _ in range(cfg.n_layers)]
+    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(cfg.n_layers)]
+
+
 def init_chunk_bufs(cfg: LlamaConfig, batch: int, chunk: int,
                     dtype=jnp.bfloat16):
     """Per-layer chunk-local K/V buffers for the continuous decode scan

@@ -118,6 +118,17 @@ class SlotRequest:
     contextvars, so the server passes the handle explicitly; when set
     (and the engine has a tracer) the request's prefill/wave spans parent
     under its HTTP root span.
+
+    Paged-KV hooks (engines constructed with a ``kv_pool.PagedKVRuntime``):
+    ``prefix`` becomes ``(n_cached, block_ids)`` — shared POOL blocks the
+    lookup already incref'd for this request (the engine installs them in
+    the slot's block table; no KV moves).  ``kv_blocks`` optionally carries
+    pre-allocated fresh blocks (the server reserves at admission so the
+    HTTP capacity check and the engine can never disagree); None lets the
+    engine allocate.  ``on_prefill_blocks(ids)`` fires once prefill has
+    provably landed, with the blocks covering the prompt's full blocks —
+    the server's zero-copy cache-insert hook.  ``kv_extract``/
+    ``on_prefill_kv`` are the DENSE hooks and are ignored under paging.
     """
 
     ids: List[int]
@@ -131,11 +142,14 @@ class SlotRequest:
     kv_extract: Optional[Tuple[int, int]] = None
     on_prefill_kv: Optional[Callable[[list], None]] = None
     span_ctx: Optional[object] = None
+    kv_blocks: Optional[List[int]] = None
+    on_prefill_blocks: Optional[Callable[[List[int]], None]] = None
 
 
 class _Slot:
     __slots__ = ("req", "out", "budget", "gen_id", "t0", "prefill_s",
-                 "dispatched", "done", "pending", "cached", "span")
+                 "dispatched", "done", "pending", "cached", "span",
+                 "blocks", "alloc")
 
     def __init__(self):
         self.req: Optional[SlotRequest] = None
@@ -150,6 +164,10 @@ class _Slot:
         self.cached = 0  # prompt tokens restored from the prefix KV cache
         self.span = None  # active trace span: prefill until resolve, wave
         # from resolve to retire (None when the request carries no context)
+        self.blocks: List[int] = []  # paged: pool blocks this slot holds a
+        # reference on (shared prefix ids first, then fresh) — decref'd
+        # exactly once at retire
+        self.alloc = 0  # paged: tokens this slot's allocation covers
 
 
 class _PendingWave:
@@ -160,13 +178,16 @@ class _PendingWave:
     resolution (when prefill has provably landed) and handed to each
     request's ``on_prefill_kv``."""
 
-    __slots__ = ("rows", "firsts_dev", "t0", "extracts")
+    __slots__ = ("rows", "firsts_dev", "t0", "extracts", "block_inserts")
 
-    def __init__(self, rows, firsts_dev, t0, extracts=()):
+    def __init__(self, rows, firsts_dev, t0, extracts=(), block_inserts=()):
         self.rows = rows            # [(slot_idx, req, budget)]
         self.firsts_dev = firsts_dev
         self.t0 = t0
         self.extracts = list(extracts)  # [(req, device kv slices)]
+        # paged: [(req, prompt block ids)] — handed to on_prefill_blocks at
+        # resolution (zero-copy cache insert; no device work at all)
+        self.block_inserts = list(block_inserts)
 
 
 class ContinuousEngine:
@@ -180,12 +201,26 @@ class ContinuousEngine:
     def __init__(self, gen: Generator, slots: int = 8, chunk: int = 32,
                  stop_tokens: Tuple[int, ...] = (), depth: int = 2,
                  on_progress: Optional[Callable[[str], None]] = None,
-                 tracer=None):
+                 tracer=None, paged=None):
         self.gen = gen
         self.B = slots
         self.chunk = chunk
         self.stop_tokens = stop_tokens
         self.depth = depth
+        # paged KV substrate (tpustack.serving.kv_pool.PagedKVRuntime):
+        # slots hold BLOCK TABLES into one shared HBM pool instead of
+        # private [max_seq] cache lines — admission capacity is free
+        # blocks, prefix hits are refcount bumps, and the pool arrays
+        # persist across runs (cached blocks outlive busy periods).  None
+        # keeps the dense engine byte-for-byte.
+        self.paged = paged
+        if paged is not None:
+            if gen.cfg.max_seq != paged.max_seq:
+                raise ValueError(
+                    f"paged runtime max_seq {paged.max_seq} != engine "
+                    f"config {gen.cfg.max_seq}")
+        self._bt = None  # paged: host block tables [B, blocks_per_seq]
+        self._slots_view = None  # live slots during run() (release hints)
         # distributed tracing (tpustack.obs.trace.Tracer): per-request
         # prefill/wave spans parented to each SlotRequest's span_ctx.  None
         # disables — the bench/CLI paths stay span-free.
@@ -206,8 +241,17 @@ class ContinuousEngine:
     # ------------------------------------------------------------ device state
     def _fresh_state(self):
         c = self.gen.cfg
-        return {
-            "caches": init_kv_caches(c, self.B, dtype=self.gen.cache_dtype),
+        if self.paged is not None:
+            # the POOL is the persistent KV store (handed back in run()'s
+            # finally); only the per-slot scalars are fresh per run.  Block
+            # tables live host-side, snapshotted to device per dispatch.
+            self._bt = np.zeros((self.B, self.paged.blocks_per_seq),
+                                np.int32)
+            state = {"pool": self.paged.arrays}
+        else:
+            state = {"caches": init_kv_caches(c, self.B,
+                                              dtype=self.gen.cache_dtype)}
+        state.update({
             "cur": jnp.zeros((self.B,), jnp.int32),
             "active": jnp.zeros((self.B,), jnp.int32),
             "first": jnp.zeros((self.B, 1), jnp.int32),
@@ -215,7 +259,80 @@ class ContinuousEngine:
             "topk": jnp.zeros((self.B,), jnp.int32),
             "greedy": jnp.ones((self.B,), jnp.bool_),
             "keys": jnp.zeros((self.B, 2), jnp.uint32),
-        }
+        })
+        return state
+
+    # ------------------------------------------------------- paged plumbing
+    def _release_blocks(self, req: Optional[SlotRequest]) -> None:
+        """Drop the pool references a not-yet-admitted request carries
+        (prefix-hit refs from the lookup + any server-preallocated fresh
+        blocks) — the failure path's counterpart of a retire decref."""
+        if self.paged is None or req is None:
+            return
+        ids = list(req.kv_blocks or [])
+        if req.prefix and req.prefix[0] > 0:
+            ids += list(req.prefix[1])
+        if ids:
+            self.paged.pool.decref(ids)
+
+    def _alloc_slot_blocks(self, i: int, s: "_Slot", req: SlotRequest,
+                           budget: int) -> bool:
+        """Install slot ``i``'s block table row: shared prefix blocks first
+        (refs already owned via the lookup), then fresh blocks covering the
+        rest of ``prompt + budget``.  Uses the server's pre-allocation when
+        provided; otherwise allocates here, evicting unreferenced cached
+        blocks on pressure.  False (with the request error-retired by the
+        caller) when the pool genuinely cannot cover the request."""
+        from tpustack.serving.kv_pool import OutOfBlocks
+
+        rt = self.paged
+        n_prompt = len(req.ids)
+        s.alloc = n_prompt + budget
+        prefix_ids = list(req.prefix[1]) if (req.prefix and
+                                             req.prefix[0] > 0) else []
+        fresh_tokens = s.alloc - len(prefix_ids) * rt.block
+        fresh = req.kv_blocks
+        if fresh is None:
+            try:
+                rt.ensure_free(rt.pool.blocks_for(fresh_tokens))
+                fresh = rt.pool.alloc_tokens(fresh_tokens)
+            except OutOfBlocks:
+                if prefix_ids:
+                    rt.pool.decref(prefix_ids)
+                return False
+        s.blocks = prefix_ids + list(fresh)
+        self._bt[i, :] = 0
+        self._bt[i, :len(s.blocks)] = s.blocks
+        return True
+
+    def projected_block_release_s(self, need_blocks: int,
+                                  fallback_rate: float = 50.0) -> float:
+        """Capacity-true Retry-After estimate: walk the live slots in
+        finish order (remaining budget over the measured steady decode
+        rate) and report the wall seconds until cumulative released blocks
+        cover ``need_blocks``.  Tolerates racing the engine thread — this
+        is a hint, not a barrier."""
+        rate = fallback_rate
+        marks = self._fetch_marks
+        if len(marks) >= 2 and marks[-1][0] > marks[0][0]:
+            rate = max(1e-3, (marks[-1][1] - marks[0][1])
+                       / (marks[-1][0] - marks[0][0]))
+        rel = []
+        for s in list(self._slots_view or []):
+            try:
+                if s.req is None:
+                    continue
+                remaining = max(1, s.budget - len(s.out))
+                rel.append((remaining / rate, len(s.blocks)))
+            except Exception:
+                continue
+        rel.sort()
+        freed = 0
+        for eta, n in rel:
+            freed += n
+            if freed >= need_blocks:
+                return eta
+        return rel[-1][0] if rel else 1.0
 
     # ---------------------------------------------------------------- admission
     def _admit_dispatch(self, state, slots: List[_Slot],
@@ -234,6 +351,7 @@ class ContinuousEngine:
         for i, req in waves:
             s = slots[i]
             s.req, s.out, s.dispatched = req, [], 0
+            s.blocks, s.alloc = [], 0
             s.gen_id = gen_ctr = gen_ctr + 1
             s.t0, s.done, s.pending = t0, False, False
             s.prefill_s = 0.0  # else a zero-budget retire below reports the
@@ -243,6 +361,7 @@ class ContinuousEngine:
             if (n_prompt == 0 or n_prompt >= c.max_seq
                     or s.cached >= n_prompt):
                 s.req, s.done = None, True
+                self._release_blocks(req)
                 if req.on_done is not None:
                     req.on_done(None, {"error": f"prompt length {n_prompt} "
                                                 f"invalid for ctx {c.max_seq}"})
@@ -250,7 +369,17 @@ class ContinuousEngine:
             budget = min(req.max_new, c.max_seq - n_prompt)
             s.budget = budget
             if budget <= 0:
+                self._release_blocks(req)
                 self._retire(state, slots, i, self._live(slots), park=False)
+                continue
+            if self.paged is not None and not self._alloc_slot_blocks(
+                    i, s, req, budget):
+                s.req, s.done = None, True
+                log.warning("paged admission: out of KV blocks for a "
+                            "%d-token request (pool %s)", n_prompt + budget,
+                            self.paged.pool.stats())
+                if req.on_done is not None:
+                    req.on_done(None, {"error": "out of KV blocks"})
                 continue
             valid.append((i, req, budget))
         if not valid:
@@ -306,6 +435,8 @@ class ContinuousEngine:
             # when the firsts fetch proves prefill landed).  Dispatch order
             # makes this safe against the donated-cache hazard: the slices
             # read state["caches"] BEFORE any later dispatch donates it.
+            if self.paged is not None:
+                return []
             out = []
             for i, r, _ in rows:
                 if r.kv_extract is None or r.on_prefill_kv is None:
@@ -316,6 +447,30 @@ class ContinuousEngine:
                         state["caches"], jnp.asarray(i, jnp.int32),
                         jnp.asarray(lo, jnp.int32), hi - lo)))
             return out
+
+        def block_inserts(rows):
+            # the paged counterpart of dispatch_extracts: NO device work —
+            # the prompt's full blocks already hold its prefilled KV, so a
+            # cache insert is handing their ids to the server at resolve
+            # time (when the firsts fetch proves prefill landed)
+            if self.paged is None:
+                return []
+            out = []
+            for i, r, _ in rows:
+                if r.on_prefill_blocks is None:
+                    continue
+                n_full = len(r.ids) // self.paged.block
+                if n_full:
+                    out.append((r, list(slots[i].blocks[:n_full])))
+            return out
+
+        def paged_rowmeta(rows):
+            """(bt rows, per-row allocation limits) device arrays for the
+            rows being admitted — snapshotted AFTER _alloc_slot_blocks
+            installed their tables."""
+            ids = [i for i, _, _ in rows]
+            return (jnp.asarray(self._bt[ids]),
+                    jnp.asarray([slots[i].alloc for i in ids], jnp.int32))
 
         for row in prefix_rows:
             rows = [row]
@@ -329,6 +484,43 @@ class ContinuousEngine:
             tokens[0, :n_prompt - plen] = req.ids[plen:]
             lengths, slot_ids, seeds, temp_r, topk_r, greedy_r = (
                 row_arrays(rows))
+            if self.paged is not None:
+                # zero-copy warm start: the shared blocks are already in
+                # this slot's table (installed by _alloc_slot_blocks) and
+                # hold exactly what prefill wrote — no host KV, no
+                # restore; the fused program gathers the line, prefills
+                # the suffix, and scatters it back
+                bt_rows, limits = paged_rowmeta(rows)
+                if sbucket * c.max_seq <= g.MASKED_PREFILL_MAX:
+                    (state["pool"], firsts, state["cur"], state["active"],
+                     state["first"], state["temp"], state["topk"],
+                     state["greedy"], state["keys"]) = g._admit_prefix_paged(
+                        g.params, jnp.asarray(tokens), state["pool"],
+                        bt_rows, jnp.asarray(plen, jnp.int32), lengths,
+                        limits, slot_ids, seeds, state["cur"],
+                        state["active"], state["first"], state["temp"],
+                        state["topk"], state["greedy"], state["keys"],
+                        temp_r, topk_r, greedy_r)
+                else:
+                    row_caches = g._gather_rows_paged(state["pool"], bt_rows)
+                    logits, row_caches = g._prefill_from(tokens, plen,
+                                                         lengths, row_caches)
+                    state["pool"] = g._insert_rows_paged(
+                        state["pool"], bt_rows, row_caches,
+                        jnp.asarray(plen, jnp.int32), sbucket, limits)
+                    firsts, row_keys = g._admit_sample_jit(
+                        logits, seeds, temp_r, topk_r, greedy_r)
+                    (state["cur"], state["active"], state["first"],
+                     state["temp"], state["topk"], state["greedy"],
+                     state["keys"]) = g._slot_activate(
+                        state["cur"], state["active"], state["first"],
+                        state["temp"], state["topk"], state["greedy"],
+                        state["keys"], slot_ids, lengths, firsts, temp_r,
+                        topk_r, greedy_r, row_keys)
+                slots[i].pending = True
+                self._pending.append(_PendingWave(
+                    rows, firsts, t0, block_inserts=block_inserts(rows)))
+                continue
             prefix_dev = g._prefix_to_device(
                 pkv, req.prefix[2] if len(req.prefix) > 2 else None)
             if sbucket * c.max_seq <= g.MASKED_PREFILL_MAX:
@@ -364,6 +556,40 @@ class ContinuousEngine:
                 tokens[j, :len(r.ids)] = r.ids
             lengths, slot_ids, seeds, temp_r, topk_r, greedy_r = (
                 row_arrays(rows))
+            if self.paged is not None:
+                bt_rows, limits = paged_rowmeta(rows)
+                if bucket > g.PREFILL_CHUNK:
+                    # chunked long-prompt admission: same prefill programs
+                    # as dense, only the splice goes through block tables
+                    row_caches = init_kv_caches(c, n, dtype=g.cache_dtype)
+                    logits, row_caches = g._prefill_long(tokens, lengths,
+                                                         row_caches)
+                    state["pool"] = g._insert_rows_paged(
+                        state["pool"], bt_rows, row_caches,
+                        jnp.zeros((), jnp.int32), bucket, limits)
+                    firsts, row_keys = g._admit_sample_jit(
+                        logits, seeds, temp_r, topk_r, greedy_r)
+                    (state["cur"], state["active"], state["first"],
+                     state["temp"], state["topk"], state["greedy"],
+                     state["keys"]) = g._slot_activate(
+                        state["cur"], state["active"], state["first"],
+                        state["temp"], state["topk"], state["greedy"],
+                        state["keys"], slot_ids, lengths, firsts, temp_r,
+                        topk_r, greedy_r, row_keys)
+                else:
+                    (state["pool"], firsts, state["cur"], state["active"],
+                     state["first"], state["temp"], state["topk"],
+                     state["greedy"], state["keys"]) = g._admit_fused_paged(
+                        g.params, jnp.asarray(tokens), state["pool"],
+                        bt_rows, lengths, limits, slot_ids, seeds,
+                        state["cur"], state["active"], state["first"],
+                        state["temp"], state["topk"], state["greedy"],
+                        state["keys"], temp_r, topk_r, greedy_r)
+                for i, _, _ in rows:
+                    slots[i].pending = True
+                self._pending.append(_PendingWave(
+                    rows, firsts, t0, block_inserts=block_inserts(rows)))
+                continue
             if bucket > g.PREFILL_CHUNK:
                 # chunked long-prompt admission: one fused scan dispatch
                 # for exact-multiple buckets (16k/32k), a per-chunk host
@@ -407,6 +633,16 @@ class ContinuousEngine:
         overlap this is the request's true time-to-first-token."""
         firsts = [int(t) for t in np.asarray(wave.firsts_dev)]
         t_first = time.time() - wave.t0
+        for req, ids in wave.block_inserts:
+            # prefill has landed (the firsts fetch above synced on it): the
+            # prompt's full blocks are valid, so the zero-copy cache insert
+            # is pure host bookkeeping; a failing insert must not kill the
+            # run for every in-flight peer
+            try:
+                req.on_prefill_blocks(ids)
+            except Exception:
+                log.exception("on_prefill_blocks failed (paged prefix-cache "
+                              "insert skipped)")
         for req, dev in wave.extracts:
             # prefill has landed (the firsts fetch above synced on it), so
             # this fetch costs only the transfer; a failing server-side
@@ -491,6 +727,15 @@ class ContinuousEngine:
             s.span.set_attribute("generated_tokens", len(out))
             s.span.end()
             s.span = None
+        if self.paged is not None and s.blocks:
+            # one decref per held reference (shared prefix + fresh alike);
+            # blocks the prefix cache also references survive — everything
+            # else returns to the free list before on_done fires, so a
+            # waiter observing the pool sees its capacity already released
+            self.paged.pool.decref(s.blocks)
+            s.blocks, s.alloc = [], 0
+            if self._bt is not None:
+                self._bt[i, :] = 0
         self._retired_tokens += len(out)  # incl. the admission-sampled first
         if park:
             # coalesced: applied in ONE _slot_update before the next dispatch
@@ -538,6 +783,7 @@ class ContinuousEngine:
         g, c = self.gen, self.gen.cfg
         state = self._fresh_state()
         slots = [_Slot() for _ in range(self.B)]
+        self._slots_view = slots  # projected_block_release_s reads this
         chain: deque = deque()  # (toks_dev, [(slot_idx, gen_id, offset)])
         gen_ctr = 0
         t_start = time.time()
@@ -575,12 +821,27 @@ class ContinuousEngine:
         except BaseException:
             # a failed run (injected device error, shutdown) must not leak
             # open spans — their trace would sit in the live table until
-            # eviction instead of being captured as the error it is
+            # eviction instead of being captured as the error it is — nor,
+            # under paging, the slots' pool references (the pool outlives
+            # this run; leaked refs would shrink capacity forever)
             for s in slots:
                 if s.span is not None:
                     s.span.end(status="error")
                     s.span = None
+                if self.paged is not None and s.blocks:
+                    try:
+                        self.paged.pool.decref(s.blocks)
+                    except Exception:
+                        log.exception("failed releasing slot blocks after "
+                                      "engine failure")
+                    s.blocks = []
             raise
+        finally:
+            if self.paged is not None:
+                # hand the (donation-rotated) pool buffers back — cached
+                # prefix blocks must survive into the next busy period
+                self.paged.arrays = state["pool"]
+            self._slots_view = None
 
         dt = time.time() - t_start
         n_tok = self._retired_tokens
@@ -611,12 +872,21 @@ class ContinuousEngine:
                     dispatch_ok(s) for s in slots):
                 snapshot = [(i, s.gen_id, s.dispatched)
                             for i, s in enumerate(slots) if dispatch_ok(s)]
-                (toks, last, state["cur"], state["caches"],
-                 state["keys"]) = g._decode_scan_cont(
-                    g.params, state["first"], state["cur"],
-                    state["active"], state["caches"], state["keys"],
-                    state["temp"], state["topk"], state["greedy"],
-                    self.chunk)
+                if self.paged is not None:
+                    (toks, last, state["cur"], state["pool"],
+                     state["keys"]) = g._decode_scan_paged(
+                        g.params, state["first"], state["cur"],
+                        state["active"], state["pool"],
+                        jnp.asarray(self._bt), state["keys"],
+                        state["temp"], state["topk"], state["greedy"],
+                        self.chunk)
+                else:
+                    (toks, last, state["cur"], state["caches"],
+                     state["keys"]) = g._decode_scan_cont(
+                        g.params, state["first"], state["cur"],
+                        state["active"], state["caches"], state["keys"],
+                        state["temp"], state["topk"], state["greedy"],
+                        self.chunk)
                 state["first"] = last
                 for i, _, _ in snapshot:
                     slots[i].dispatched += self.chunk

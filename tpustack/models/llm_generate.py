@@ -624,26 +624,17 @@ class Generator:
     # ALL rows are deterministic in (request, seed) regardless of admission
     # timing or batch composition.
 
-    @functools.partial(jax.jit, static_argnums=(0, 10), donate_argnums=(5,))
-    def _decode_scan_cont(self, params, first_tok, cur, active, caches, keys,
+    def _decode_cont_body(self, params, first_tok, cur, active, caches, keys,
                           temperature, top_k, greedy, n_steps: int):
-        """``n_steps`` continuous-slot decode iterations in ONE dispatch.
-
-        ``cur [B]``: per-slot frontier at chunk START (``cur0``) — advances
-        only where ``active``, clamped at max_seq-1.  ``keys [B, 2]``:
-        per-slot PRNG streams (see ``_sample_from_logits_perrow``).
-
-        The main KV cache is read-only for the whole chunk: step t writes
-        its K/V at the UNIFORM index t of per-layer chunk buffers
-        (``init_chunk_bufs``, scan-internal) and attention merges
-        {cache [0, cur0[i])} ∪ {buffer [0, t]} with an exact streaming-
-        softmax split (LlamaAttention chunk mode).  After the scan the
-        buffers flush into each row's cache line at [cur0[i], cur_end[i])
-        in ONE gather+select pass — per-step cache write-back traffic
-        (which would ~double KV bytes for concurrent long-context decodes)
-        amortises by the chunk length.  Overshoot steps past max_seq-1 are
-        clipped out of the flush window entirely, so a retiring row's
-        speculative garbage is never written to the cache at all."""
+        """Traced body of one continuous-slot decode chunk: the ``n_steps``
+        scan over a FROZEN cache view, K/V landing in chunk-local buffers.
+        Shared verbatim by the dense program (``_decode_scan_cont``, which
+        flushes the buffers into each slot's cache line) and the paged one
+        (``_decode_scan_paged``, which gathers the view from the block
+        pool and scatters the buffers back through the block tables) — one
+        source of truth is what makes paged-vs-dense greedy outputs
+        byte-identical.  Returns ``(toks [B, T], last, cur_end, bufs,
+        keys)``."""
         from tpustack.models.llama import init_chunk_bufs
 
         S = self.cfg.max_seq
@@ -668,6 +659,34 @@ class Generator:
         (last, bufs, keys), toks = jax.lax.scan(
             step, (first_tok, bufs0, keys), jnp.arange(n_steps))
         cur_end = jnp.minimum(cur0 + n_steps * active, S - 1)
+        return toks.T, last, cur_end, bufs, keys
+
+    @functools.partial(jax.jit, static_argnums=(0, 10), donate_argnums=(5,))
+    def _decode_scan_cont(self, params, first_tok, cur, active, caches, keys,
+                          temperature, top_k, greedy, n_steps: int):
+        """``n_steps`` continuous-slot decode iterations in ONE dispatch.
+
+        ``cur [B]``: per-slot frontier at chunk START (``cur0``) — advances
+        only where ``active``, clamped at max_seq-1.  ``keys [B, 2]``:
+        per-slot PRNG streams (see ``_sample_from_logits_perrow``).
+
+        The main KV cache is read-only for the whole chunk: step t writes
+        its K/V at the UNIFORM index t of per-layer chunk buffers
+        (``init_chunk_bufs``, scan-internal) and attention merges
+        {cache [0, cur0[i])} ∪ {buffer [0, t]} with an exact streaming-
+        softmax split (LlamaAttention chunk mode).  After the scan the
+        buffers flush into each row's cache line at [cur0[i], cur_end[i])
+        in ONE gather+select pass — per-step cache write-back traffic
+        (which would ~double KV bytes for concurrent long-context decodes)
+        amortises by the chunk length.  Overshoot steps past max_seq-1 are
+        clipped out of the flush window entirely, so a retiring row's
+        speculative garbage is never written to the cache at all."""
+        S = self.cfg.max_seq
+        B = first_tok.shape[0]
+        cur0 = cur
+        toks, last, cur_end, bufs, keys = self._decode_cont_body(
+            params, first_tok, cur, active, caches, keys, temperature,
+            top_k, greedy, n_steps)
 
         # flush: one linear pass per cache tensor — gather each row's chunk
         # K/V at (position - cur0) and select it inside [cur0, cur_end)
@@ -689,7 +708,175 @@ class Generator:
             return out
 
         caches = [flush(c, bf) for c, bf in zip(caches, bufs)]
-        return toks.T, last, cur_end, caches, keys
+        return toks, last, cur_end, caches, keys
+
+    # --------------------------------------------------------- paged KV pool
+    #
+    # Device half of the paged KV substrate (tpustack.serving.kv_pool):
+    # every layer's K/V lives in pool tensors [n_blocks, block, ...] and a
+    # slot's logical cache line is a BLOCK TABLE (bt [B, max_seq // block],
+    # int32 pool indices; the reserved block 0 backs idle entries).  The
+    # compute view is a gather through the table — elementwise equal to
+    # what the dense cache line would hold, so the attention bodies above
+    # run unchanged and greedy outputs are byte-identical paged-vs-dense.
+    # Writes scatter ONLY the freshly produced K/V (an admission's prefill
+    # rows, a chunk's buffers) through the table, with positions outside a
+    # row's allocation dropped via out-of-range indices — shared prefix
+    # blocks (refcount > 1) are never written after their prefill, which
+    # is what makes cross-request sharing safe.
+    #
+    # Reallocation hazard (freed blocks reassigned while chunks are in
+    # flight): dispatches execute in order on the device stream, and the
+    # host only frees a retiring slot's blocks BEFORE dispatching the new
+    # owner's admission — so a stale in-flight chunk's flush into those
+    # blocks lands first and is overwritten by the new owner's prefill/
+    # decode before any mask can admit it, the same ordering argument the
+    # dense engine makes for reassigned slot lines.
+
+    def _pool_gather_body(self, pool, bt):
+        """Traced: pool tensors ``[N, blk, *tail]`` → dense per-row view
+        ``[B, max_seq, *tail]`` via block tables ``bt [B, nb]``."""
+        B, nb = bt.shape
+
+        def ga(x):
+            g = jnp.take(x, bt.reshape(-1), axis=0)     # [B*nb, blk, *tail]
+            return g.reshape((B, nb * x.shape[1]) + x.shape[2:])
+
+        return [{k: ga(v) for k, v in layer.items()} for layer in pool]
+
+    @staticmethod
+    def _pool_scatter_body(pool, bt_rows, src_layers, keymap, positions,
+                           valid):
+        """Traced: scatter per-row values at global cache ``positions
+        [R, L]`` (``valid`` selects real entries) into the pool through
+        ``bt_rows [R, nb]``.  ``src_layers`` arrays are ``[R, L, *tail]``;
+        ``keymap`` maps pool key → source key.  Invalid entries get
+        UNIQUE out-of-range indices and ``mode='drop'``, so the scatter
+        stays unique-indices (vectorisable) and the reserved block 0 is
+        never written."""
+        blk = pool[0]["k"].shape[1]
+        R, L = positions.shape
+        nb = bt_rows.shape[1]
+        blk_idx = jnp.take_along_axis(
+            bt_rows, jnp.clip(positions // blk, 0, nb - 1), axis=1)
+        flat = blk_idx * blk + positions % blk            # [R, L]
+        oob_base = pool[0]["k"].shape[0] * blk
+        oob = oob_base + jnp.arange(R * L, dtype=flat.dtype).reshape(R, L)
+        idx = jnp.where(valid, flat, oob).reshape(-1)
+
+        def sc(dst, src):
+            fd = dst.reshape((dst.shape[0] * dst.shape[1],) + dst.shape[2:])
+            fd = fd.at[idx].set(
+                src.reshape((-1,) + src.shape[2:]).astype(dst.dtype),
+                mode="drop", unique_indices=True)
+            return fd.reshape(dst.shape)
+
+        return [{k: sc(layer[k], srcl[keymap.get(k, k)]) for k in layer}
+                for layer, srcl in zip(pool, src_layers)]
+
+    def _insert_span_body(self, pool, bt_rows, caches, start, bucket: int,
+                          limits):
+        """Traced: write cache positions ``[start, start + bucket)`` of R
+        rows into the pool through their block tables — the paged splice.
+        ``caches`` are full-line row caches (``[R, max_seq, ...]``) whose
+        data at those positions is what prefill just produced; ``limits
+        [R]`` clips each row's write at its allocation (padded-bucket
+        garbage beyond it is dropped, where the dense splice wrote it into
+        the slot's private line)."""
+
+        def sl(x):
+            idx = (jnp.zeros((), jnp.int32), start) + (
+                jnp.zeros((), jnp.int32),) * (x.ndim - 2)
+            return jax.lax.dynamic_slice(
+                x, idx, (x.shape[0], bucket) + x.shape[2:])
+
+        src = [{k: sl(v) for k, v in layer.items()} for layer in caches]
+        R = bt_rows.shape[0]
+        positions = start + jnp.broadcast_to(jnp.arange(bucket), (R, bucket))
+        valid = positions < limits[:, None]
+        return self._pool_scatter_body(pool, bt_rows, src, {}, positions,
+                                       valid)
+
+    @functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(1,))
+    def _insert_rows_paged(self, pool, bt_rows, row_caches, start,
+                           bucket: int, limits):
+        """One-dispatch paged splice (the chunked long-prompt and
+        big-suffix admission paths) — see _insert_span_body."""
+        return self._insert_span_body(pool, bt_rows, row_caches, start,
+                                      bucket, limits)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _gather_rows_paged(self, pool, bt_rows):
+        """Standalone gather of R dense row caches out of the pool (NOT
+        donated — the pool keeps serving).  The big-suffix prefix path
+        uses it to build row caches for the flash-chunk prefill loop."""
+        return self._pool_gather_body(pool, bt_rows)
+
+    @functools.partial(jax.jit, static_argnums=(0, 11), donate_argnums=(5,))
+    def _decode_scan_paged(self, params, first_tok, cur, active, pool, bt,
+                           keys, temperature, top_k, greedy, n_steps: int):
+        """Paged twin of ``_decode_scan_cont``: gather the frozen chunk
+        view from the pool, run the IDENTICAL scan body, scatter the chunk
+        buffers back through the block tables at ``[cur0, cur_end)``.
+        Only the new tokens' K/V move pool-ward — shared prefix blocks are
+        read, never rewritten."""
+        toks, last, cur_end, bufs, keys = self._decode_cont_body(
+            params, first_tok, cur, active, self._pool_gather_body(pool, bt),
+            keys, temperature, top_k, greedy, n_steps)
+        B = bt.shape[0]
+        positions = cur[:, None] + jnp.arange(n_steps)[None, :]
+        valid = positions < cur_end[:, None]
+        pool = self._pool_scatter_body(
+            pool, bt, bufs,
+            {"k": "ck", "v": "cv", "k_scale": "ck_scale",
+             "v_scale": "cv_scale"}, positions, valid)
+        return toks, last, cur_end, pool, keys
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(3, 9, 10, 11, 12, 13, 14, 15))
+    def _admit_fused_paged(self, params, tokens, pool, bt_rows, lengths,
+                           limits, slot_ids, seeds, cur, active, first, temp,
+                           topk, greedy, keys, temp_r, topk_r, greedy_r):
+        """Paged twin of ``_admit_fused``: ONE dispatch covering fresh
+        in-graph row caches → batched prefill (identical trace, identical
+        logits) → paged splice through the rows' block tables →
+        first-token sample → slot activation."""
+        n, bucket = tokens.shape
+        row_caches = init_kv_caches(self.cfg, n, dtype=self.cache_dtype)
+        positions = jnp.broadcast_to(jnp.arange(bucket), (n, bucket))
+        logits, row_caches = self.model.apply(
+            {"params": params}, tokens, positions, row_caches, 0, None,
+            lengths - 1)
+        pool = self._insert_span_body(pool, bt_rows, row_caches,
+                                      jnp.zeros((), jnp.int32), bucket,
+                                      limits)
+        firsts, next_keys = self._first_sample(logits[:, 0], seeds, temp_r,
+                                               topk_r, greedy_r)
+        return (pool, firsts) + self._activate_rows(
+            cur, active, first, temp, topk, greedy, keys, slot_ids,
+            lengths, firsts, temp_r, topk_r, greedy_r, next_keys)
+
+    @functools.partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(3, 10, 11, 12, 13, 14, 15, 16))
+    def _admit_prefix_paged(self, params, tokens, pool, bt_rows, base,
+                            length, limits, slot_ids, seeds, cur, active,
+                            first, temp, topk, greedy, keys, temp_r, topk_r,
+                            greedy_r):
+        """ONE-dispatch paged warm start: gather the hit row's line (the
+        shared prefix blocks hold exactly what prefill wrote — zero-copy
+        restore) → masked suffix prefill (same traced body as the dense
+        fused warm start) → scatter the suffix span back through the block
+        table → sample + activate."""
+        caches = self._pool_gather_body(pool, bt_rows)
+        logits, caches = self._prefill_masked_body(params, tokens, base,
+                                                   length, caches)
+        pool = self._insert_span_body(pool, bt_rows, caches, base,
+                                      tokens.shape[1], limits)
+        firsts, next_keys = self._first_sample(logits, seeds, temp_r, topk_r,
+                                               greedy_r)
+        return (pool, firsts) + self._activate_rows(
+            cur, active, first, temp, topk, greedy, keys, slot_ids,
+            length, firsts, temp_r, topk_r, greedy_r, next_keys)
 
     @staticmethod
     def _splice_rows(slot_caches, row_caches, slot_ids, n: int, bucket: int):

@@ -95,6 +95,25 @@ CATALOG: Tuple[MetricSpec, ...] = (
     MetricSpec("tpustack_llm_prefix_cache_entries", "gauge",
                "Chunk nodes resident in the radix store.", unit="entries"),
 
+    # ---- LLM paged KV pool (block-table substrate, kv_pool.py) ----
+    MetricSpec("tpustack_llm_kv_free_blocks", "gauge",
+               "Free blocks in the paged KV pool — what capacity-true "
+               "admission checks against (plus evictable cached blocks).",
+               unit="blocks"),
+    MetricSpec("tpustack_llm_kv_used_blocks", "gauge",
+               "Pool blocks held by live slots and/or the refcounted "
+               "prefix cache.", unit="blocks"),
+    MetricSpec("tpustack_llm_kv_copy_avoided_tokens_total", "counter",
+               "Prompt-KV tokens served by block POINTER sharing instead "
+               "of the dense path's copies: prefix hits (restore host→HBM "
+               "avoided) plus cache inserts (extract HBM→host avoided).  "
+               "Zero with the cache cold or under the dense fallback.",
+               unit="total"),
+    MetricSpec("tpustack_llm_kv_block_fragmentation_ratio", "gauge",
+               "Reserved-but-unfillable token slack in used blocks "
+               "(block-size rounding): 0 = tight fit, rises with larger "
+               "TPUSTACK_KV_BLOCK against short requests.", unit="ratio"),
+
     # ---- SD server (signature-keyed micro-batcher) ----
     MetricSpec("tpustack_sd_queue_depth", "gauge",
                "Generate requests waiting in micro-batch groups.",
@@ -130,7 +149,8 @@ CATALOG: Tuple[MetricSpec, ...] = (
                ("server",), unit="state"),
     MetricSpec("tpustack_requests_shed_total", "counter",
                "Work refused at admission, by reason (backpressure 429 | "
-               "draining 503).  Both responses carry Retry-After.",
+               "draining 503 | out_of_kv_blocks 429, llm paged mode).  "
+               "All responses carry Retry-After.",
                ("server", "reason"), unit="total"),
     MetricSpec("tpustack_deadline_exceeded_total", "counter",
                "Requests cancelled at their deadline (504), by the phase "

@@ -1,0 +1,456 @@
+"""Paged KV substrate — block pool, block-table bookkeeping, paged radix cache.
+
+ROADMAP item 2: the dense per-slot KV (each engine slot owns a private
+``[max_seq]`` cache line) and the host-resident prefix cache
+(``tpustack.serving.prefix_cache``: extract → host numpy → restore) are
+replaced by ONE HBM-resident pool of fixed-size KV *blocks*:
+
+- Every layer's K/V lives in pool tensors ``[n_blocks, block_tokens, ...]``
+  (``tpustack.models.llama.init_kv_pool``).  A sequence's logical cache
+  line is a *block table* — ``max_seq // block_tokens`` block ids — and the
+  device programs gather/scatter through it
+  (``Generator._decode_scan_paged`` and friends).
+- **Admission is capacity-true**: a request needs
+  ``ceil((prompt + max_new) / block)`` blocks, not a whole ``max_seq``
+  line, so concurrency at ctx 4k–8k rises to what HBM actually holds
+  instead of the dense ``HBM / max_seq`` slot cap.
+- **Prefix reuse is zero-copy**: a finished prefill's *full* blocks are
+  recorded in a radix trie keyed by token ids (``PagedPrefixCache``).  A
+  later request sharing the prefix points its block table at the SAME
+  physical blocks — a refcount increment, no extract, no host round trip,
+  no restore.  Blocks are freed only at refcount 0, so eviction can never
+  pull KV out from under a decoding slot.
+
+This module is the host side only: allocator (free list + refcounts),
+admission math, and the block-id radix store.  It is dependency-free and
+device-agnostic — the device surgery lives in ``llm_generate``, the engine
+integration in ``llm_continuous``, and the HTTP policy in ``llm_server``.
+
+Block 0 is reserved (never allocated): unoccupied block-table entries point
+at it, so a gather of an idle region reads deterministic garbage that the
+attention mask never admits, and nothing ever scatters into it.
+
+Thread-safe: the server event loop reads stats and admits while the engine
+thread allocates/frees at chunk boundaries.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpustack.utils import get_logger
+
+log = get_logger("serving.kv_pool")
+
+
+class OutOfBlocks(RuntimeError):
+    """Allocation failed: the pool has fewer free blocks than requested."""
+
+
+class KVBlockPool:
+    """Fixed-size block allocator with per-block refcounts.
+
+    ``n_blocks`` includes the reserved block 0, so ``capacity_blocks`` (the
+    allocatable count) is ``n_blocks - 1``.  ``block_tokens`` is the tokens
+    per block — the paged analog of the prefix cache's chunk granularity
+    AND the rounding quantum of the admission math.
+
+    Refcount protocol: ``alloc_tokens`` returns blocks at refcount 1 (the
+    caller — an engine slot — owns that reference).  Sharing increfs
+    (``PagedPrefixCache.match`` for a hitting slot, ``insert`` for the
+    cache's own resident reference).  ``decref`` returns a block to the
+    free list only when the count reaches 0 — a cached block being decoded
+    against (count ≥ 2) survives any eviction attempt by construction.
+
+    ``filled`` tracks the tokens each allocation committed per block, so
+    ``fragmentation()`` can report the slack the fixed block size wastes
+    (reserved-but-unfillable tail tokens): larger blocks → fewer
+    gather/scatter indices but more slack and coarser prefix sharing.
+    """
+
+    def __init__(self, n_blocks: int, block_tokens: int):
+        if n_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (0 is reserved), got {n_blocks}")
+        if block_tokens <= 0:
+            raise ValueError(f"block_tokens must be positive, got {block_tokens}")
+        self.n_blocks = n_blocks
+        self.block = block_tokens
+        self._lock = threading.RLock()
+        self._free: deque = deque(range(1, n_blocks))
+        self._ref = np.zeros(n_blocks, np.int64)
+        self._filled = np.zeros(n_blocks, np.int64)
+        # monotonic counters for stats()
+        self.allocated_blocks_total = 0
+        self.freed_blocks_total = 0
+
+    # ------------------------------------------------------------ capacity
+    @property
+    def capacity_blocks(self) -> int:
+        return self.n_blocks - 1
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.capacity_blocks - len(self._free)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks a sequence of ``n_tokens`` occupies (ceil)."""
+        return max(0, (n_tokens + self.block - 1) // self.block)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        return self.blocks_for(n_tokens) <= self.n_free
+
+    # ---------------------------------------------------------- allocation
+    def alloc_tokens(self, n_tokens: int) -> List[int]:
+        """Allocate blocks covering ``n_tokens`` (refcount 1 each).  Raises
+        :class:`OutOfBlocks` without side effects when the pool is short —
+        admission must gate, not half-allocate."""
+        need = self.blocks_for(n_tokens)
+        with self._lock:
+            if need > len(self._free):
+                raise OutOfBlocks(
+                    f"need {need} blocks for {n_tokens} tokens, "
+                    f"{len(self._free)} free of {self.capacity_blocks}")
+            ids = [self._free.popleft() for _ in range(need)]
+            remaining = n_tokens
+            for bid in ids:
+                self._ref[bid] = 1
+                self._filled[bid] = min(self.block, remaining)
+                remaining -= min(self.block, remaining)
+            self.allocated_blocks_total += need
+            return ids
+
+    def incref(self, ids: Sequence[int]) -> None:
+        with self._lock:
+            for bid in ids:
+                if self._ref[bid] <= 0:
+                    raise ValueError(f"incref on free block {bid}")
+                self._ref[bid] += 1
+
+    def decref(self, ids: Sequence[int]) -> int:
+        """Drop one reference per id; blocks reaching 0 return to the free
+        list.  Returns how many were actually freed."""
+        freed = 0
+        with self._lock:
+            for bid in ids:
+                if self._ref[bid] <= 0:
+                    raise ValueError(f"decref on free block {bid}")
+                self._ref[bid] -= 1
+                if self._ref[bid] == 0:
+                    self._filled[bid] = 0
+                    self._free.append(bid)
+                    freed += 1
+            self.freed_blocks_total += freed
+        return freed
+
+    def refcount(self, bid: int) -> int:
+        return int(self._ref[bid])
+
+    # ------------------------------------------------------------- metrics
+    def fragmentation(self) -> float:
+        """Internal fragmentation of the current allocation: the fraction
+        of reserved token slots in used blocks that no token can ever fill
+        (block-rounding slack).  0.0 when idle."""
+        with self._lock:
+            used = self.n_used
+            if used == 0:
+                return 0.0
+            filled = int(self._filled.sum())
+            return max(0.0, 1.0 - filled / (used * self.block))
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "block_tokens": self.block,
+                "pool_blocks": self.capacity_blocks,
+                "free_blocks": self.n_free,
+                "used_blocks": self.n_used,
+                "utilization": (self.n_used / self.capacity_blocks
+                                if self.capacity_blocks else 0.0),
+                "fragmentation": round(self.fragmentation(), 4),
+                "allocated_blocks_total": self.allocated_blocks_total,
+                "freed_blocks_total": self.freed_blocks_total,
+            }
+
+
+class PagedMatch:
+    """Result of a paged lookup: ``length`` cached tokens (block-snapped, 0
+    on a miss) and the matched ``block_ids``.  The caller OWNS one
+    reference per matched block (taken under the trie lock) — the engine
+    folds them into the slot's block list so a single retire-time decref
+    releases hit and fresh blocks alike."""
+
+    __slots__ = ("length", "block_ids")
+
+    def __init__(self, length: int, block_ids: List[int]):
+        self.length = length
+        self.block_ids = block_ids
+
+
+_NODE_UIDS = itertools.count(1)
+
+
+class _Node:
+    """One block of a cached prefix: edge label = its token ids, payload =
+    the physical block id (the cache holds one pool reference on it)."""
+
+    __slots__ = ("key", "parent", "children", "block_id", "last_used", "uid")
+
+    def __init__(self, key: Tuple[int, ...], parent: Optional["_Node"],
+                 block_id: int):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.block_id = block_id
+        self.last_used = 0
+        self.uid = next(_NODE_UIDS)
+
+
+class PagedPrefixCache:
+    """Radix trie of cached prefixes keyed on token ids, valued in BLOCK
+    IDS — the paged rekeying of ``prefix_cache.PrefixCache``.
+
+    The dense store held host numpy KV and a hit paid restore (host→HBM
+    copy-in); here a node is one pool block id and a hit is pointer
+    arithmetic: the engine writes the matched ids into the slot's block
+    table and attention gathers the shared blocks directly.  Zero KV bytes
+    move on either hit or insert.
+
+    Only *complete* blocks are cached (``insert`` takes the blocks covering
+    ``floor(n_prompt / block) * block`` prompt tokens): a partial tail
+    block keeps receiving the owning slot's decode K/V writes, so sharing
+    it would let two slots write different tokens into the same physical
+    positions.  Matches are additionally capped at ``len(ids) - 1`` tokens
+    — the engine must prefill at least one token for next-token logits.
+
+    Eviction (`evict`) drops least-recently-used leaves whose block nobody
+    else references (pool refcount == 1, i.e. only the cache's own ref) —
+    a block a live slot shares is skipped, never reclaimed.  There is no
+    byte cap: the pool itself bounds residency, and the server evicts on
+    demand when admission runs short of free blocks.
+    """
+
+    def __init__(self, pool: KVBlockPool, on_evict=None):
+        self.pool = pool
+        self.block = pool.block
+        #: optional hook called (outside the lock) with the number of
+        #: blocks an evict() pass freed — the server bumps its eviction
+        #: counter here, mirroring the dense store's contract
+        self.on_evict = on_evict
+        self._root = _Node((), None, -1)
+        self._lock = threading.Lock()
+        self._tick = 0
+        # stats
+        self.entries = 0
+        self.hits = 0
+        self.misses = 0
+        self.lookups = 0
+        self.evictions = 0
+        self.hit_tokens = 0
+        self.inserted_tokens = 0
+
+    # ------------------------------------------------------------- lookup
+    def match(self, ids: List[int]) -> PagedMatch:
+        """Longest cached prefix of ``ids`` (whole blocks, capped at
+        ``len(ids) - 1`` tokens).  Increfs every matched block before
+        returning — the caller owns those references (see PagedMatch)."""
+        max_blocks = max(0, (len(ids) - 1) // self.block)
+        with self._lock:
+            self._tick += 1
+            self.lookups += 1
+            node, depth, blocks = self._root, 0, []
+            while depth < max_blocks:
+                key = tuple(ids[depth * self.block:(depth + 1) * self.block])
+                child = node.children.get(key)
+                if child is None:
+                    break
+                child.last_used = self._tick
+                blocks.append(child.block_id)
+                node, depth = child, depth + 1
+            if not blocks:
+                self.misses += 1
+                return PagedMatch(0, [])
+            self.pool.incref(blocks)
+            self.hits += 1
+            self.hit_tokens += depth * self.block
+            return PagedMatch(depth * self.block, blocks)
+
+    # ------------------------------------------------------------- insert
+    def insert(self, ids: List[int], block_ids: Sequence[int]) -> int:
+        """Record ``block_ids`` as the cache entry for the first
+        ``len(block_ids)`` whole blocks of ``ids``.  Newly recorded blocks
+        gain one pool reference (the cache's); blocks whose chunk is
+        already cached — possibly under a DIFFERENT physical id from a
+        concurrent identical prompt — are skipped (the caller's copy is
+        simply not recorded and frees at retire).  Returns newly cached
+        tokens."""
+        if len(block_ids) * self.block > len(ids):
+            raise ValueError(
+                f"{len(block_ids)} blocks cover "
+                f"{len(block_ids) * self.block} tokens > prompt {len(ids)}")
+        new_tokens = 0
+        with self._lock:
+            self._tick += 1
+            node = self._root
+            for d, bid in enumerate(block_ids):
+                key = tuple(ids[d * self.block:(d + 1) * self.block])
+                child = node.children.get(key)
+                if child is None:
+                    self.pool.incref([bid])
+                    child = _Node(key, node, bid)
+                    node.children[key] = child
+                    self.entries += 1
+                    new_tokens += self.block
+                child.last_used = self._tick
+                node = child
+            self.inserted_tokens += new_tokens
+        return new_tokens
+
+    # ----------------------------------------------------------- eviction
+    def evictable_blocks(self) -> int:
+        """Blocks the cache could release right now: resident nodes whose
+        block only the cache references (no slot is decoding against it).
+        This is what capacity-true admission adds to the free count."""
+        with self._lock:
+            return sum(1 for n in self._walk()
+                       if self.pool.refcount(n.block_id) == 1)
+
+    def evict(self, need_blocks: int) -> int:
+        """Release up to ``need_blocks`` blocks, LRU leaves first (interior
+        nodes become leaves — and eviction candidates — as their subtrees
+        drain, via the parent-promotion push below).  Leaves a live slot
+        shares (pool refcount > 1) are skipped — eviction is blocked while
+        referenced; the block frees later when the slot retires and its
+        decref reaches 0.  One trie walk total (a heap orders candidates),
+        not one per freed block — this runs on the serving thread under
+        admission pressure.  Returns blocks actually freed."""
+        import heapq
+
+        freed = 0
+        with self._lock:
+            heap = [(n.last_used, n.uid, n) for n in self._walk()
+                    if not n.children
+                    and self.pool.refcount(n.block_id) == 1]
+            heapq.heapify(heap)
+            while heap and freed < need_blocks:
+                _, _, leaf = heapq.heappop(heap)
+                # a promoted parent may have been re-checked stale; guard
+                if (leaf.children
+                        or leaf.parent.children.get(leaf.key) is not leaf
+                        or self.pool.refcount(leaf.block_id) != 1):
+                    continue
+                leaf.parent.children.pop(leaf.key)
+                self.entries -= 1
+                self.evictions += 1
+                freed += self.pool.decref([leaf.block_id])
+                parent = leaf.parent
+                if (parent is not self._root and not parent.children
+                        and self.pool.refcount(parent.block_id) == 1):
+                    heapq.heappush(heap,
+                                   (parent.last_used, parent.uid, parent))
+        if freed:
+            log.info("paged prefix cache evicted %d block(s) "
+                     "(%d tokens)", freed, freed * self.block)
+            if self.on_evict is not None:
+                self.on_evict(freed)
+        return freed
+
+    def _walk(self):
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n
+
+    # -------------------------------------------------------------- admin
+    def clear(self) -> int:
+        """Drop every resident node (decref all) — returns blocks freed."""
+        with self._lock:
+            ids = [n.block_id for n in self._walk()]
+            self._root = _Node((), None, -1)
+            self.entries = 0
+            return self.pool.decref(ids) if ids else 0
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {
+                "enabled": True,
+                "paged": True,
+                "block_tokens": self.block,
+                "entries": self.entries,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / lookups) if lookups else 0.0,
+                "evictions": self.evictions,
+                "cached_tokens_served": self.hit_tokens,
+                "inserted_tokens": self.inserted_tokens,
+            }
+
+
+class PagedKVRuntime:
+    """Everything the serving stack shares about one paged KV pool: the
+    host allocator, the persistent DEVICE pool arrays (handed to each
+    ``ContinuousEngine`` run and handed back — cached blocks must survive
+    across busy periods, unlike the dense engine's per-run caches), and
+    the optional paged prefix cache.
+
+    ``arrays`` is the per-layer list of pool tensors from
+    ``tpustack.models.llama.init_kv_pool``; the engine donates them to
+    every paged dispatch and stores the returned buffers back here, so
+    there is exactly one pool's worth of HBM however many runs come and
+    go.  ``block_tables(B)`` returns a fresh host-side table (int32,
+    ``[B, max_seq // block]``, all entries the reserved block 0).
+    """
+
+    def __init__(self, arrays, pool: KVBlockPool, max_seq: int,
+                 cache: Optional[PagedPrefixCache] = None):
+        if max_seq % pool.block:
+            raise ValueError(
+                f"max_seq {max_seq} not a multiple of block {pool.block}")
+        self.arrays = arrays
+        self.pool = pool
+        self.cache = cache
+        self.max_seq = max_seq
+        self.block = pool.block
+        self.blocks_per_seq = max_seq // pool.block
+
+    # ------------------------------------------------------ admission math
+    def need_tokens(self, n_prompt: int, max_new: int) -> int:
+        """Tokens a request reserves: prompt + its REAL budget (clamped to
+        the context window) — the engine's own budget formula, so admission
+        and allocation can never disagree."""
+        return n_prompt + max(0, min(max_new, self.max_seq - n_prompt))
+
+    def need_blocks(self, n_prompt: int, max_new: int) -> int:
+        return self.pool.blocks_for(self.need_tokens(n_prompt, max_new))
+
+    def ensure_free(self, n_blocks: int) -> bool:
+        """True when ``n_blocks`` are free, evicting unreferenced cached
+        blocks (LRU) to get there if needed."""
+        short = n_blocks - self.pool.n_free
+        if short > 0 and self.cache is not None:
+            self.cache.evict(short)
+        return self.pool.n_free >= n_blocks
+
+    def admissible_blocks(self) -> int:
+        """Blocks admission may count on immediately: free + evictable."""
+        n = self.pool.n_free
+        if self.cache is not None:
+            n += self.cache.evictable_blocks()
+        return n
+
+    def stats(self) -> Dict[str, object]:
+        out = dict(self.pool.stats())
+        out["blocks_per_seq"] = self.blocks_per_seq
+        out["prefix_cache"] = (self.cache.stats() if self.cache is not None
+                               else {"enabled": False})
+        return out

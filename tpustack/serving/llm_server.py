@@ -33,13 +33,28 @@ reference's Q4_K_M GGUF but ~2x decode from halved HBM traffic),
 ``LLM_MAX_BATCH`` (continuous-batching slot count — llama.cpp
 ``--parallel`` analog; requests join/leave the running batch at chunk
 boundaries; ``LLM_BATCH_WINDOW_MS`` is a legacy no-op),
+``TPUSTACK_PAGED_KV`` (paged KV substrate, ON by default for batched
+serving: slots hold block tables into one HBM-resident pool instead of
+private ``max_seq`` cache lines, admission is "enough free blocks for
+prompt + max_new" instead of "free slot", prefix reuse is zero-copy
+refcounted block sharing, and out-of-blocks requests get 429 with a
+Retry-After computed from projected block release; ``0`` falls back to
+the dense per-slot engine for bisection;
+``TPUSTACK_KV_BLOCK`` is the block size in tokens (default
+``min(64, max(8, ctx / 8))``, snapped to divide ctx);
+``TPUSTACK_KV_POOL_BLOCKS`` is the allocatable pool size in blocks
+(default ``LLM_MAX_BATCH x ctx / block`` — dense HBM parity; raise it
+and ``LLM_MAX_BATCH`` together to serve more concurrent requests from
+the same HBM when typical contexts run short of ctx)),
 ``TPUSTACK_PREFIX_CACHE`` (cross-request prefix KV cache — radix reuse of
 finished prefill KV so chat requests sharing a system prompt skip its
-prefill entirely; on by default, ``0`` disables;
-``TPUSTACK_PREFIX_CACHE_MB`` caps resident host bytes, default 512;
-``TPUSTACK_PREFIX_CACHE_CHUNK`` is the snap granularity in tokens,
-default 256; per-request opt-out via ``"cache_prompt": false`` in the
-body — llama.cpp's field name),
+prefill entirely; on by default, ``0`` disables.  Under paged KV the
+store is the refcounted block trie (``tpustack.serving.kv_pool``) and a
+hit is pointer sharing; under the dense fallback it is the host-resident
+radix store, where ``TPUSTACK_PREFIX_CACHE_MB`` caps resident host
+bytes, default 512, and ``TPUSTACK_PREFIX_CACHE_CHUNK`` is the snap
+granularity in tokens, default 256; per-request opt-out via
+``"cache_prompt": false`` in the body — llama.cpp's field name),
 ``MODEL_DIR`` (HF safetensors), ``LLM_TOKENIZER_DIR``, ``PORT`` (8080),
 plus the shared resilience contract (``tpustack.serving.resilience``):
 ``TPUSTACK_DRAIN_TIMEOUT_S``, ``TPUSTACK_REQUEST_TIMEOUT_S`` (per-request
@@ -77,6 +92,18 @@ log = get_logger("serving.llm_server")
 class _Cancelled(Exception):
     """Raised inside the generate loop (via on_token) to abandon a stream
     whose client went away — stops burning TPU on a dead connection."""
+
+
+class OutOfKVBlocks(Exception):
+    """Paged admission shortfall: the pool (even after evicting every
+    unreferenced cached block) cannot cover the request right now.
+    ``retry_after_s`` is capacity-true — computed from the projected
+    block-release time of the in-flight requests, not a slot-count
+    heuristic — and handlers surface it as 429 + Retry-After."""
+
+    def __init__(self, retry_after_s: int):
+        super().__init__(f"out of KV blocks; retry after {retry_after_s}s")
+        self.retry_after_s = retry_after_s
 
 
 def _or_default(value, default):
@@ -163,10 +190,12 @@ class _PendingCompletion:
 
     __slots__ = ("ids", "n_predict", "sample", "future", "cancel",
                  "stream_put", "seed", "prefix", "kv_extract", "on_prefill_kv",
-                 "phase", "span_ctx", "queue_span")
+                 "phase", "span_ctx", "queue_span", "kv_blocks",
+                 "on_prefill_blocks")
 
     def __init__(self, ids, n_predict, sample, future, stream_put=None,
-                 seed=None, prefix=None, kv_extract=None, on_prefill_kv=None):
+                 seed=None, prefix=None, kv_extract=None, on_prefill_kv=None,
+                 kv_blocks=None, on_prefill_blocks=None):
         self.ids = ids
         self.n_predict = n_predict
         self.sample = sample
@@ -183,6 +212,14 @@ class _PendingCompletion:
         self.prefix = prefix
         self.kv_extract = kv_extract
         self.on_prefill_kv = on_prefill_kv
+        # paged-KV hooks: blocks pre-allocated at HTTP admission (the
+        # capacity check IS the allocation, so admission and the engine can
+        # never disagree) and the zero-copy cache-insert callback.  While
+        # phase == "queued" the SERVER owns the references (released if the
+        # request dies in the queue); feed() handing it to a slot transfers
+        # ownership to the engine.
+        self.kv_blocks = kv_blocks
+        self.on_prefill_blocks = on_prefill_blocks
         # distributed tracing: the request's HTTP root-span context (engine
         # threads parent their prefill/wave spans under it) and the
         # queue_wait span, open from enqueue until feed() hands the request
@@ -220,11 +257,14 @@ class LLMServer:
 
     #: sentinel: "build the prefix cache from the environment"
     _PREFIX_FROM_ENV = object()
+    #: sentinel: "build the paged KV runtime from the environment"
+    _PAGED_FROM_ENV = object()
 
     def __init__(self, generator=None, tokenizer=None, model_name: str = "tpustack",
                  max_batch: Optional[int] = None,
                  batch_window_ms: Optional[float] = None,
-                 registry=None, prefix_cache=_PREFIX_FROM_ENV, tracer=None):
+                 registry=None, prefix_cache=_PREFIX_FROM_ENV, tracer=None,
+                 paged=_PAGED_FROM_ENV):
         # metrics registry: tests pass a fresh Registry for isolation; the
         # default is the process-wide one /metrics exposes
         self._registry = registry
@@ -233,17 +273,6 @@ class LLMServer:
         # distributed tracing: same isolation contract as the registry —
         # tests pass a fresh Tracer, production shares the process default
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
-        # cross-request prefix KV cache (tpustack.serving.prefix_cache):
-        # tests pass an instance (tiny chunk) or None (hard off); serving
-        # builds from TPUSTACK_PREFIX_CACHE{,_MB,_CHUNK}, default ON —
-        # lookup/insert are no-ops until a prompt spans a whole chunk
-        if prefix_cache is LLMServer._PREFIX_FROM_ENV:
-            prefix_cache = self._build_prefix_cache()
-        self.prefix_cache = prefix_cache
-        if prefix_cache is not None and prefix_cache._on_evict is None:
-            prefix_cache._on_evict = (
-                lambda n: self.metrics[
-                    "tpustack_llm_prefix_cache_evictions_total"].inc(n))
         if generator is None:
             generator, tokenizer, model_name = _build_generator()
         self.gen = generator
@@ -252,6 +281,45 @@ class LLMServer:
         self._lock = asyncio.Lock()
         self.max_batch = (int(os.environ.get("LLM_MAX_BATCH", "8"))
                           if max_batch is None else max_batch)
+        # paged KV substrate (tpustack.serving.kv_pool) — the default
+        # serving engine: one HBM block pool + per-slot block tables,
+        # capacity-true admission, refcounted zero-copy prefix sharing.
+        # Tests pass an explicit PagedKVRuntime or None; an explicit DENSE
+        # PrefixCache instance forces the dense fallback (the two stores
+        # don't mix).  TPUSTACK_PAGED_KV=0 is the bisection flag.
+        explicit_dense_cache = (
+            prefix_cache is not LLMServer._PREFIX_FROM_ENV
+            and prefix_cache is not None)
+        if paged is LLMServer._PAGED_FROM_ENV:
+            paged = (None if explicit_dense_cache
+                     else self._build_paged(self.gen, self.max_batch))
+            if paged is not None and prefix_cache is None:
+                paged.cache = None  # caller asked for NO prefix cache:
+                # keep the paged engine, drop the block trie
+        self.paged = paged
+        if self.paged is not None:
+            prefix_cache = None  # the block trie replaces the host store
+        # cross-request prefix KV cache, DENSE fallback form
+        # (tpustack.serving.prefix_cache): tests pass an instance (tiny
+        # chunk) or None (hard off); serving builds from
+        # TPUSTACK_PREFIX_CACHE{,_MB,_CHUNK}, default ON — lookup/insert
+        # are no-ops until a prompt spans a whole chunk
+        if prefix_cache is LLMServer._PREFIX_FROM_ENV:
+            prefix_cache = self._build_prefix_cache()
+        self.prefix_cache = prefix_cache
+        if prefix_cache is not None and prefix_cache._on_evict is None:
+            prefix_cache._on_evict = (
+                lambda n: self.metrics[
+                    "tpustack_llm_prefix_cache_evictions_total"].inc(n))
+        if (self.paged is not None and self.paged.cache is not None
+                and self.paged.cache.on_evict is None):
+            # same exported counter as the dense store, paged substrate
+            self.paged.cache.on_evict = (
+                lambda n: self.metrics[
+                    "tpustack_llm_prefix_cache_evictions_total"].inc(n))
+        # live engine during a busy period — the projected-block-release
+        # estimate behind 429 Retry-After reads it opportunistically
+        self._engine = None
         # legacy knob (pre-continuous window batching): accepted, unused
         self.batch_window_ms = (
             float(os.environ.get("LLM_BATCH_WINDOW_MS", "0"))
@@ -295,6 +363,156 @@ class LLMServer:
                     or 256)
         return PrefixCache(chunk_tokens=chunk,
                            capacity_bytes=max(1, int(mb * 1024 * 1024)))
+
+    @staticmethod
+    def _build_paged(gen, max_batch: int):
+        """Paged KV runtime from the environment (default ON for batched
+        serving; ``LLM_MAX_BATCH=1`` solo deployments keep the dense
+        engine).  Block size snaps down to divide the context; the pool
+        defaults to dense HBM parity (``max_batch x ctx`` tokens) — the
+        concurrency win comes from admission charging each request its
+        ACTUAL ``prompt + max_new`` instead of a whole ctx line."""
+        if os.environ.get("TPUSTACK_PAGED_KV", "1").lower() in (
+                "0", "false", "no", "off"):
+            return None
+        if max_batch < 2:
+            return None
+        from tpustack.models.llama import init_kv_pool
+        from tpustack.serving.kv_pool import (KVBlockPool, PagedKVRuntime,
+                                              PagedPrefixCache)
+
+        max_seq = gen.cfg.max_seq
+        block = int(os.environ.get("TPUSTACK_KV_BLOCK", "0") or 0)
+        if block <= 0:
+            block = min(64, max(8, max_seq // 8))
+        block = min(block, max_seq)
+        while block > 1 and max_seq % block:
+            block //= 2
+        n_blocks = int(os.environ.get("TPUSTACK_KV_POOL_BLOCKS", "0") or 0)
+        if n_blocks <= 0:
+            n_blocks = max_batch * (max_seq // block)
+        pool = KVBlockPool(n_blocks + 1, block)  # +1: reserved block 0
+        cache = None
+        if os.environ.get("TPUSTACK_PREFIX_CACHE", "1").lower() not in (
+                "0", "false", "no", "off"):
+            cache = PagedPrefixCache(pool)
+        arrays = init_kv_pool(gen.cfg, n_blocks + 1, block,
+                              dtype=gen.cache_dtype)
+        log.info("paged KV pool: %d blocks x %d tokens (ctx %d, %d-slot "
+                 "dense parity), prefix cache %s", n_blocks, block, max_seq,
+                 max_batch, "on" if cache is not None else "off")
+        return PagedKVRuntime(arrays, pool, max_seq, cache)
+
+    # ---------------------------------------------------- paged admission
+    def _paged_gauges(self) -> None:
+        p = self.paged.pool
+        self.metrics["tpustack_llm_kv_free_blocks"].set(p.n_free)
+        self.metrics["tpustack_llm_kv_used_blocks"].set(p.n_used)
+        self.metrics["tpustack_llm_kv_block_fragmentation_ratio"].set(
+            p.fragmentation())
+
+    def _paged_retry_after(self, shortfall_blocks: int) -> int:
+        """Capacity-true Retry-After: seconds until the in-flight
+        requests' projected block releases cover the shortfall (engine
+        fetch-mark decode rate x remaining budgets), clamped to [1, 120].
+        Falls back to the resilience layer's p50-service heuristic when no
+        engine run is live to estimate from."""
+        import math
+
+        eng = self._engine
+        ra = None
+        if eng is not None:
+            try:
+                ra = eng.projected_block_release_s(shortfall_blocks)
+            except Exception:
+                ra = None
+        if ra is None:
+            return self.resilience.retry_after_s()
+        ra = min(max(1, math.ceil(ra)), 120)
+        self.metrics["tpustack_retry_after_seconds"].labels(
+            server="llm").set(ra)
+        return ra
+
+    def _paged_admit(self, ids, n_predict: int, cache_prompt: bool):
+        """Admission + prefix hooks for the paged engine, in ONE step: the
+        capacity check IS the allocation.  A prefix hit increfs the shared
+        blocks (zero-copy — counted in the copy-avoided total) and only
+        the uncached remainder allocates fresh blocks; a shortfall first
+        evicts unreferenced cached blocks (LRU), then raises
+        :class:`OutOfKVBlocks` with the projected-release Retry-After.
+        Returns ``(prefix, kv_blocks, on_prefill_blocks)`` for the
+        SlotRequest."""
+        from tpustack.serving.kv_pool import OutOfBlocks
+
+        rt = self.paged
+        prefix = None
+        if rt.cache is not None and cache_prompt:
+            m = rt.cache.match(ids)
+            self.metrics["tpustack_llm_prefix_cache_lookups_total"].labels(
+                result="hit" if m.length else "miss").inc()
+            self.metrics["tpustack_llm_prefix_cached_tokens"].observe(
+                m.length)
+            span = obs_trace.current_span.get()
+            if span is not None:
+                span.add_event("prefix_cache",
+                               result="hit" if m.length else "miss",
+                               cached_tokens=m.length)
+            if m.length:
+                self.metrics[
+                    "tpustack_llm_kv_copy_avoided_tokens_total"].inc(
+                    m.length)
+                prefix = (m.length, m.block_ids)
+        n_shared = len(prefix[1]) if prefix else 0
+        fresh_tokens = (rt.need_tokens(len(ids), max(0, n_predict))
+                        - n_shared * rt.block)
+        need_fresh = rt.pool.blocks_for(fresh_tokens)
+        if n_shared + need_fresh > rt.pool.capacity_blocks:
+            if prefix:
+                rt.pool.decref(prefix[1])
+            raise ValueError(
+                f"request needs {n_shared + need_fresh} KV blocks; the "
+                f"pool holds {rt.pool.capacity_blocks} "
+                f"(TPUSTACK_KV_POOL_BLOCKS)")
+        try:
+            rt.ensure_free(need_fresh)
+            kv_blocks = rt.pool.alloc_tokens(fresh_tokens)
+        except OutOfBlocks:
+            if prefix:
+                rt.pool.decref(prefix[1])
+            self.metrics["tpustack_requests_shed_total"].labels(
+                server="llm", reason="out_of_kv_blocks").inc()
+            shortfall = need_fresh - rt.pool.n_free
+            raise OutOfKVBlocks(self._paged_retry_after(shortfall)) from None
+        on_insert = None
+        if (rt.cache is not None and cache_prompt
+                and len(ids) // rt.block > n_shared):
+            ids_copy = list(ids)
+
+            def on_insert(bids):
+                new_toks = rt.cache.insert(ids_copy, bids)
+                if new_toks:
+                    # dense inserts copied these tokens' KV device→host;
+                    # recording block ids moves zero bytes
+                    self.metrics[
+                        "tpustack_llm_kv_copy_avoided_tokens_total"].inc(
+                        new_toks)
+        self._paged_gauges()
+        return prefix, kv_blocks, on_insert
+
+    def _paged_release(self, r: "_PendingCompletion") -> None:
+        """Release a QUEUED request's pool references (pre-allocated fresh
+        blocks + prefix-hit refs).  No-op once feed() handed the request
+        to a slot — from then on the engine owns the references and
+        releases them at retire (or in its failure path)."""
+        if self.paged is None or r.phase != "queued":
+            return
+        ids = list(r.kv_blocks or [])
+        if r.prefix:
+            ids += list(r.prefix[1])
+        r.kv_blocks, r.prefix = None, None
+        if ids:
+            self.paged.pool.decref(ids)
+            self._paged_gauges()
 
     def _prefix_lookup(self, ids, allow: bool = True):
         """Per-request prefix-cache policy: longest cached prefix (hit →
@@ -401,14 +619,24 @@ class LLMServer:
         self.metrics["tpustack_llm_queue_depth"].set(len(self._queue))
         self._wake.set()
 
+    def _request_hooks(self, ids, n_predict: int, cache_prompt: bool) -> dict:
+        """Per-request KV-cache wiring, mode-routed: paged admission (the
+        allocation-is-admission path; may raise :class:`OutOfKVBlocks` or
+        ValueError) or the dense prefix-cache lookup.  Returns
+        _PendingCompletion/SlotRequest kwargs."""
+        if self.paged is not None and self._batchable():
+            prefix, kv_blocks, on_insert = self._paged_admit(
+                ids, n_predict, cache_prompt)
+            return {"prefix": prefix, "kv_blocks": kv_blocks,
+                    "on_prefill_blocks": on_insert}
+        p, e, cb = self._prefix_lookup(ids, cache_prompt)
+        return {"prefix": p, "kv_extract": e, "on_prefill_kv": cb}
+
     async def _enqueue_completion(self, ids, n_predict, sample, seed=None,
-                                  prefix_hooks=(None, None, None),
-                                  deadline_s=None):
+                                  hooks=None, deadline_s=None):
         loop = asyncio.get_running_loop()
         req = _PendingCompletion(ids, n_predict, sample, loop.create_future(),
-                                 seed=seed, prefix=prefix_hooks[0],
-                                 kv_extract=prefix_hooks[1],
-                                 on_prefill_kv=prefix_hooks[2])
+                                 seed=seed, **(hooks or {}))
         await self._enqueue_raw(req)
         try:
             return await asyncio.wait_for(req.future, deadline_s)
@@ -437,6 +665,9 @@ class LLMServer:
 
         def on_done(tokens, row_stats):
             self.metrics["tpustack_llm_running_requests"].dec()
+            if self.paged is not None:
+                # the engine freed the slot's blocks before calling us
+                self._paged_gauges()
             if tokens is None:  # admission-time validation failure
                 self.metrics["tpustack_llm_requests_rejected_total"].labels(
                     reason="admission").inc()
@@ -455,7 +686,8 @@ class LLMServer:
                            cancelled=r.cancel.is_set, seed=r.seed,
                            prefix=r.prefix, kv_extract=r.kv_extract,
                            on_prefill_kv=r.on_prefill_kv,
-                           span_ctx=r.span_ctx)
+                           span_ctx=r.span_ctx, kv_blocks=r.kv_blocks,
+                           on_prefill_blocks=r.on_prefill_blocks)
 
     async def _batch_loop(self):
         """Run the continuous engine whenever requests are queued: the
@@ -479,7 +711,8 @@ class LLMServer:
                     chunk=self.engine_chunk,
                     stop_tokens=(self.tok.eos_id,),
                     on_progress=self.resilience.progress,
-                    tracer=self.tracer)
+                    tracer=self.tracer, paged=self.paged)
+                self._engine = engine
 
                 def feed():
                     if self._solo_waiting > 0:
@@ -496,7 +729,8 @@ class LLMServer:
                             if r.queue_span is not None:
                                 r.queue_span.set_attribute("cancelled", True)
                                 r.queue_span.end(status="error")
-                            continue  # waiter already cancelled its future
+                            self._paged_release(r)  # died queued: give the
+                            continue  # blocks back; waiter already gone
                         handed.append(r)
                         r.phase = "decode"  # now owns a slot (504 phase)
                         if r.queue_span is not None:
@@ -515,6 +749,10 @@ class LLMServer:
                 for r in handed:
                     if r.queue_span is not None:
                         r.queue_span.end(status="error")  # idempotent
+                    # still-queued requests hold pool references the engine
+                    # never saw (phase gate makes this a no-op for rows the
+                    # engine's own failure path already released)
+                    self._paged_release(r)
                     if not r.future.done():
                         r.future.set_exception(exc)
                     if r.stream_put is not None:
@@ -557,7 +795,9 @@ class LLMServer:
             self.metrics["tpustack_llm_requests_rejected_total"].labels(
                 reason="empty_prompt").inc()
             raise ValueError("empty prompt")
-        prefix_hooks = self._prefix_lookup(ids, cache_prompt)
+        hooks = self._request_hooks(ids, n_predict, cache_prompt)
+        prefix_hooks = (hooks.get("prefix"), hooks.get("kv_extract"),
+                        hooks.get("on_prefill_kv"))
         t_start = time.perf_counter()
         if not self._batchable():
             cancel = threading.Event()
@@ -586,7 +826,7 @@ class LLMServer:
                               greedy=temperature <= 0)
         out_ids, stats = await self._enqueue_completion(ids, n_predict, sample,
                                                         seed=seed,
-                                                        prefix_hooks=prefix_hooks,
+                                                        hooks=hooks,
                                                         deadline_s=deadline_s)
         if out_ids and out_ids[-1] == self.tok.eos_id:
             out_ids = out_ids[:-1]
@@ -715,6 +955,41 @@ class LLMServer:
             if fmt == "openai":
                 return web.json_response({"error": {"message": msg}}, status=400)
             return web.json_response({"error": msg}, status=400)
+        try:
+            # paged admission allocates HERE — any 429/400 must go out as
+            # JSON with real status codes, before the SSE headers flush
+            hooks = self._request_hooks(ids, n_predict, cache_prompt)
+        except OutOfKVBlocks as e:
+            payload = ({"error": {"message": str(e)}} if fmt == "openai"
+                       else {"error": str(e)})
+            return web.json_response(
+                payload, status=429,
+                headers={"Retry-After": str(e.retry_after_s)})
+        except ValueError as e:
+            payload = ({"error": {"message": str(e)}} if fmt == "openai"
+                       else {"error": str(e)})
+            return web.json_response(payload, status=400)
+
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        prefix_hooks = (hooks.get("prefix"), hooks.get("kv_extract"),
+                        hooks.get("on_prefill_kv"))
+        batched = self._batchable()
+        if batched:
+            # concurrent streams coalesce into ONE batched decode; tokens
+            # arrive per fused chunk (coarser cadence than the solo path's
+            # per-token hook, but N streams share each weight pass).  Built
+            # BEFORE the SSE headers flush: the request object is what owns
+            # the paged admission's pool references until it is enqueued.
+            req = _PendingCompletion(
+                ids, n_predict,
+                SampleConfig(temperature=temperature, top_k=top_k,
+                             greedy=temperature <= 0),
+                loop.create_future(),
+                stream_put=lambda t: loop.call_soon_threadsafe(q.put_nowait, t),
+                seed=seed, **hooks)
+            cancel = req.cancel
 
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
@@ -723,7 +998,16 @@ class LLMServer:
             # prepared StreamResponse — stamp the rid before headers flush
             "X-Request-Id": request.get("request_id", "-"),
         })
-        await resp.prepare(request)
+        try:
+            await resp.prepare(request)
+        except BaseException:
+            # client died before the stream existed (prepare raised, or the
+            # handler task was cancelled at this await): the request was
+            # never enqueued, so nothing downstream will ever release its
+            # paged admission blocks — do it here or they leak forever
+            if batched:
+                self._paged_release(req)
+            raise
 
         async def send(payload) -> None:
             # bounded write: a stalled-but-connected reader (TCP zero window)
@@ -732,25 +1016,7 @@ class LLMServer:
                 resp.write(b"data: " + json.dumps(payload).encode() + b"\n\n"),
                 timeout=60)
 
-        loop = asyncio.get_running_loop()
-        q: asyncio.Queue = asyncio.Queue()
-
-        prefix_hooks = self._prefix_lookup(ids, cache_prompt)
-        batched = self._batchable()
-        if batched:
-            # concurrent streams coalesce into ONE batched decode; tokens
-            # arrive per fused chunk (coarser cadence than the solo path's
-            # per-token hook, but N streams share each weight pass)
-            req = _PendingCompletion(
-                ids, n_predict,
-                SampleConfig(temperature=temperature, top_k=top_k,
-                             greedy=temperature <= 0),
-                loop.create_future(),
-                stream_put=lambda t: loop.call_soon_threadsafe(q.put_nowait, t),
-                seed=seed, prefix=prefix_hooks[0],
-                kv_extract=prefix_hooks[1], on_prefill_kv=prefix_hooks[2])
-            cancel = req.cancel
-        else:
+        if not batched:
             cancel = threading.Event()
 
             def on_token(t):
@@ -934,7 +1200,10 @@ class LLMServer:
             "chunk": self.engine_chunk,
             "queue_depth": len(self._queue),
             "solo_waiting": self._solo_waiting,
-            "prefix_cache": self.prefix_cache is not None,
+            "prefix_cache": (self.prefix_cache is not None
+                             or (self.paged is not None
+                                 and self.paged.cache is not None)),
+            "paged_kv": self.paged is not None,
         }})
         return web.json_response(payload, status=status)
 
@@ -945,17 +1214,28 @@ class LLMServer:
         return web.json_response(payload, status=status)
 
     async def props(self, request: web.Request) -> web.Response:
-        """Server properties + live prefix-cache config/stats, so operators
-        can verify the cache (enabled, chunk, capacity, hit rate) without
-        scraping ``/metrics``."""
+        """Server properties + live KV-cache config/stats, so operators can
+        verify the serving substrate (paged pool size/block/utilization,
+        prefix-cache hit rate, dense-fallback flag) without scraping
+        ``/metrics``."""
         pc = self.prefix_cache
-        return web.json_response({
+        payload = {
             "model": self.model_name,
             "n_ctx": self.gen.cfg.max_seq,
             "backend": "jax/tpu",
             "prefix_cache": pc.stats() if pc is not None
             else {"enabled": False},
-        })
+        }
+        if self.paged is not None:
+            rt = self.paged
+            payload["paged_kv"] = dict(rt.stats(), enabled=True,
+                                       dense_fallback=False)
+            payload["prefix_cache"] = (rt.cache.stats()
+                                       if rt.cache is not None
+                                       else {"enabled": False})
+        else:
+            payload["paged_kv"] = {"enabled": False, "dense_fallback": True}
+        return web.json_response(payload)
 
     def _reject(self, reason: str) -> None:
         self.metrics["tpustack_llm_requests_rejected_total"].labels(
@@ -999,6 +1279,10 @@ class LLMServer:
                 cache_prompt=cache_prompt, deadline_s=deadline_s)
         except ValueError as e:  # e.g. prompt longer than the context window
             return web.json_response({"error": str(e)}, status=400)
+        except OutOfKVBlocks as e:
+            return web.json_response(
+                {"error": str(e)}, status=429,
+                headers={"Retry-After": str(e.retry_after_s)})
         except DeadlineExceeded as e:
             self.resilience.note_deadline(e.phase)
             return web.json_response({"error": str(e), "phase": e.phase},
@@ -1051,6 +1335,10 @@ class LLMServer:
                 cache_prompt=cache_prompt, deadline_s=deadline_s)
         except ValueError as e:
             return web.json_response({"error": {"message": str(e)}}, status=400)
+        except OutOfKVBlocks as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=429,
+                headers={"Retry-After": str(e.retry_after_s)})
         except DeadlineExceeded as e:
             self.resilience.note_deadline(e.phase)
             return web.json_response(
