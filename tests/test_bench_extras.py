@@ -38,17 +38,19 @@ def test_llm_extras_schema(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     out = bench._llm_extras(lambda *a: None)
     assert set(out) == {"continuous_e2e", "prefill_8k", "shared_prefix",
-                        "paged"}
+                        "paged", "speculative"}
     for sub in out.values():
         assert sub["value"] == 1.0
         assert sub["steady_decode_tokens_per_sec"] == 2.0
         assert "ignored_key" not in sub
-    # the four bench_llm invocations: batch-8 continuous + the 8k prefill
-    # + the shared-prefix (prefix KV cache) + the paged-KV sweep workloads
+    # the five bench_llm invocations: batch-8 continuous + the 8k prefill
+    # + the shared-prefix (prefix KV cache) + the paged-KV sweep + the
+    # speculative-decoding sweep workloads
     assert any("--continuous" in c for c in calls)
     assert any("8192" in c for c in calls)
     assert any("--shared-prefix" in c for c in calls)
     assert any("--paged" in c for c in calls)
+    assert any("--speculative" in c for c in calls)
 
 
 def test_wan_extras_schema(monkeypatch):
@@ -79,6 +81,7 @@ def test_extras_degrade_on_tool_failure(monkeypatch):
     out = bench._llm_extras(lambda *a: None)
     assert "error" in out["continuous_e2e"] and "error" in out["prefill_8k"]
     assert "error" in out["shared_prefix"] and "error" in out["paged"]
+    assert "error" in out["speculative"]
     wan = bench._wan_extras(lambda *a: None)
     assert "error" in wan
 

@@ -432,6 +432,58 @@ def test_build_paged_env_knobs(gen, monkeypatch):
     assert rt.cache is None
 
 
+def test_server_spec_paged_burst_leak_check(gen):
+    """The PR 7 extension of the burst leak bar: speculation × paged KV —
+    bursts of repetitive (drafting) prompts with a mid-stream
+    cancellation mixed in leave no leaked or double-freed blocks; the
+    verify step's rejected-draft KV never lands, so residency afterwards
+    is exactly the cache's evictable blocks."""
+    from tpustack.serving.speculative import SpecConfig
+
+    rt = make_runtime(gen)
+    server, reg = _server(gen, paged=rt, spec=SpecConfig(tokens=4))
+    server.chunk = 4  # tiny-shape wave cadence (prod chunk covers a whole
+    # tiny budget in one pipelined fill, leaving speculation nothing)
+    free0 = rt.pool.n_free
+    bodies = [{"prompt": "abcabcabcabcabcabcabcabcabc" + t,
+               "n_predict": 24, "temperature": 0}
+              for t in ("a", "b", "a", "c", "b")]
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def scenario():
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            for body in bodies:
+                r = await client.post("/completion", json=body)
+                assert r.status == 200
+            # mid-stream cancellation: read two SSE events then drop the
+            # connection — the engine notices at the next wave boundary
+            r = await client.post("/completion", json=dict(
+                bodies[0], n_predict=40, stream=True))
+            assert r.status == 200
+            n = 0
+            async for _ in r.content:
+                n += 1
+                if n >= 2:
+                    break
+            r.close()
+            await asyncio.sleep(0.3)  # let the cancel land at a boundary
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+    # speculation actually happened on this repetitive traffic
+    assert reg.get_sample_value(
+        "tpustack_llm_spec_drafted_tokens_total") > 0
+    # every non-cache block returned: used == evictable (cache-held only)
+    resident = rt.cache.evictable_blocks()
+    assert rt.pool.n_used == resident
+    rt.cache.evict(100)
+    assert rt.pool.n_free == free0
+
+
 def test_bench_paged_tiny_smoke_cli():
     """Shell ``tools/bench_llm.py --paged --tiny`` — the CPU-runnable
     proof behind the acceptance bar: paged admitted concurrency at the

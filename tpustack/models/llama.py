@@ -155,6 +155,13 @@ class LlamaAttention(nn.Module):
             # one flush pass per chunk — write-back amortises by the chunk
             # length.  lax.scatter remains off the table (serialises on
             # TPU; 7x decode slowdown, measured).
+            #
+            # s > 1 is the SPECULATIVE VERIFY segment (llm_generate
+            # ._spec_verify_*): s draft+carry tokens land at buffer indices
+            # [t, t+s) in ONE weight pass, and query row j attends the same
+            # {main cache [0, cur0[i])} set plus buffer [0, t+j] — the
+            # in-segment causal generalisation of the single-token mask,
+            # which it collapses to exactly at s == 1.
             cur0, t = cache_index      # [B] slot frontiers, scalar chunk step
             quantized = "k_scale" in kv_cache
             cbuf_len = kv_cache["ck"].shape[1]
@@ -187,8 +194,15 @@ class LlamaAttention(nn.Module):
 
             main_mask = (jnp.arange(kv_cache["k"].shape[1])[None, None, :]
                          < cur0[:, None, None])          # [B, 1, S]
-            buf_mask = jnp.broadcast_to(
-                jnp.arange(cbuf_len)[None, None, :] <= t, (b, 1, cbuf_len))
+            if s == 1:
+                buf_mask = jnp.broadcast_to(
+                    jnp.arange(cbuf_len)[None, None, :] <= t,
+                    (b, 1, cbuf_len))
+            else:
+                # verify segment: per-query in-segment causal (see above)
+                buf_mask = jnp.broadcast_to(
+                    jnp.arange(cbuf_len)[None, None, :]
+                    <= (t + jnp.arange(s))[None, :, None], (b, s, cbuf_len))
             part_main = dot_product_attention_partial(
                 q, kv_cache["k"], kv_cache["v"], mask=main_mask,
                 k_scale=kv_cache.get("k_scale"),

@@ -129,6 +129,18 @@ class SlotRequest:
     provably landed, with the blocks covering the prompt's full blocks —
     the server's zero-copy cache-insert hook.  ``kv_extract``/
     ``on_prefill_kv`` are the DENSE hooks and are ignored under paging.
+
+    ``speculative``: per-request opt-out (body ``"speculative": false``) —
+    False means this row never drafts (it still rides batch-wide verify
+    dispatches as a plain one-token step).  Greedy outputs are identical
+    with speculation on, off, or opted out.  For SAMPLED rows the
+    speculation contract is distribution-level: rejection sampling keeps
+    the target distribution exactly, and a seeded request replays
+    identically under identical traffic, but the r5 "independent of batch
+    peers" point guarantee narrows to greedy rows — the per-slot key
+    chain advances per verify position, and whether a given token came
+    from a verify or a plain chunk depends on the whole batch's drafting
+    state.  Engines built with ``spec=None`` keep the full r5 guarantee.
     """
 
     ids: List[int]
@@ -144,12 +156,13 @@ class SlotRequest:
     span_ctx: Optional[object] = None
     kv_blocks: Optional[List[int]] = None
     on_prefill_blocks: Optional[Callable[[List[int]], None]] = None
+    speculative: bool = True
 
 
 class _Slot:
     __slots__ = ("req", "out", "budget", "gen_id", "t0", "prefill_s",
                  "dispatched", "done", "pending", "cached", "span",
-                 "blocks", "alloc")
+                 "blocks", "alloc", "spec_ema", "spec_idle", "stride_ema")
 
     def __init__(self):
         self.req: Optional[SlotRequest] = None
@@ -168,6 +181,15 @@ class _Slot:
         # reference on (shared prefix ids first, then fresh) — decref'd
         # exactly once at retire
         self.alloc = 0  # paged: tokens this slot's allocation covers
+        # speculation state (engines constructed with spec=SpecConfig):
+        # rolling acceptance-rate EMA (optimistic start — the first verify
+        # measures the real rate), waves since this slot last drafted (the
+        # probe counter once the EMA throttles it to zero), and the EMA of
+        # tokens this slot advances per wave — the stride the projected-
+        # block-release estimate uses instead of assuming one fixed chunk
+        self.spec_ema = 1.0
+        self.spec_idle = 0
+        self.stride_ema = 1.0
 
 
 class _PendingWave:
@@ -201,12 +223,42 @@ class ContinuousEngine:
     def __init__(self, gen: Generator, slots: int = 8, chunk: int = 32,
                  stop_tokens: Tuple[int, ...] = (), depth: int = 2,
                  on_progress: Optional[Callable[[str], None]] = None,
-                 tracer=None, paged=None):
+                 tracer=None, paged=None, spec=None, on_spec=None):
         self.gen = gen
         self.B = slots
         self.chunk = chunk
         self.stop_tokens = stop_tokens
         self.depth = depth
+        # speculative decoding (tpustack.serving.speculative.SpecConfig):
+        # when set, the wave loop turns variable-stride — each dispatch is
+        # either a verify step (host-drafted tokens scored in ONE forward
+        # pass; slots advance 1..tokens+1 each) or, when no slot has a
+        # usable draft, a plain pipelined chunk exactly like the spec-off
+        # engine.  None keeps the plain loop byte-for-byte (the
+        # TPUSTACK_SPEC_TOKENS=0 bisection contract).
+        self.spec = spec if (spec is not None
+                             and getattr(spec, "tokens", 0) > 0) else None
+        self._drafter = None
+        if self.spec is not None:
+            self._drafter = self.spec.drafter
+            if self._drafter is None:
+                from tpustack.serving.speculative import PromptLookupDrafter
+
+                self._drafter = PromptLookupDrafter(
+                    ngram_max=self.spec.ngram_max,
+                    ngram_min=self.spec.ngram_min)
+        # per-dispatch speculation hook (drafted, accepted) — the server's
+        # metrics wiring; runs on the engine thread
+        self.on_spec = on_spec
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_dispatches = 0
+        self._plain_steps = 0
+        # per-slot draft memo keyed on (gen_id, history length, k): the
+        # probe pass and the plan pass (and repeated probes while a chain
+        # drains) ask for the same history's draft — pay the drafter once
+        # (matters for DraftModelDrafter, whose proposal is a model run)
+        self._draft_memo: Dict[int, Tuple[Tuple[int, int, int], List[int]]] = {}
         # paged KV substrate (tpustack.serving.kv_pool.PagedKVRuntime):
         # slots hold BLOCK TABLES into one shared HBM pool instead of
         # private [max_seq] cache lines — admission capacity is free
@@ -236,7 +288,7 @@ class ContinuousEngine:
         self._to_park: List[int] = []  # retirements awaiting a fused park
         self._pending: List[_PendingWave] = []
         self._retired_tokens = 0
-        self._fetch_marks: List[Tuple[float, int]] = []
+        self._fetch_marks: List[Tuple[float, int, int]] = []
 
     # ------------------------------------------------------------ device state
     def _fresh_state(self):
@@ -308,31 +360,33 @@ class ContinuousEngine:
     def projected_block_release_s(self, need_blocks: int,
                                   fallback_rate: float = 50.0) -> float:
         """Capacity-true Retry-After estimate: walk the live slots in
-        finish order (remaining budget over the measured steady decode
-        rate) and report the wall seconds until cumulative released blocks
-        cover ``need_blocks``.  Tolerates racing the engine thread — this
-        is a hint, not a barrier."""
-        rate = fallback_rate
+        finish order and report the wall seconds until cumulative released
+        blocks cover ``need_blocks``.  Each slot's finish ETA is its
+        remaining budget over ITS OWN live rate — the measured wave rate
+        times the slot's tokens-per-wave stride EMA (the plain chunk when
+        not speculating; the acceptance-driven 1..k+1 stride under
+        speculation), so Retry-After neither assumes one token per wave
+        nor overestimates when speculation is landing multiple.  Tolerates
+        racing the engine thread — this is a hint, not a barrier."""
+        from tpustack.serving.kv_pool import eta_until_blocks
+
         marks = self._fetch_marks
+        wave_rate = None
         if len(marks) >= 2 and marks[-1][0] > marks[0][0]:
-            rate = max(1e-3, (marks[-1][1] - marks[0][1])
-                       / (marks[-1][0] - marks[0][0]))
+            wave_rate = max(1e-3, (marks[-1][2] - marks[0][2])
+                            / (marks[-1][0] - marks[0][0]))
         rel = []
         for s in list(self._slots_view or []):
             try:
                 if s.req is None:
                     continue
                 remaining = max(1, s.budget - len(s.out))
+                rate = (max(1e-3, s.stride_ema) * wave_rate
+                        if wave_rate is not None else fallback_rate)
                 rel.append((remaining / rate, len(s.blocks)))
             except Exception:
                 continue
-        rel.sort()
-        freed = 0
-        for eta, n in rel:
-            freed += n
-            if freed >= need_blocks:
-                return eta
-        return rel[-1][0] if rel else 1.0
+        return eta_until_blocks(rel, need_blocks)
 
     # ---------------------------------------------------------------- admission
     def _admit_dispatch(self, state, slots: List[_Slot],
@@ -352,6 +406,9 @@ class ContinuousEngine:
             s = slots[i]
             s.req, s.out, s.dispatched = req, [], 0
             s.blocks, s.alloc = [], 0
+            s.spec_ema, s.spec_idle = 1.0, 0
+            s.stride_ema = float(self.chunk)  # plain-wave stride until a
+            # verify step measures this occupant's real acceptance
             s.gen_id = gen_ctr = gen_ctr + 1
             s.t0, s.done, s.pending = t0, False, False
             s.prefill_s = 0.0  # else a zero-budget retire below reports the
@@ -791,10 +848,15 @@ class ContinuousEngine:
         self._to_park = []
         self._pending = []
         self._retired_tokens = 0  # per-run total, counted at _retire
-        # (wall time, tokens consumed so far) at each block fetch: the
-        # steady-state decode rate is the slope between the first and last
-        # marks — what the bench reports alongside end-to-end tokens/s
-        self._fetch_marks: List[Tuple[float, int]] = []
+        self._spec_drafted = self._spec_accepted = 0
+        self._spec_dispatches = self._plain_steps = 0
+        self._wave_ctr = 0
+        # (wall time, tokens consumed so far, waves fetched so far) at each
+        # block fetch: the steady-state decode rate is the slope between
+        # the first and last marks — what the bench reports alongside
+        # end-to-end tokens/s; the wave count feeds the per-slot
+        # stride-aware projected-block-release estimate
+        self._fetch_marks: List[Tuple[float, int, int]] = []
 
         def admit_free() -> None:
             nonlocal gen_ctr, admitted
@@ -817,7 +879,11 @@ class ContinuousEngine:
                     and 1 + s.dispatched < s.budget)
 
         try:
-            self._run_loop(state, slots, chain, admit_free, dispatch_ok)
+            if self.spec is not None:
+                self._run_loop_spec(state, slots, chain, admit_free,
+                                    dispatch_ok)
+            else:
+                self._run_loop(state, slots, chain, admit_free, dispatch_ok)
         except BaseException:
             # a failed run (injected device error, shutdown) must not leak
             # open spans — their trace would sit in the live table until
@@ -850,14 +916,100 @@ class ContinuousEngine:
                  "tokens_per_s": n_tok / dt if dt > 0 else 0.0}
         fetch_marks = self._fetch_marks
         if len(fetch_marks) >= 2:
-            (t0m, c0), (t1m, c1) = fetch_marks[0], fetch_marks[-1]
+            t0m, c0 = fetch_marks[0][0], fetch_marks[0][1]
+            t1m, c1 = fetch_marks[-1][0], fetch_marks[-1][1]
             if t1m > t0m:
                 stats["steady_tokens_per_s"] = (c1 - c0) / (t1m - t0m)
+        # weight passes: each plain chunk streams the weights `chunk`
+        # times; a verify step streams them ONCE for its K+1 positions —
+        # tokens/weight-pass (aggregate across slots) is the bandwidth-
+        # amortisation figure speculation exists to raise: plain decode is
+        # bounded by the live slot count, speculation by live × (k+1)
+        passes = self._plain_steps + self._spec_dispatches
+        decoded = max(0, n_tok - admitted)  # firsts come from prefill
+        stats.update({
+            "decode_weight_passes": passes,
+            "tokens_per_weight_pass": decoded / passes if passes else 0.0,
+        })
+        if self.spec is not None:
+            stats.update({
+                "spec_drafted_tokens": self._spec_drafted,
+                "spec_accepted_tokens": self._spec_accepted,
+                "spec_dispatches": self._spec_dispatches,
+                "spec_acceptance": (self._spec_accepted / self._spec_drafted
+                                    if self._spec_drafted else 0.0),
+            })
         return stats
 
-    def _run_loop(self, state, slots, chain, admit_free, dispatch_ok):
+    def _fill_chain(self, state, slots, chain, dispatch_ok):
+        """Keep up to ``depth`` plain decode chunks in flight (the
+        pipelined dispatch half of the wave loop, shared by the plain and
+        speculative run loops)."""
         g = self.gen
-        fetch_marks = self._fetch_marks
+        while len(chain) < self.depth and any(
+                dispatch_ok(s) for s in slots):
+            snapshot = [(i, s.gen_id, s.dispatched)
+                        for i, s in enumerate(slots) if dispatch_ok(s)]
+            if self.paged is not None:
+                (toks, last, state["cur"], state["pool"],
+                 state["keys"]) = g._decode_scan_paged(
+                    g.params, state["first"], state["cur"],
+                    state["active"], state["pool"],
+                    jnp.asarray(self._bt), state["keys"],
+                    state["temp"], state["topk"], state["greedy"],
+                    self.chunk)
+            else:
+                (toks, last, state["cur"], state["caches"],
+                 state["keys"]) = g._decode_scan_cont(
+                    g.params, state["first"], state["cur"],
+                    state["active"], state["caches"], state["keys"],
+                    state["temp"], state["topk"], state["greedy"],
+                    self.chunk)
+            state["first"] = last
+            self._plain_steps += self.chunk
+            for i, _, _ in snapshot:
+                slots[i].dispatched += self.chunk
+            chain.append((toks, snapshot))
+
+    def _consume_block(self, state, slots, block, snapshot):
+        """Host bookkeeping for one fetched plain chunk block (the consume
+        half of the wave loop, shared by both run loops)."""
+        if self._on_progress is not None:
+            self._on_progress("wave")
+        self._wave_ctr += 1
+        self._fetch_marks.append((
+            time.time(), self._retired_tokens + sum(
+                len(s.out) for s in slots if s.req is not None),
+            self._wave_ctr))
+        live = self._live(slots)
+        for i, gid, offset in snapshot:
+            s = slots[i]
+            if s.req is None or s.gen_id != gid or s.done:
+                continue  # lane is garbage for a retired/reassigned slot
+            if s.req.cancelled():
+                s.done = True
+                self._retire(state, slots, i, live)
+                continue
+            # chunks are consumed in dispatch order and never overlap:
+            # this block carries exactly decode steps [offset, offset+chunk)
+            assert len(s.out) - 1 == offset, (len(s.out), offset)
+            accepted = []
+            for t in (int(x) for x in block[i]):
+                s.out.append(t)
+                accepted.append(t)
+                if t in self.stop_tokens or len(s.out) >= s.budget:
+                    s.done = True
+                    break
+            s.spec_idle += 1  # plain wave: the slot did not draft
+            s.stride_ema = 0.75 * s.stride_ema + 0.25 * max(1, len(accepted))
+            if accepted and s.span is not None:
+                s.span.add_event("wave", tokens=len(accepted))
+            if accepted and s.req.on_tokens is not None:
+                s.req.on_tokens(accepted)
+            if s.done:
+                self._retire(state, slots, i, live)
+
+    def _run_loop(self, state, slots, chain, admit_free, dispatch_ok):
         while True:
             # parks MUST land before admissions: a freshly admitted slot's
             # state would otherwise be zeroed by its predecessor's park
@@ -868,29 +1020,7 @@ class ContinuousEngine:
             # deliver first tokens the moment the device has them (non-
             # blocking) — streaming clients see them before the next chunk
             self._resolve_pending(state, slots, only_ready=True)
-            while len(chain) < self.depth and any(
-                    dispatch_ok(s) for s in slots):
-                snapshot = [(i, s.gen_id, s.dispatched)
-                            for i, s in enumerate(slots) if dispatch_ok(s)]
-                if self.paged is not None:
-                    (toks, last, state["cur"], state["pool"],
-                     state["keys"]) = g._decode_scan_paged(
-                        g.params, state["first"], state["cur"],
-                        state["active"], state["pool"],
-                        jnp.asarray(self._bt), state["keys"],
-                        state["temp"], state["topk"], state["greedy"],
-                        self.chunk)
-                else:
-                    (toks, last, state["cur"], state["caches"],
-                     state["keys"]) = g._decode_scan_cont(
-                        g.params, state["first"], state["cur"],
-                        state["active"], state["caches"], state["keys"],
-                        state["temp"], state["topk"], state["greedy"],
-                        self.chunk)
-                state["first"] = last
-                for i, _, _ in snapshot:
-                    slots[i].dispatched += self.chunk
-                chain.append((toks, snapshot))
+            self._fill_chain(state, slots, chain, dispatch_ok)
             if not chain:
                 # every live row is pending-resolution, done-but-unparked,
                 # or out of budget: resolve (blocking — their retires need
@@ -912,33 +1042,214 @@ class ContinuousEngine:
                 # already-computed tokens are never stalled behind them
                 self._resolve_pending(state, slots,
                                       needed_slots=pending_here)
-            block = np.asarray(block)
-            if self._on_progress is not None:
-                self._on_progress("wave")
-            fetch_marks.append((time.time(), self._retired_tokens + sum(
-                len(s.out) for s in slots if s.req is not None)))
-            live = self._live(slots)
-            for i, gid, offset in snapshot:
-                s = slots[i]
-                if s.req is None or s.gen_id != gid or s.done:
-                    continue  # lane is garbage for a retired/reassigned slot
-                if s.req.cancelled():
+            self._consume_block(state, slots, np.asarray(block), snapshot)
+
+    # ------------------------------------------------- speculative decoding
+    def _slot_draft_budget(self, s: _Slot) -> int:
+        """How many tokens slot ``s`` may draft this wave: the configured
+        max, clamped to the row's remaining budget (a draft past budget
+        can never be delivered) and throttled by the rolling acceptance
+        EMA — a slot whose drafts keep getting rejected stops paying for
+        verify positions (plain decode is the floor), with a 1-token probe
+        every ``probe_every`` waves to notice traffic turning predictable
+        again."""
+        req = s.req
+        if req is None or not req.speculative:
+            return 0
+        cap = min(self.spec.tokens, s.budget - len(s.out) - 1)
+        if cap <= 0:
+            return 0
+        k = int(round(s.spec_ema * self.spec.tokens))
+        if k <= 0:
+            if s.spec_idle < self.spec.probe_every:
+                return 0
+            k = 1
+        return min(cap, k)
+
+    def _spec_plan(self, slots, dispatch_ok, probe_only: bool = False):
+        """Host drafting pass: propose up to ``_slot_draft_budget`` tokens
+        per dispatchable slot via the drafter (n-gram prompt lookup by
+        default), truncated at the first stop token (nothing after it can
+        land).  Returns ``[(slot, draft)]`` covering EVERY dispatchable
+        slot (zero-draft rows ride the verify as a plain step) when at
+        least one slot drafted, else None — the caller then runs a plain
+        pipelined chunk.  ``probe_only`` answers "would anyone draft?"
+        without building the plan (the chain-drain check)."""
+        plan = []
+        any_draft = False
+        for i, s in enumerate(slots):
+            if s.req is None or s.done or s.pending or not dispatch_ok(s):
+                continue
+            toks: List[int] = []
+            k_i = self._slot_draft_budget(s)
+            if k_i > 0:
+                key = (s.gen_id, len(s.out), k_i)
+                memo = self._draft_memo.get(i)
+                if memo is not None and memo[0] == key:
+                    toks = memo[1]
+                else:
+                    toks = self._drafter.draft(s.req.ids + s.out, k_i)[:k_i]
+                    for j, t in enumerate(toks):
+                        if t in self.stop_tokens:
+                            toks = toks[:j + 1]
+                            break
+                    self._draft_memo[i] = (key, toks)
+            if probe_only:
+                if toks:
+                    return True
+                continue
+            plan.append((i, toks))
+            any_draft = any_draft or bool(toks)
+        if probe_only:
+            return False
+        return plan if any_draft else None
+
+    def _spec_dispatch(self, state, slots, plan):
+        """One speculative verify wave: ship the host drafts, score K+1
+        positions per slot in ONE forward pass, fetch (tokens, accepted
+        counts), and deliver each row's accepted run + bonus token.  The
+        device wrote KV for ACCEPTED positions only (the verify programs
+        clip the flush/scatter at the accepted frontier), so a rejected
+        draft costs compute, never cache or pool state."""
+        g = self.gen
+        spec = self.spec
+        K = spec.tokens
+        # structural invariant (the spec loop plans only after a blocking
+        # resolve): a pending slot is device-active but host-unaccounted —
+        # a verify advancing it would desync its token stream
+        assert not any(s.pending for s in slots), "verify with pending slots"
+        draft = np.zeros((self.B, K), np.int32)
+        dlen = np.zeros((self.B,), np.int32)
+        rows = []
+        for i, toks in plan:
+            draft[i, :len(toks)] = toks
+            dlen[i] = len(toks)
+            rows.append((i, slots[i].gen_id))
+        if self.paged is not None:
+            (toks_dev, n_acc, last, state["cur"], state["pool"],
+             state["keys"]) = g._spec_verify_paged(
+                g.params, state["first"], jnp.asarray(draft),
+                jnp.asarray(dlen), state["cur"], state["active"],
+                state["pool"], jnp.asarray(self._bt), state["keys"],
+                state["temp"], state["topk"], state["greedy"], K)
+        else:
+            (toks_dev, n_acc, last, state["cur"], state["caches"],
+             state["keys"]) = g._spec_verify_cont(
+                g.params, state["first"], jnp.asarray(draft),
+                jnp.asarray(dlen), state["cur"], state["active"],
+                state["caches"], state["keys"], state["temp"],
+                state["topk"], state["greedy"], K)
+        state["first"] = last
+        self._spec_dispatches += 1
+        block = np.asarray(toks_dev)
+        accs = np.asarray(n_acc)
+        if self._on_progress is not None:
+            self._on_progress("wave")
+        self._wave_ctr += 1
+        self._fetch_marks.append((
+            time.time(), self._retired_tokens + sum(
+                len(s.out) for s in slots if s.req is not None),
+            self._wave_ctr))
+        alpha = spec.ema_alpha
+        live = self._live(slots)
+        for i, gid in rows:
+            s = slots[i]
+            if s.req is None or s.gen_id != gid or s.done:
+                continue
+            if s.req.cancelled():
+                s.done = True
+                self._retire(state, slots, i, live)
+                continue
+            k_i = int(dlen[i])
+            m = min(int(accs[i]), k_i)
+            if k_i > 0:
+                s.spec_ema = (1 - alpha) * s.spec_ema + alpha * (m / k_i)
+                s.spec_idle = 0
+                self._spec_drafted += k_i
+                self._spec_accepted += m
+                if s.span is not None:
+                    s.span.add_event("spec", drafted=k_i, accepted=m)
+                if self.on_spec is not None:
+                    try:
+                        self.on_spec(k_i, m)
+                    except Exception:
+                        log.exception("on_spec hook failed")
+            else:
+                s.spec_idle += 1
+            accepted = []
+            for t in (int(x) for x in block[i, :m + 1]):
+                s.out.append(t)
+                accepted.append(t)
+                if t in self.stop_tokens or len(s.out) >= s.budget:
                     s.done = True
-                    self._retire(state, slots, i, live)
+                    break
+            # keep the plain-chunk bookkeeping invariant (dispatched =
+            # tokens beyond the admission-sampled first) — the spec loop
+            # is fetch-synchronous, so dispatched == consumed
+            s.dispatched = len(s.out) - 1
+            s.stride_ema = (0.75 * s.stride_ema
+                            + 0.25 * max(1, len(accepted)))
+            if accepted and s.span is not None:
+                s.span.add_event("wave", tokens=len(accepted))
+            if accepted and s.req.on_tokens is not None:
+                s.req.on_tokens(accepted)
+            if s.done:
+                self._retire(state, slots, i, live)
+
+    def _run_loop_spec(self, state, slots, chain, admit_free, dispatch_ok):
+        """Variable-stride wave loop (``spec`` configured): whenever the
+        host is caught up with the device (no plain chunks in flight) and
+        any slot has a usable draft, dispatch ONE verify step — slots
+        advance 1..tokens+1 each — otherwise fall back to the plain
+        pipelined chunk loop.  The fallback stops refilling the chain the
+        moment fresh history would draft (checked per consumed wave), so
+        the pipeline drains and speculation resumes; a drafting slot is
+        therefore at most ``depth`` chunks away from speculating again,
+        and traffic that never drafts runs the plain loop at full depth —
+        degrade-to-plain, never below it."""
+        while True:
+            self._flush_park(state)
+            admit_free()
+            if self._live(slots) == 0:
+                break
+            self._resolve_pending(state, slots, only_ready=True)
+            plan = None
+            if not chain:
+                # host caught up: resolve everything (drafting needs each
+                # row's full accepted history), retire exhausted rows, and
+                # flush the parks — a verify must never advance a retired
+                # slot whose blocks were already released
+                self._resolve_pending(state, slots)
+                for i, s in enumerate(slots):
+                    if s.req is not None and (s.done or not dispatch_ok(s)):
+                        self._retire(state, slots, i, self._live(slots))
+                if self._live(slots) == 0:
                     continue
-                # chunks are consumed in dispatch order and never overlap:
-                # this block carries exactly decode steps [offset, offset+chunk)
-                assert len(s.out) - 1 == offset, (len(s.out), offset)
-                accepted = []
-                for t in (int(x) for x in block[i]):
-                    s.out.append(t)
-                    accepted.append(t)
-                    if t in self.stop_tokens or len(s.out) >= s.budget:
-                        s.done = True
-                        break
-                if accepted and s.span is not None:
-                    s.span.add_event("wave", tokens=len(accepted))
-                if accepted and s.req.on_tokens is not None:
-                    s.req.on_tokens(accepted)
-                if s.done:
-                    self._retire(state, slots, i, live)
+                self._flush_park(state)
+                # NOTE: no admission here — a freshly dispatched admission
+                # would be pending (unresolved firsts) and a verify must
+                # never advance a slot the host can't account for; the
+                # loop top admits and the blocking resolve above completes
+                # those before any verify dispatch
+                plan = self._spec_plan(slots, dispatch_ok)
+            if plan is not None:
+                self._spec_dispatch(state, slots, plan)
+                continue
+            # plain decode: refill the pipeline only while NO slot would
+            # draft on its current history; otherwise drain what's in
+            # flight so the next iteration can speculate
+            if not chain or not self._spec_plan(slots, dispatch_ok,
+                                                probe_only=True):
+                self._fill_chain(state, slots, chain, dispatch_ok)
+            if not chain:
+                self._resolve_pending(state, slots)
+                for i, s in enumerate(slots):
+                    if s.req is not None and (s.done or not dispatch_ok(s)):
+                        self._retire(state, slots, i, self._live(slots))
+                continue
+            block, snapshot = chain.popleft()
+            pending_here = {i for i, _, _ in snapshot if slots[i].pending}
+            if pending_here or self._pending:
+                self._resolve_pending(state, slots,
+                                      needed_slots=pending_here)
+            self._consume_block(state, slots, np.asarray(block), snapshot)

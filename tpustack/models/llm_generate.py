@@ -681,15 +681,22 @@ class Generator:
         amortises by the chunk length.  Overshoot steps past max_seq-1 are
         clipped out of the flush window entirely, so a retiring row's
         speculative garbage is never written to the cache at all."""
-        S = self.cfg.max_seq
-        B = first_tok.shape[0]
         cur0 = cur
         toks, last, cur_end, bufs, keys = self._decode_cont_body(
             params, first_tok, cur, active, caches, keys, temperature,
             top_k, greedy, n_steps)
+        caches = self._flush_chunk_bufs(caches, bufs, cur0, cur_end, n_steps)
+        return toks, last, cur_end, caches, keys
 
-        # flush: one linear pass per cache tensor — gather each row's chunk
-        # K/V at (position - cur0) and select it inside [cur0, cur_end)
+    def _flush_chunk_bufs(self, caches, bufs, cur0, cur_end, n_steps: int):
+        """Traced flush of chunk-local K/V buffers into per-row cache lines
+        at ``[cur0, cur_end)``: one linear pass per cache tensor — gather
+        each row's chunk K/V at (position - cur0) and select it inside the
+        window.  Shared by the plain decode scan and the speculative verify
+        (where ``cur_end`` stops at the accepted frontier, so rejected
+        draft K/V is never written at all)."""
+        S = self.cfg.max_seq
+        B = cur0.shape[0]
         ar = jnp.arange(S)[None, :]
         window = (ar >= cur0[:, None]) & (ar < cur_end[:, None])    # [B, S]
         idx = jnp.clip(ar - cur0[:, None], 0, n_steps - 1).astype(jnp.int32)
@@ -707,8 +714,7 @@ class Generator:
                                     g.astype(cache[mk].dtype), cache[mk])
             return out
 
-        caches = [flush(c, bf) for c, bf in zip(caches, bufs)]
-        return toks, last, cur_end, caches, keys
+        return [flush(c, bf) for c, bf in zip(caches, bufs)]
 
     # --------------------------------------------------------- paged KV pool
     #
@@ -831,6 +837,170 @@ class Generator:
             {"k": "ck", "v": "cv", "k_scale": "ck_scale",
              "v_scale": "cv_scale"}, positions, valid)
         return toks, last, cur_end, pool, keys
+
+    # --------------------------------------------------- speculative verify
+    #
+    # Device half of speculative decoding on the continuous engine
+    # (llm_continuous; Leviathan et al. 2023, prompt-lookup per Saxena
+    # 2023).  Decode is bandwidth-bound: every plain step streams the full
+    # weight + KV working set to emit ONE token per slot.  The verify step
+    # feeds each slot's last accepted token plus K host-proposed draft
+    # tokens through ONE forward pass (the chunk-mode attention generalised
+    # to an in-segment-causal multi-query block — see LlamaAttention),
+    # scores all K+1 positions, and accepts the longest draft prefix that
+    # agrees with what the model would have produced anyway:
+    #
+    # - greedy rows accept draft_j while it equals argmax(logits_j) — so
+    #   the emitted chain is bit-for-bit the plain greedy chain, just
+    #   discovered up to K+1 tokens per weight pass instead of one;
+    # - sampled rows rejection-sample (accept draft_j with probability
+    #   p_j(draft_j) under the row's temperature/top-k-filtered
+    #   distribution; on the first rejection the bonus token draws from
+    #   the residual with the draft token removed and renormalised), so
+    #   the output DISTRIBUTION is exactly the plain sampling path's —
+    #   the standard correctness argument for a deterministic proposal.
+    #
+    # Every row always emits n_acc + 1 tokens (the bonus comes free from
+    # the position after the last accepted draft), so a verify step is
+    # never slower than a plain decode step in tokens-per-weight-pass.
+    # KV for the accepted tokens only is flushed/scattered ([cur0,
+    # cur0 + n_acc + 1)); rejected draft K/V never lands in the cache or
+    # the pool, which keeps paged block accounting capacity-true.
+
+    def _spec_verify_parts(self, params, first_tok, draft, draft_len, cur,
+                           active, caches, keys, temperature, top_k, greedy,
+                           n_draft: int):
+        """Traced body of one verify step, shared by the dense and paged
+        programs.  ``first_tok [B,1]``: last accepted token (KV not yet
+        written); ``draft [B,K]`` host-proposed continuations with per-row
+        valid counts ``draft_len [B]`` (zero-draft rows run exactly one
+        plain decode step's worth of work inside the same dispatch).
+        Returns ``(toks [B,K+1], n_acc [B], last [B,1], cur_end [B], bufs,
+        keys)`` — the host takes ``toks[i, :n_acc[i]+1]``."""
+        from tpustack.models.llama import init_chunk_bufs
+
+        S_max = self.cfg.max_seq
+        V = self.cfg.vocab_size
+        K = n_draft
+        S = K + 1
+        B = first_tok.shape[0]
+        cur0 = cur
+        seg = jnp.concatenate([first_tok, draft], axis=1)        # [B, S]
+        bufs0 = init_chunk_bufs(self.cfg, B, S, dtype=self.cache_dtype)
+        merged = [dict(c, **bf) for c, bf in zip(caches, bufs0)]
+        offs = jnp.arange(S)[None, :] * active[:, None]
+        positions = jnp.minimum(cur0[:, None] + offs, S_max - 1)
+        logits, merged = self.model.apply(
+            {"params": params}, seg, positions, merged, (cur0, 0), None)
+        bufs = [{k: d[k] for k in bf} for d, bf in zip(merged, bufs0)]
+        logits = logits.astype(jnp.float32)                      # [B, S, V]
+
+        # PRNG discipline: K acceptance draws + 1 bonus draw per row per
+        # verify, advanced UNCONDITIONALLY (outside the all-greedy gate) so
+        # the key chain's state never depends on batch composition
+        step_keys = []
+        for _ in range(S):
+            sk, keys = _advance_keys(keys)
+            step_keys.append(sk)
+
+        gr = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(greedy)), (B,))
+        outs_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+        valid = jnp.arange(K)[None, :] < draft_len[:, None]          # [B, K]
+
+        def greedy_path(_):
+            acc = (outs_greedy[:, :K] == draft) & valid
+            n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1),
+                            axis=1)
+            bonus = jnp.take_along_axis(outs_greedy, n_acc[:, None],
+                                        axis=1)[:, 0]
+            return n_acc, bonus
+
+        def mixed_path(_):
+            # rejection sampling under the per-row filtered distribution:
+            # the same temperature/top-k filter plain decode samples from
+            rep = lambda x: jnp.repeat(jnp.broadcast_to(
+                jnp.atleast_1d(jnp.asarray(x)), (B,)), S)
+            scaled = self._topk_scaled(logits.reshape(B * S, V),
+                                       rep(temperature),
+                                       rep(top_k)).reshape(B, S, V)
+            probs = jax.nn.softmax(scaled, axis=-1)              # [B, S, V]
+            p_draft = jnp.take_along_axis(probs[:, :K], draft[..., None],
+                                          axis=-1)[..., 0]       # [B, K]
+            u = jnp.stack([jax.vmap(
+                lambda k: jax.random.uniform(k))(step_keys[j])
+                for j in range(K)], axis=1)                      # [B, K]
+            acc_s = (u < p_draft) & valid
+            acc = jnp.where(gr[:, None], (outs_greedy[:, :K] == draft)
+                            & valid, acc_s)
+            n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1),
+                            axis=1)
+            # bonus at position n_acc: residual (draft token removed,
+            # renormalised) after a true rejection; the FULL distribution
+            # when the row simply ran out of accepted drafts
+            pj = jnp.take_along_axis(probs, n_acc[:, None, None],
+                                     axis=1)[:, 0]               # [B, V]
+            draft_pad = jnp.pad(draft, ((0, 0), (0, 1)))         # [B, S]
+            rejected_tok = jnp.take_along_axis(draft_pad, n_acc[:, None],
+                                               axis=1)[:, 0]
+            ran_out = n_acc >= draft_len
+            residual = jnp.where(
+                (jnp.arange(V)[None, :] == rejected_tok[:, None])
+                & ~ran_out[:, None], 0.0, pj)
+            bonus_s = jax.vmap(jax.random.categorical)(
+                step_keys[K], jnp.log(jnp.maximum(residual, 1e-38)))
+            bonus_g = jnp.take_along_axis(outs_greedy, n_acc[:, None],
+                                          axis=1)[:, 0]
+            return n_acc, jnp.where(gr, bonus_g,
+                                    bonus_s).astype(jnp.int32)
+
+        # all-greedy runtime gate, like _greedy_gated: the common serving
+        # mix (and every parked slot) skips the softmax/draw machinery
+        n_acc, bonus = jax.lax.cond(jnp.all(gr), greedy_path, mixed_path,
+                                    None)
+        ar = jnp.arange(S)[None, :]
+        draft_pad = jnp.pad(draft, ((0, 0), (0, 1)))
+        toks = jnp.where(ar < n_acc[:, None], draft_pad,
+                         jnp.where(ar == n_acc[:, None], bonus[:, None],
+                                   0)).astype(jnp.int32)
+        cur_end = jnp.minimum(cur0 + (n_acc + 1) * active, S_max - 1)
+        return toks, n_acc, bonus[:, None], cur_end, bufs, keys
+
+    @functools.partial(jax.jit, static_argnums=(0, 12), donate_argnums=(7,))
+    def _spec_verify_cont(self, params, first_tok, draft, draft_len, cur,
+                          active, caches, keys, temperature, top_k, greedy,
+                          n_draft: int):
+        """Dense speculative verify: one K+1-position forward pass over the
+        frozen slot caches, then the shared chunk flush clipped at each
+        row's ACCEPTED frontier — rejected draft K/V is never written."""
+        cur0 = cur
+        toks, n_acc, last, cur_end, bufs, keys = self._spec_verify_parts(
+            params, first_tok, draft, draft_len, cur, active, caches, keys,
+            temperature, top_k, greedy, n_draft)
+        caches = self._flush_chunk_bufs(caches, bufs, cur0, cur_end,
+                                        n_draft + 1)
+        return toks, n_acc, last, cur_end, caches, keys
+
+    @functools.partial(jax.jit, static_argnums=(0, 13), donate_argnums=(7,))
+    def _spec_verify_paged(self, params, first_tok, draft, draft_len, cur,
+                           active, pool, bt, keys, temperature, top_k,
+                           greedy, n_draft: int):
+        """Paged twin of ``_spec_verify_cont``: gather the frozen view from
+        the block pool, run the IDENTICAL verify body, scatter ONLY the
+        accepted positions back through the block tables — so shared
+        prefix blocks are read but never rewritten, and block accounting
+        stays capacity-true (no rejected-draft KV ever lands)."""
+        toks, n_acc, last, cur_end, bufs, keys = self._spec_verify_parts(
+            params, first_tok, draft, draft_len, cur, active,
+            self._pool_gather_body(pool, bt), keys, temperature, top_k,
+            greedy, n_draft)
+        S = n_draft + 1
+        positions = cur[:, None] + jnp.arange(S)[None, :]
+        valid = positions < cur_end[:, None]
+        pool = self._pool_scatter_body(
+            pool, bt, bufs,
+            {"k": "ck", "v": "cv", "k_scale": "ck_scale",
+             "v_scale": "cv_scale"}, positions, valid)
+        return toks, n_acc, last, cur_end, pool, keys
 
     @functools.partial(jax.jit, static_argnums=(0,),
                        donate_argnums=(3, 9, 10, 11, 12, 13, 14, 15))

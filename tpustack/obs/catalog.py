@@ -114,6 +114,28 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "(block-size rounding): 0 = tight fit, rises with larger "
                "TPUSTACK_KV_BLOCK against short requests.", unit="ratio"),
 
+    # ---- LLM speculative decoding (prompt-lookup / draft-model verify) ----
+    MetricSpec("tpustack_llm_spec_drafted_tokens_total", "counter",
+               "Draft tokens proposed to verify steps (prompt-lookup "
+               "n-gram or draft-model).  Zero with TPUSTACK_SPEC_TOKENS=0 "
+               "or when the acceptance throttle has every slot on plain "
+               "decode.", unit="total"),
+    MetricSpec("tpustack_llm_spec_accepted_tokens_total", "counter",
+               "Draft tokens the verify step accepted (agreed with what "
+               "the model would have produced).  Each accepted token is "
+               "one decode weight-pass the engine did NOT pay for.",
+               unit="total"),
+    MetricSpec("tpustack_llm_spec_acceptance_ratio", "gauge",
+               "Running accepted/drafted ratio since process start — the "
+               "traffic-predictability signal the per-slot EMA throttle "
+               "acts on (low ratio = drafting is wasted verify "
+               "positions).", unit="ratio"),
+    MetricSpec("tpustack_llm_spec_accepted_length_tokens", "histogram",
+               "Accepted draft length per verify dispatch per slot (the "
+               "slot advanced this + 1 tokens in one weight pass; 0 = "
+               "the verify degenerated to a plain decode step).",
+               buckets=(0, 1, 2, 3, 4, 6, 8, 16), unit="tokens"),
+
     # ---- SD server (signature-keyed micro-batcher) ----
     MetricSpec("tpustack_sd_queue_depth", "gauge",
                "Generate requests waiting in micro-batch groups.",

@@ -52,6 +52,26 @@ class OutOfBlocks(RuntimeError):
     """Allocation failed: the pool has fewer free blocks than requested."""
 
 
+def eta_until_blocks(releases, need_blocks: int) -> float:
+    """Wall-clock seconds until ``need_blocks`` pool blocks are projected
+    to free: walk ``releases`` — one ``(eta_seconds, blocks_held)`` pair
+    per in-flight request, each ETA computed by the caller from the
+    request's remaining budget over its LIVE token rate (the engine feeds
+    the measured wave rate times the slot's tokens-per-wave stride EMA, so
+    a slot speculation is advancing k+1 tokens per dispatch projects k+1
+    times sooner than a one-token-per-wave assumption would) — in finish
+    order and report when the cumulative release covers the need.  Pure
+    math, separated from the engine for testability; 1.0 s when nothing is
+    in flight (the caller has no basis for an estimate)."""
+    rel = sorted(releases)
+    freed = 0
+    for eta, n in rel:
+        freed += n
+        if freed >= need_blocks:
+            return eta
+    return rel[-1][0] if rel else 1.0
+
+
 class KVBlockPool:
     """Fixed-size block allocator with per-block refcounts.
 
@@ -427,7 +447,12 @@ class PagedKVRuntime:
     def need_tokens(self, n_prompt: int, max_new: int) -> int:
         """Tokens a request reserves: prompt + its REAL budget (clamped to
         the context window) — the engine's own budget formula, so admission
-        and allocation can never disagree."""
+        and allocation can never disagree.  Multi-token strides
+        (speculative verify steps advancing 1..k+1 tokens per dispatch)
+        never change this bound: the engine clamps draft length to the
+        remaining budget and the verify programs clip their KV scatter at
+        the accepted frontier, so no dispatch can write past
+        ``prompt + budget`` however many tokens it lands at once."""
         return n_prompt + max(0, min(max_new, self.max_seq - n_prompt))
 
     def need_blocks(self, n_prompt: int, max_new: int) -> int:

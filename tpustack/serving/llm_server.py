@@ -46,6 +46,22 @@ the dense per-slot engine for bisection;
 (default ``LLM_MAX_BATCH x ctx / block`` — dense HBM parity; raise it
 and ``LLM_MAX_BATCH`` together to serve more concurrent requests from
 the same HBM when typical contexts run short of ctx)),
+``TPUSTACK_SPEC_TOKENS`` (speculative decoding on the continuous engine,
+ON by default at 4 draft tokens per verify step: a host-side n-gram
+prompt-lookup drafter proposes continuations out of each request's own
+prompt+generated history and ONE forward pass scores draft+1 positions,
+accepting the longest prefix that agrees with what the model would have
+produced — greedy outputs are byte-identical speculation on or off, and
+sampled outputs keep the target distribution via rejection sampling.
+``0`` disables (bisection flag: the plain wave loop is byte-for-byte the
+spec-free engine); per-slot draft length auto-throttles on a rolling
+acceptance EMA so unpredictable traffic degrades to plain decode, never
+below it; per-request opt-out via body ``"speculative": false``;
+``TPUSTACK_SPEC_NGRAM`` caps the lookup n-gram length (default 3);
+``TPUSTACK_SPEC_DRAFT=<preset>`` swaps the drafter for a greedy draft
+MODEL of that preset (``tiny``|``llama2_7b``|``qwen25_7b``; weights from
+``TPUSTACK_SPEC_DRAFT_DIR`` or random — rehearsal-grade), reusing the
+same verify program),
 ``TPUSTACK_PREFIX_CACHE`` (cross-request prefix KV cache — radix reuse of
 finished prefill KV so chat requests sharing a system prompt skip its
 prefill entirely; on by default, ``0`` disables.  Under paged KV the
@@ -191,11 +207,11 @@ class _PendingCompletion:
     __slots__ = ("ids", "n_predict", "sample", "future", "cancel",
                  "stream_put", "seed", "prefix", "kv_extract", "on_prefill_kv",
                  "phase", "span_ctx", "queue_span", "kv_blocks",
-                 "on_prefill_blocks")
+                 "on_prefill_blocks", "speculative")
 
     def __init__(self, ids, n_predict, sample, future, stream_put=None,
                  seed=None, prefix=None, kv_extract=None, on_prefill_kv=None,
-                 kv_blocks=None, on_prefill_blocks=None):
+                 kv_blocks=None, on_prefill_blocks=None, speculative=True):
         self.ids = ids
         self.n_predict = n_predict
         self.sample = sample
@@ -220,6 +236,8 @@ class _PendingCompletion:
         # ownership to the engine.
         self.kv_blocks = kv_blocks
         self.on_prefill_blocks = on_prefill_blocks
+        # per-request speculation opt-out (body `"speculative": false`)
+        self.speculative = speculative
         # distributed tracing: the request's HTTP root-span context (engine
         # threads parent their prefill/wave spans under it) and the
         # queue_wait span, open from enqueue until feed() hands the request
@@ -259,12 +277,14 @@ class LLMServer:
     _PREFIX_FROM_ENV = object()
     #: sentinel: "build the paged KV runtime from the environment"
     _PAGED_FROM_ENV = object()
+    #: sentinel: "build the speculative-decoding config from the environment"
+    _SPEC_FROM_ENV = object()
 
     def __init__(self, generator=None, tokenizer=None, model_name: str = "tpustack",
                  max_batch: Optional[int] = None,
                  batch_window_ms: Optional[float] = None,
                  registry=None, prefix_cache=_PREFIX_FROM_ENV, tracer=None,
-                 paged=_PAGED_FROM_ENV):
+                 paged=_PAGED_FROM_ENV, spec=_SPEC_FROM_ENV):
         # metrics registry: tests pass a fresh Registry for isolation; the
         # default is the process-wide one /metrics exposes
         self._registry = registry
@@ -317,6 +337,17 @@ class LLMServer:
             self.paged.cache.on_evict = (
                 lambda n: self.metrics[
                     "tpustack_llm_prefix_cache_evictions_total"].inc(n))
+        # speculative decoding (tpustack.serving.speculative.SpecConfig):
+        # tests pass a SpecConfig (or None for hard off); serving builds
+        # from TPUSTACK_SPEC_TOKENS & friends, default ON — the engine's
+        # verify step keeps greedy outputs byte-identical, so this is a
+        # perf knob, not a behavior change.  Engine-only: LLM_MAX_BATCH=1
+        # solo deployments decode plain.
+        if spec is LLMServer._SPEC_FROM_ENV:
+            spec = self._build_spec(self.gen)
+        self.spec_cfg = spec
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         # live engine during a busy period — the projected-block-release
         # estimate behind 429 Retry-After reads it opportunistically
         self._engine = None
@@ -402,6 +433,68 @@ class LLMServer:
                  "dense parity), prefix cache %s", n_blocks, block, max_seq,
                  max_batch, "on" if cache is not None else "off")
         return PagedKVRuntime(arrays, pool, max_seq, cache)
+
+    @staticmethod
+    def _build_spec(gen):
+        """Speculative-decoding config from the environment (default ON:
+        4-token prompt-lookup drafting).  ``TPUSTACK_SPEC_TOKENS=0`` is
+        the bisection flag — the engine's wave loop is then byte-for-byte
+        the spec-free one.  ``TPUSTACK_SPEC_DRAFT=<preset>`` builds a
+        draft-model drafter (weights from ``TPUSTACK_SPEC_DRAFT_DIR``, or
+        random — the verify step owns correctness either way)."""
+        from tpustack.serving.speculative import SpecConfig
+
+        k = int(os.environ.get("TPUSTACK_SPEC_TOKENS", "4") or 0)
+        if k <= 0:
+            return None
+        ngram = max(1, int(os.environ.get("TPUSTACK_SPEC_NGRAM", "3") or 3))
+        drafter = None
+        preset = (os.environ.get("TPUSTACK_SPEC_DRAFT", "") or "").strip()
+        if preset:
+            drafter = LLMServer._build_draft_drafter(gen, preset)
+        return SpecConfig(tokens=k, ngram_max=ngram, drafter=drafter)
+
+    @staticmethod
+    def _build_draft_drafter(gen, preset: str):
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        from tpustack.models.llama import LlamaConfig
+        from tpustack.models.llm_generate import Generator
+        from tpustack.serving.speculative import DraftModelDrafter
+
+        presets = ("tiny", "llama2_7b", "qwen25_7b")
+        if preset not in presets:
+            raise ValueError(f"TPUSTACK_SPEC_DRAFT={preset!r}: unknown "
+                             f"preset (want one of {presets})")
+        cfg = (LlamaConfig.tiny(max_seq=gen.cfg.max_seq)
+               if preset == "tiny" else _dc.replace(
+                   getattr(LlamaConfig, preset)(), max_seq=gen.cfg.max_seq))
+        dtype = jnp.float32 if preset == "tiny" else jnp.bfloat16
+        model_dir = os.environ.get("TPUSTACK_SPEC_DRAFT_DIR", "")
+        if model_dir:
+            draft_gen = Generator.from_checkpoint(cfg, model_dir,
+                                                  dtype=dtype)
+        else:
+            draft_gen = Generator(cfg, dtype=dtype)
+        log.info("speculative draft model: %s (%s)", preset,
+                 model_dir or "random weights")
+        return DraftModelDrafter(draft_gen)
+
+    def _note_spec(self, drafted: int, accepted: int) -> None:
+        """Per-verify-dispatch speculation accounting (engine thread):
+        counters, the per-dispatch accepted-length histogram, and the
+        running acceptance-ratio gauge."""
+        self._spec_drafted += drafted
+        self._spec_accepted += accepted
+        m = self.metrics
+        m["tpustack_llm_spec_drafted_tokens_total"].inc(drafted)
+        m["tpustack_llm_spec_accepted_tokens_total"].inc(accepted)
+        m["tpustack_llm_spec_accepted_length_tokens"].observe(accepted)
+        m["tpustack_llm_spec_acceptance_ratio"].set(
+            self._spec_accepted / self._spec_drafted
+            if self._spec_drafted else 0.0)
 
     # ---------------------------------------------------- paged admission
     def _paged_gauges(self) -> None:
@@ -633,10 +726,12 @@ class LLMServer:
         return {"prefix": p, "kv_extract": e, "on_prefill_kv": cb}
 
     async def _enqueue_completion(self, ids, n_predict, sample, seed=None,
-                                  hooks=None, deadline_s=None):
+                                  hooks=None, deadline_s=None,
+                                  speculative=True):
         loop = asyncio.get_running_loop()
         req = _PendingCompletion(ids, n_predict, sample, loop.create_future(),
-                                 seed=seed, **(hooks or {}))
+                                 seed=seed, speculative=speculative,
+                                 **(hooks or {}))
         await self._enqueue_raw(req)
         try:
             return await asyncio.wait_for(req.future, deadline_s)
@@ -687,7 +782,8 @@ class LLMServer:
                            prefix=r.prefix, kv_extract=r.kv_extract,
                            on_prefill_kv=r.on_prefill_kv,
                            span_ctx=r.span_ctx, kv_blocks=r.kv_blocks,
-                           on_prefill_blocks=r.on_prefill_blocks)
+                           on_prefill_blocks=r.on_prefill_blocks,
+                           speculative=r.speculative)
 
     async def _batch_loop(self):
         """Run the continuous engine whenever requests are queued: the
@@ -711,7 +807,8 @@ class LLMServer:
                     chunk=self.engine_chunk,
                     stop_tokens=(self.tok.eos_id,),
                     on_progress=self.resilience.progress,
-                    tracer=self.tracer, paged=self.paged)
+                    tracer=self.tracer, paged=self.paged,
+                    spec=self.spec_cfg, on_spec=self._note_spec)
                 self._engine = engine
 
                 def feed():
@@ -784,7 +881,8 @@ class LLMServer:
 
     async def _complete_routed(self, prompt: str, n_predict: int,
                                temperature: float, top_k: int, seed,
-                               cache_prompt: bool = True, deadline_s=None):
+                               cache_prompt: bool = True, deadline_s=None,
+                               speculative: bool = True):
         """(content, stats, stopped_eos) via the micro-batcher when eligible,
         else the solo device path.  Raises ValueError for bad requests and
         DeadlineExceeded past ``deadline_s``."""
@@ -824,10 +922,9 @@ class LLMServer:
             return content, stats, stopped_eos
         sample = SampleConfig(temperature=temperature, top_k=top_k,
                               greedy=temperature <= 0)
-        out_ids, stats = await self._enqueue_completion(ids, n_predict, sample,
-                                                        seed=seed,
-                                                        hooks=hooks,
-                                                        deadline_s=deadline_s)
+        out_ids, stats = await self._enqueue_completion(
+            ids, n_predict, sample, seed=seed, hooks=hooks,
+            deadline_s=deadline_s, speculative=speculative)
         if out_ids and out_ids[-1] == self.tok.eos_id:
             out_ids = out_ids[:-1]
             stopped_eos = True
@@ -938,7 +1035,8 @@ class LLMServer:
 
     async def _stream(self, request: web.Request, prompt: str, n_predict: int,
                       temperature: float, top_k: int, seed, fmt: str,
-                      cache_prompt: bool = True, deadline_s=None):
+                      cache_prompt: bool = True, deadline_s=None,
+                      speculative: bool = True):
         """SSE streaming shared by /completion (llama.cpp chunk shape) and
         /v1/chat/completions (OpenAI ``chat.completion.chunk`` + ``[DONE]``).
 
@@ -988,7 +1086,7 @@ class LLMServer:
                              greedy=temperature <= 0),
                 loop.create_future(),
                 stream_put=lambda t: loop.call_soon_threadsafe(q.put_nowait, t),
-                seed=seed, **hooks)
+                seed=seed, speculative=speculative, **hooks)
             cancel = req.cancel
 
         resp = web.StreamResponse(headers={
@@ -1235,6 +1333,18 @@ class LLMServer:
                                        else {"enabled": False})
         else:
             payload["paged_kv"] = {"enabled": False, "dense_fallback": True}
+        sc = self.spec_cfg
+        enabled = sc is not None and self._batchable()
+        payload["speculative"] = {
+            "enabled": enabled,
+            "tokens": sc.tokens if enabled else 0,
+            "drafter": ((type(sc.drafter).__name__ if sc.drafter is not None
+                         else "prompt_lookup") if enabled else None),
+            "drafted_tokens": self._spec_drafted,
+            "accepted_tokens": self._spec_accepted,
+            "acceptance_ratio": (self._spec_accepted / self._spec_drafted
+                                 if self._spec_drafted else 0.0),
+        }
         return web.json_response(payload)
 
     def _reject(self, reason: str) -> None:
@@ -1266,17 +1376,22 @@ class LLMServer:
         # cache (when server-enabled); explicit false → this request neither
         # reuses nor populates it
         cache_prompt = bool(_or_default(body.get("cache_prompt"), True))
+        # per-request speculation opt-out (greedy outputs identical either
+        # way; a debugging/bisection knob, mirroring cache_prompt)
+        speculative = bool(_or_default(body.get("speculative"), True))
         if body.get("stream"):
             return await self._stream(request, prompt, n_predict, temperature,
                                       top_k, seed, fmt="llamacpp",
                                       cache_prompt=cache_prompt,
-                                      deadline_s=deadline_s)
+                                      deadline_s=deadline_s,
+                                      speculative=speculative)
 
         t0 = time.time()
         try:
             content, stats, stopped_eos = await self._complete_routed(
                 prompt, n_predict, temperature, top_k, seed,
-                cache_prompt=cache_prompt, deadline_s=deadline_s)
+                cache_prompt=cache_prompt, deadline_s=deadline_s,
+                speculative=speculative)
         except ValueError as e:  # e.g. prompt longer than the context window
             return web.json_response({"error": str(e)}, status=400)
         except OutOfKVBlocks as e:
@@ -1323,16 +1438,19 @@ class LLMServer:
             return web.json_response(
                 {"error": {"message": f"invalid parameter: {e}"}}, status=400)
         cache_prompt = bool(_or_default(body.get("cache_prompt"), True))
+        speculative = bool(_or_default(body.get("speculative"), True))
         if body.get("stream"):
             return await self._stream(request, prompt, n_predict, temperature,
                                       40, seed,
                                       fmt="openai", cache_prompt=cache_prompt,
-                                      deadline_s=deadline_s)
+                                      deadline_s=deadline_s,
+                                      speculative=speculative)
 
         try:
             content, stats, stopped_eos = await self._complete_routed(
                 prompt, n_predict, temperature, 40, seed,
-                cache_prompt=cache_prompt, deadline_s=deadline_s)
+                cache_prompt=cache_prompt, deadline_s=deadline_s,
+                speculative=speculative)
         except ValueError as e:
             return web.json_response({"error": {"message": str(e)}}, status=400)
         except OutOfKVBlocks as e:
