@@ -1,148 +1,23 @@
 #!/usr/bin/env python
-"""Metric-name lint: keep the ``tpustack_*`` namespace coherent as it grows.
+"""Metric-name lint — thin CLI shim over the tpulint checker.
 
-Checks the catalog (``tpustack.obs.catalog.CATALOG``) — the single place
-metrics are declared — against the naming contract:
-
-- every name matches ``tpustack_<snake_case>`` (lowercase, digits, single
-  underscores; no camelCase, no double underscores, no trailing underscore);
-- counters end in ``_total`` (Prometheus convention);
-- every non-counter name ends in an approved unit token (``_seconds``,
-  ``_bytes``, ... or a count unit like ``_depth``/``_slots``/``_tokens``),
-  and the declared ``unit`` field matches that suffix;
-- label names are snake_case and never repeat a reserved name (``le``,
-  ``quantile``, anything ``__``-prefixed);
-- histogram buckets are strictly ascending and finite;
-- help strings exist; names are unique;
-- the catalog and the ``docs/OBSERVABILITY.md`` metric table agree BOTH
-  ways: every declared metric has a documented row, and every documented
-  row names a declared metric — a metric shipped without operator docs
-  (or a doc row for a deleted metric) fails CI.
-
-Runs standalone (``python tools/lint_metrics.py``, exit 1 on violations)
-and inside the tier-1 suite (``tests/test_obs.py`` imports ``lint()``), so
-a nonconforming metric fails CI before it ships.
+The implementation moved to ``tools/tpulint/checker_metrics.py`` (rule
+TPL501 under ``python -m tools.tpulint``); this entrypoint keeps the
+historical CLI and import surface: ``python tools/lint_metrics.py`` exits
+1 on violations, and ``import lint_metrics; lint_metrics.lint()`` returns
+the violation strings — both unchanged since PR 2.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_NAME_RE = re.compile(r"^tpustack(_[a-z0-9]+)+$")
-_LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-
-#: approved trailing unit tokens.  Base units (Prometheus guidance) plus the
-#: count-style units this stack legitimately exports; extend deliberately —
-#: DON'T invent per-metric spellings of the same unit (e.g. "secs", "msec").
-UNIT_SUFFIXES = (
-    "seconds", "bytes", "ratio", "celsius", "info",
-    # count units (dimensionless gauges/histograms say what they count)
-    "depth", "slots", "tokens", "images", "requests", "entries", "prompts",
-    # paged-KV pool accounting (fixed-size KV blocks, kv_pool.py)
-    "blocks",
-    # enum gauges (value is a documented small-integer state machine)
-    "state",
-    # index gauges (value identifies a position, e.g. the last-saved
-    # training step — a resumed run continues FROM this number)
-    "step",
-)
-_RESERVED_LABELS = {"le", "quantile"}
-
-#: the operator-facing metric table this lint keeps in lock-step with the
-#: catalog
-DOC_PATH = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
-
-#: a doc table row: | `tpustack_...` | type | ...
-_DOC_ROW_RE = re.compile(r"^\|\s*`(tpustack_[a-z0-9_]+)`\s*\|")
-
-
-def documented_metrics(doc_path: str = DOC_PATH) -> List[str]:
-    """Metric names from the OBSERVABILITY.md table (first backticked
-    ``tpustack_*`` cell of each table row)."""
-    names: List[str] = []
-    with open(doc_path) as f:
-        for line in f:
-            m = _DOC_ROW_RE.match(line.strip())
-            if m:
-                names.append(m.group(1))
-    return names
-
-
-def lint_docs(doc_path: str = DOC_PATH) -> List[str]:
-    """Catalog ↔ doc-table cross-check, both directions."""
-    from tpustack.obs.catalog import CATALOG
-
-    errors: List[str] = []
-    try:
-        documented = set(documented_metrics(doc_path))
-    except OSError as e:
-        return [f"cannot read {doc_path}: {e}"]
-    declared = {spec.name for spec in CATALOG}
-    for name in sorted(declared - documented):
-        errors.append(f"{name}: declared in the catalog but missing from "
-                      f"the {os.path.basename(doc_path)} metric table")
-    for name in sorted(documented - declared):
-        errors.append(f"{name}: documented in {os.path.basename(doc_path)} "
-                      "but not declared in the catalog")
-    return errors
-
-
-def lint() -> List[str]:
-    """Return a list of violation strings (empty = clean)."""
-    from tpustack.obs.catalog import CATALOG
-
-    errors: List[str] = lint_docs()
-    seen = set()
-    for spec in CATALOG:
-        where = f"{spec.name}:"
-        if spec.name in seen:
-            errors.append(f"{where} duplicate metric name")
-        seen.add(spec.name)
-        if not _NAME_RE.match(spec.name):
-            errors.append(f"{where} not tpustack_* snake_case")
-        if spec.type not in ("counter", "gauge", "histogram"):
-            errors.append(f"{where} unknown type {spec.type!r}")
-        if not spec.help.strip():
-            errors.append(f"{where} empty help string")
-
-        if spec.type == "counter":
-            if not spec.name.endswith("_total"):
-                errors.append(f"{where} counters must end in _total")
-            if spec.unit != "total":
-                errors.append(f"{where} counter unit field must be 'total'")
-        else:
-            suffix = spec.name.rsplit("_", 1)[-1]
-            if suffix not in UNIT_SUFFIXES:
-                errors.append(
-                    f"{where} must end in a unit suffix {UNIT_SUFFIXES}, "
-                    f"got _{suffix}")
-            elif spec.unit != suffix:
-                errors.append(
-                    f"{where} declared unit {spec.unit!r} != name suffix "
-                    f"{suffix!r}")
-
-        for label in spec.labels:
-            if not _LABEL_RE.match(label) or label.startswith("__"):
-                errors.append(f"{where} bad label name {label!r}")
-            if label in _RESERVED_LABELS:
-                errors.append(f"{where} label {label!r} is reserved")
-
-        if spec.type == "histogram" and spec.buckets is not None:
-            b = list(spec.buckets)
-            if b != sorted(b) or len(set(b)) != len(b):
-                errors.append(f"{where} buckets not strictly ascending: {b}")
-            if any(x != x or x in (float("inf"), float("-inf")) for x in b):
-                errors.append(f"{where} buckets must be finite "
-                              "(+Inf is implicit)")
-        if spec.type != "histogram" and spec.buckets is not None:
-            errors.append(f"{where} buckets on a non-histogram")
-    return errors
+from tools.tpulint.checker_metrics import (DOC_PATH,  # noqa: F401,E402
+                                           UNIT_SUFFIXES, documented_metrics,
+                                           lint, lint_docs)
 
 
 def main() -> int:

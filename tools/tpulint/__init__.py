@@ -1,0 +1,32 @@
+"""tpulint — AST-based static analysis tuned to this codebase.
+
+``python -m tools.tpulint`` runs the whole suite (AST rules + the metric
+and manifest checkers + the knob-registry cross-check) and exits nonzero
+on findings — the CI/tier-1 entrypoint.  See ``docs/LINTING.md`` for the
+rule catalog, the ``guarded-by`` annotation convention, suppression
+syntax, and how to add a rule.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# the package is imported both as ``tools.tpulint`` (repo root on
+# sys.path: tier-1 tests, python -m) and from shims that only put tools/
+# on the path — anchor the repo root so intra-package absolute imports
+# and the tpustack imports inside checkers always resolve
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.tpulint.core import (Finding, Rule, all_rules,  # noqa: E402
+                                lint_files, lint_repo)
+# importing the rule modules registers their rules
+from tools.tpulint import rules_code  # noqa: F401,E402
+from tools.tpulint import rules_config  # noqa: F401,E402
+from tools.tpulint import checker_metrics  # noqa: F401,E402
+from tools.tpulint import checker_manifests  # noqa: F401,E402
+
+__all__ = ["Finding", "Rule", "all_rules", "lint_files", "lint_repo"]

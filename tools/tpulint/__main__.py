@@ -1,0 +1,92 @@
+"""CLI: ``python -m tools.tpulint [paths...] [options]``.
+
+- no paths → full repo lint (AST rules over the default scan set plus the
+  metric / manifest / knob-registry checkers); exit 1 on findings.
+- explicit paths → AST rules only, over those files/dirs (fixture mode).
+- ``--json`` machine-readable output, ``--select`` code-prefix filter,
+  ``--no-scope`` disables per-rule file scoping (fixtures), ``--list-rules``
+  prints the rule catalog, ``--list-knobs`` prints the generated knob
+  table (paste into docs/CONFIG.md; TPL402 fails when the two drift).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.tpulint import all_rules, lint_files, lint_repo
+from tools.tpulint.core import REPO
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tpulint",
+        description="tpustack static-analysis suite (see docs/LINTING.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs for the AST rules; default = full "
+                         "repo lint including the repo-level checkers")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule-code prefixes to run "
+                         "(e.g. TPL1,TPL402)")
+    ap.add_argument("--no-scope", action="store_true",
+                    help="ignore per-rule file scoping (fixture testing)")
+    ap.add_argument("--root", default=str(REPO), help=argparse.SUPPRESS)
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--list-knobs", action="store_true",
+                    help="print the generated TPUSTACK_*/LLM_* knob table "
+                         "(the docs/CONFIG.md table) and exit")
+    args = ap.parse_args(argv)
+    root = Path(args.root)
+
+    if args.list_rules:
+        for r in all_rules():
+            scope = " [scoped]" if r.scope else ""
+            print(f"{r.code}  {r.name}{scope}: {r.summary}")
+        return 0
+    if args.list_knobs:
+        sys.path.insert(0, str(root))
+        from tpustack.utils import knobs
+
+        print(knobs.markdown_table())
+        return 0
+
+    select = [s.strip() for s in args.select.split(",") if s.strip()]
+    if args.paths:
+        # A typo'd path must be a usage error, not a silently-empty "clean"
+        # run — a CI hook linting a misspelled directory would otherwise
+        # green-light unlinted code forever.
+        missing = [p for p in args.paths
+                   if not (Path(p) if Path(p).is_absolute()
+                           else root / p).exists()]
+        if missing:
+            print(f"tpulint: no such path: {', '.join(missing)}",
+                  file=sys.stderr)
+            return 2
+        findings = lint_files(args.paths, root, select=select,
+                              unscoped=args.no_scope)
+    else:
+        findings = lint_repo(root, select=select)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_json() for f in findings],
+            "count": len(findings),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        if findings:
+            print(f"tpulint: {len(findings)} finding(s)", file=sys.stderr)
+        else:
+            n_rules = len(all_rules())
+            print(f"tpulint: clean ({n_rules} rules)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

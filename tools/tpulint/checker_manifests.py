@@ -1,0 +1,339 @@
+"""Manifest checker (TPL601) — the PR-3/PR-4/PR-5 ``lint_manifests`` as a
+tpulint plugin.
+
+Every workload in ``cluster-config/`` must declare the production
+resilience basics the serving stack depends on; monitoring Rules CRs must
+be triageable and reference real catalog metrics; checkpointing train Jobs
+must actually be able to resume; the prober CronJob must export what it
+measures.  See the rule docstrings below — the policy is unchanged from
+``tools/lint_manifests.py``, which remains as a thin CLI shim over this
+module.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import List, Optional, Set
+
+import yaml
+
+from tools.tpulint.core import REPO, Finding, repo_rule
+
+#: vendored upstream manifests we do not author (flux install --export)
+SKIP_FILES = ("cluster/flux-system/gotk-components.yaml",)
+
+#: seconds the preStop sleep holds before SIGTERM (endpoint propagation)
+PRESTOP_GRACE_S = 5
+
+#: minimum terminationGracePeriodSeconds for a checkpointing trainer: the
+#: SIGTERM handler finishes the in-flight step, then flushes + manifests
+#: the emergency checkpoint (tpustack/train/resilience.py) — SIGKILL
+#: before that completes loses up to save-every steps of work
+TRAIN_CKPT_GRACE_S = 60
+
+#: volume types that survive a pod restart (what --ckpt-dir needs);
+#: emptyDir et al. die with the pod
+DURABLE_VOLUME_KEYS = ("persistentVolumeClaim", "hostPath", "nfs", "csi")
+
+WORKLOAD_KINDS = ("Deployment", "DaemonSet", "Job", "CronJob", "JobSet")
+
+#: monitoring-rule CR kinds: GMP managed-collection flavours + the
+#: prometheus-operator upstream
+RULES_KINDS = ("Rules", "ClusterRules", "GlobalRules", "PrometheusRule")
+
+#: recording-rule naming: level:metric:operations (Prometheus convention)
+_RECORD_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*(:[a-zA-Z0-9_]+)+$")
+
+#: tpustack metric tokens inside a PromQL expr (histogram suffixes are
+#: normalized back to the family name before the catalog check)
+_EXPR_METRIC_RE = re.compile(r"\btpustack_[a-z0-9_]+")
+
+_ALERT_SEVERITIES = {"page", "ticket", "info", "warning", "critical"}
+
+
+def _catalog_metric_names() -> Optional[Set[str]]:
+    """Declared metric names (plus histogram sample suffixes), or None if
+    the package cannot be imported (the lint still runs structurally)."""
+    sys.path.insert(0, str(REPO))
+    try:
+        from tpustack.obs.catalog import CATALOG
+    except Exception:
+        return None
+    finally:
+        sys.path.pop(0)
+    names: Set[str] = set()
+    for spec in CATALOG:
+        names.add(spec.name)
+        if spec.type == "histogram":
+            names.update(f"{spec.name}{sfx}"
+                         for sfx in ("_bucket", "_sum", "_count"))
+    return names
+
+
+def _check_monitoring_rules(where: str, doc, errors: List[str],
+                            catalog: Optional[Set[str]]) -> None:
+    groups = (doc.get("spec") or {}).get("groups")
+    if not groups:
+        errors.append(f"{where}: rules CR without spec.groups")
+        return
+    for gi, group in enumerate(groups):
+        gname = group.get("name") or f"#{gi}"
+        if not group.get("name"):
+            errors.append(f"{where}: group #{gi} has no name")
+        rules = group.get("rules")
+        if not rules:
+            errors.append(f"{where}: group {gname!r} has no rules")
+            continue
+        for ri, rule in enumerate(rules):
+            rid = rule.get("record") or rule.get("alert") or f"#{ri}"
+            rwhere = f"{where}/{gname}/{rid}"
+            record, alert = rule.get("record"), rule.get("alert")
+            if bool(record) == bool(alert):
+                errors.append(f"{rwhere}: rule must set exactly one of "
+                              "record/alert")
+                continue
+            expr = rule.get("expr")
+            if not isinstance(expr, str) or not expr.strip():
+                errors.append(f"{rwhere}: missing expr")
+                continue
+            if record and not _RECORD_NAME_RE.match(record):
+                errors.append(f"{rwhere}: recording rule name must be "
+                              "colon-namespaced (level:metric:operations)")
+            if alert:
+                severity = (rule.get("labels") or {}).get("severity")
+                if severity not in _ALERT_SEVERITIES:
+                    errors.append(
+                        f"{rwhere}: alert severity label must be one of "
+                        f"{sorted(_ALERT_SEVERITIES)}, got {severity!r}")
+                if not (rule.get("annotations") or {}).get("summary"):
+                    errors.append(f"{rwhere}: alert needs an annotations."
+                                  "summary (operators triage from it)")
+            if catalog is not None:
+                for token in set(_EXPR_METRIC_RE.findall(expr)):
+                    if token not in catalog:
+                        errors.append(
+                            f"{rwhere}: expr references {token}, which is "
+                            "not in tpustack/obs/catalog.py — the rule "
+                            "would never fire")
+
+
+def _is_prober(container) -> bool:
+    argv = [str(a) for a in ((container.get("command") or [])
+                             + (container.get("args") or []))]
+    return any("probe.py" in a for a in argv)
+
+
+def _check_prober_contract(where: str, doc, errors: List[str]) -> None:
+    if doc.get("kind") != "CronJob":
+        return
+    for tmpl in _pod_templates(doc):
+        spec = tmpl.get("spec", {})
+        probers = [c for c in spec.get("containers", []) or []
+                   if _is_prober(c)]
+        if not probers:
+            continue
+        annotations = (tmpl.get("metadata") or {}).get("annotations") or {}
+        if annotations.get("prometheus.io/scrape") != "true":
+            errors.append(f"{where}: prober pod template missing "
+                          "prometheus.io/scrape annotations — its "
+                          "tpustack_probe_* metrics would never be scraped")
+        for c in probers:
+            if _env_value(c, "TPUSTACK_METRICS_PORT") is None:
+                errors.append(
+                    f"{where}: prober container {c.get('name')!r} does not "
+                    "set TPUSTACK_METRICS_PORT (no sidecar, no metrics)")
+        if not doc["spec"].get("concurrencyPolicy"):
+            errors.append(f"{where}: prober CronJob must pin "
+                          "concurrencyPolicy (overlapping probe pods "
+                          "double-count attempts)")
+
+
+def _pod_templates(doc):
+    """Yield every pod template a workload doc carries."""
+    kind = doc.get("kind")
+    if kind in ("Deployment", "DaemonSet", "Job"):
+        yield doc["spec"]["template"]
+    elif kind == "CronJob":
+        yield doc["spec"]["jobTemplate"]["spec"]["template"]
+    elif kind == "JobSet":
+        for rj in doc["spec"].get("replicatedJobs", []):
+            yield rj["template"]["spec"]["template"]
+
+
+def _env_value(container, name):
+    for e in container.get("env", []) or []:
+        if e.get("name") == name and "value" in e:
+            return e["value"]
+    return None
+
+
+def _check_resources(where: str, container, errors: List[str]) -> None:
+    res = container.get("resources") or {}
+    for section in ("requests", "limits"):
+        block = res.get(section) or {}
+        for unit in ("cpu", "memory"):
+            if unit not in block:
+                errors.append(f"{where}: container {container.get('name')!r} "
+                              f"missing resources.{section}.{unit}")
+
+
+def _check_deployment(where: str, doc, errors: List[str]) -> None:
+    tmpl = doc["spec"]["template"]
+    spec = tmpl["spec"]
+    server = (spec.get("containers") or [{}])[0]
+    # startupProbe may carry the cold-compile window, but readiness and
+    # liveness are unconditional: without them a draining or hung pod
+    # keeps receiving traffic / never restarts
+    for probe in ("readinessProbe", "livenessProbe"):
+        if probe not in server:
+            errors.append(f"{where}: serving container missing {probe}")
+    grace = spec.get("terminationGracePeriodSeconds")
+    if grace is None:
+        errors.append(f"{where}: pod template missing "
+                      "terminationGracePeriodSeconds")
+
+
+def _check_drain_consistency(where: str, doc, errors: List[str]) -> None:
+    for tmpl in _pod_templates(doc):
+        spec = tmpl.get("spec", {})
+        grace = spec.get("terminationGracePeriodSeconds")
+        for container in spec.get("containers", []) or []:
+            drain = _env_value(container, "TPUSTACK_DRAIN_TIMEOUT_S")
+            if drain is None:
+                continue
+            linger = _env_value(container, "TPUSTACK_DRAIN_LINGER_S") or 0
+            need = float(drain) + float(linger) + PRESTOP_GRACE_S
+            if not (container.get("lifecycle") or {}).get("preStop"):
+                errors.append(
+                    f"{where}: TPUSTACK_DRAIN_TIMEOUT_S set but no preStop "
+                    "hook (readiness flip needs endpoint propagation time)")
+            if grace is None or float(grace) < need:
+                errors.append(
+                    f"{where}: terminationGracePeriodSeconds ({grace}) < "
+                    f"preStop {PRESTOP_GRACE_S}s + drain {drain}s — "
+                    "kubernetes would SIGKILL the pod mid-drain")
+
+
+def _ckpt_dir_of(container):
+    argv = [str(a) for a in ((container.get("command") or [])
+                             + (container.get("args") or []))]
+    for j, a in enumerate(argv):
+        if a.startswith("--ckpt-dir="):
+            return a.split("=", 1)[1]
+        if a == "--ckpt-dir" and j + 1 < len(argv):
+            return argv[j + 1]
+    return None
+
+
+def _restart_budget(doc):
+    kind = doc.get("kind")
+    if kind == "Job":
+        return doc["spec"].get("backoffLimit", 6)  # k8s default is 6
+    if kind == "CronJob":
+        return doc["spec"]["jobTemplate"]["spec"].get("backoffLimit", 6)
+    if kind == "JobSet":
+        # the set restarts as a whole; the inner Jobs' backoffLimit stays 0
+        return (doc["spec"].get("failurePolicy") or {}).get("maxRestarts", 0)
+    return None
+
+
+def _check_train_ckpt_contract(where: str, doc, errors: List[str]) -> None:
+    """Jobs that checkpoint must actually be able to resume: durable
+    volume under --ckpt-dir, a restart budget, and enough grace for the
+    emergency save."""
+    budget = _restart_budget(doc)
+    if budget is None:  # not a Job-shaped workload
+        return
+    for tmpl in _pod_templates(doc):
+        spec = tmpl.get("spec", {})
+        volumes = {v.get("name"): v for v in spec.get("volumes", []) or []}
+        checkpoints = False
+        for container in spec.get("containers", []) or []:
+            ckpt = _ckpt_dir_of(container)
+            if ckpt is None:
+                continue
+            checkpoints = True
+            cname = container.get("name")
+            mount = None
+            for m in container.get("volumeMounts", []) or []:
+                mp = m.get("mountPath", "").rstrip("/")
+                if ckpt == mp or ckpt.startswith(mp + "/"):
+                    mount = m
+                    break
+            if mount is None:
+                errors.append(
+                    f"{where}: container {cname!r} passes --ckpt-dir={ckpt} "
+                    "but mounts no volume at that path")
+            else:
+                vol = volumes.get(mount.get("name")) or {}
+                if not any(k in vol for k in DURABLE_VOLUME_KEYS):
+                    errors.append(
+                        f"{where}: --ckpt-dir={ckpt} volume "
+                        f"{mount.get('name')!r} is not durable "
+                        f"(need one of {DURABLE_VOLUME_KEYS}) — a "
+                        "restarted pod would train from step 0")
+        if not checkpoints:
+            continue
+        # workload/pod-level requirements, reported once per template
+        if not budget:
+            errors.append(
+                f"{where}: checkpointing workload has restart budget 0 "
+                "(backoffLimit / failurePolicy.maxRestarts) — a "
+                "preempted pod never resumes")
+        grace = spec.get("terminationGracePeriodSeconds")
+        if grace is None or float(grace) < TRAIN_CKPT_GRACE_S:
+            errors.append(
+                f"{where}: terminationGracePeriodSeconds ({grace}) < "
+                f"{TRAIN_CKPT_GRACE_S}s emergency-save window — "
+                "SIGKILL could land mid-checkpoint-flush")
+
+
+def lint(root: Path = None) -> List[str]:
+    """Return a list of violation strings (empty = clean)."""
+    root = Path(root) if root is not None else REPO / "cluster-config"
+    errors: List[str] = []
+    catalog = _catalog_metric_names()
+    for path in sorted(root.rglob("*.yaml")):
+        rel = path.relative_to(root).as_posix()
+        if rel in SKIP_FILES:
+            continue
+        with open(path) as f:
+            try:
+                docs = [d for d in yaml.safe_load_all(f) if d]
+            except yaml.YAMLError as e:
+                errors.append(f"{rel}: unparseable YAML: {e}")
+                continue
+        for doc in docs:
+            if not isinstance(doc, dict):
+                continue
+            kind = doc.get("kind")
+            if kind in RULES_KINDS:
+                where = f"{rel}/{kind}/{doc['metadata'].get('name')}"
+                _check_monitoring_rules(where, doc, errors, catalog)
+                continue
+            if kind not in WORKLOAD_KINDS:
+                continue
+            where = f"{rel}/{kind}/{doc['metadata'].get('name')}"
+            for tmpl in _pod_templates(doc):
+                for container in (tmpl.get("spec", {}).get("containers")
+                                  or []):
+                    _check_resources(where, container, errors)
+            if kind == "Deployment":
+                _check_deployment(where, doc, errors)
+            _check_drain_consistency(where, doc, errors)
+            _check_train_ckpt_contract(where, doc, errors)
+            _check_prober_contract(where, doc, errors)
+    return errors
+
+
+@repo_rule("TPL601", "manifest-contract",
+           "cluster-config workloads: probes, resources, drain, rules CRs")
+def manifest_contract(root: Path) -> List[Finding]:
+    try:
+        errors = lint(root=root / "cluster-config")
+    except Exception as e:
+        return [Finding("TPL601", "cluster-config", 1,
+                        f"manifest checker failed to run: {e}")]
+    return [Finding("TPL601", "cluster-config", 1, e) for e in errors]

@@ -1,0 +1,169 @@
+"""Metric-name checker (TPL501) — the PR-1 ``lint_metrics`` as a tpulint
+plugin.
+
+Checks the catalog (``tpustack.obs.catalog.CATALOG``) — the single place
+metrics are declared — against the naming contract:
+
+- every name matches ``tpustack_<snake_case>`` (lowercase, digits, single
+  underscores; no camelCase, no double underscores, no trailing underscore);
+- counters end in ``_total`` (Prometheus convention);
+- every non-counter name ends in an approved unit token (``_seconds``,
+  ``_bytes``, ... or a count unit like ``_depth``/``_slots``/``_tokens``),
+  and the declared ``unit`` field matches that suffix;
+- label names are snake_case and never repeat a reserved name (``le``,
+  ``quantile``, anything ``__``-prefixed);
+- histogram buckets are strictly ascending and finite;
+- help strings exist; names are unique;
+- the catalog and the ``docs/OBSERVABILITY.md`` metric table agree BOTH
+  ways: every declared metric has a documented row, and every documented
+  row names a declared metric.
+
+``tools/lint_metrics.py`` remains as a thin CLI shim over this module (the
+tier-1 suite and operators shell it); ``python -m tools.tpulint`` runs it
+as the TPL501 checker alongside the AST rules.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from pathlib import Path
+from typing import List
+
+from tools.tpulint.core import REPO, Finding, repo_rule
+
+_NAME_RE = re.compile(r"^tpustack(_[a-z0-9]+)+$")
+_LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: approved trailing unit tokens.  Base units (Prometheus guidance) plus the
+#: count-style units this stack legitimately exports; extend deliberately —
+#: DON'T invent per-metric spellings of the same unit (e.g. "secs", "msec").
+UNIT_SUFFIXES = (
+    "seconds", "bytes", "ratio", "celsius", "info",
+    # count units (dimensionless gauges/histograms say what they count)
+    "depth", "slots", "tokens", "images", "requests", "entries", "prompts",
+    # paged-KV pool accounting (fixed-size KV blocks, kv_pool.py)
+    "blocks",
+    # enum gauges (value is a documented small-integer state machine)
+    "state",
+    # index gauges (value identifies a position, e.g. the last-saved
+    # training step — a resumed run continues FROM this number)
+    "step",
+)
+_RESERVED_LABELS = {"le", "quantile"}
+
+#: the operator-facing metric table this lint keeps in lock-step with the
+#: catalog
+DOC_PATH = os.path.join(str(REPO), "docs", "OBSERVABILITY.md")
+
+#: a doc table row: | `tpustack_...` | type | ...
+_DOC_ROW_RE = re.compile(r"^\|\s*`(tpustack_[a-z0-9_]+)`\s*\|")
+
+
+def _import_catalog(root: Path = REPO):
+    sys.path.insert(0, str(root))
+    try:
+        from tpustack.obs.catalog import CATALOG
+    finally:
+        sys.path.pop(0)
+    return CATALOG
+
+
+def documented_metrics(doc_path: str = DOC_PATH) -> List[str]:
+    """Metric names from the OBSERVABILITY.md table (first backticked
+    ``tpustack_*`` cell of each table row)."""
+    names: List[str] = []
+    with open(doc_path) as f:
+        for line in f:
+            m = _DOC_ROW_RE.match(line.strip())
+            if m:
+                names.append(m.group(1))
+    return names
+
+
+def lint_docs(doc_path: str = DOC_PATH) -> List[str]:
+    """Catalog ↔ doc-table cross-check, both directions."""
+    CATALOG = _import_catalog()
+
+    errors: List[str] = []
+    try:
+        documented = set(documented_metrics(doc_path))
+    except OSError as e:
+        return [f"cannot read {doc_path}: {e}"]
+    declared = {spec.name for spec in CATALOG}
+    for name in sorted(declared - documented):
+        errors.append(f"{name}: declared in the catalog but missing from "
+                      f"the {os.path.basename(doc_path)} metric table")
+    for name in sorted(documented - declared):
+        errors.append(f"{name}: documented in {os.path.basename(doc_path)} "
+                      "but not declared in the catalog")
+    return errors
+
+
+def lint(doc_path: str = DOC_PATH) -> List[str]:
+    """Return a list of violation strings (empty = clean)."""
+    CATALOG = _import_catalog()
+
+    errors: List[str] = lint_docs(doc_path)
+    seen = set()
+    for spec in CATALOG:
+        where = f"{spec.name}:"
+        if spec.name in seen:
+            errors.append(f"{where} duplicate metric name")
+        seen.add(spec.name)
+        if not _NAME_RE.match(spec.name):
+            errors.append(f"{where} not tpustack_* snake_case")
+        if spec.type not in ("counter", "gauge", "histogram"):
+            errors.append(f"{where} unknown type {spec.type!r}")
+        if not spec.help.strip():
+            errors.append(f"{where} empty help string")
+
+        if spec.type == "counter":
+            if not spec.name.endswith("_total"):
+                errors.append(f"{where} counters must end in _total")
+            if spec.unit != "total":
+                errors.append(f"{where} counter unit field must be 'total'")
+        else:
+            suffix = spec.name.rsplit("_", 1)[-1]
+            if suffix not in UNIT_SUFFIXES:
+                errors.append(
+                    f"{where} must end in a unit suffix {UNIT_SUFFIXES}, "
+                    f"got _{suffix}")
+            elif spec.unit != suffix:
+                errors.append(
+                    f"{where} declared unit {spec.unit!r} != name suffix "
+                    f"{suffix!r}")
+
+        for label in spec.labels:
+            if not _LABEL_RE.match(label) or label.startswith("__"):
+                errors.append(f"{where} bad label name {label!r}")
+            if label in _RESERVED_LABELS:
+                errors.append(f"{where} label {label!r} is reserved")
+
+        if spec.type == "histogram" and spec.buckets is not None:
+            b = list(spec.buckets)
+            if b != sorted(b) or len(set(b)) != len(b):
+                errors.append(f"{where} buckets not strictly ascending: {b}")
+            if any(x != x or x in (float("inf"), float("-inf")) for x in b):
+                errors.append(f"{where} buckets must be finite "
+                              "(+Inf is implicit)")
+        if spec.type != "histogram" and spec.buckets is not None:
+            errors.append(f"{where} buckets on a non-histogram")
+    return errors
+
+
+@repo_rule("TPL501", "metric-catalog",
+           "tpustack_* metric naming contract + catalog <-> doc table")
+def metric_catalog(root: Path) -> List[Finding]:
+    # note: if a tpustack from another checkout is already imported, the
+    # catalog comes from sys.modules regardless of root (python caching);
+    # the doc table is read from the requested root either way
+    try:
+        _import_catalog(root)
+        errors = lint(doc_path=str(root / "docs" / "OBSERVABILITY.md"))
+    except Exception as e:
+        return [Finding("TPL501", "tpustack/obs/catalog.py", 1,
+                        f"metric checker failed to run: {e}")]
+    return [Finding("TPL501", "tpustack/obs/catalog.py", 1, e)
+            for e in errors]

@@ -1,0 +1,454 @@
+"""AST rules: trace-safety, lock discipline, exception safety.
+
+Codes
+-----
+- **TPL101 host-sync-in-loop** — a host synchronisation (``.item()``,
+  ``.block_until_ready()``, ``np.asarray``/``np.array``,
+  ``jax.device_get``, ``float(x[...])``/``int(x[...])``) lexically inside
+  a ``for``/``while`` body of an engine module.  Each sync stalls the
+  dispatch pipeline; the engine's wave loops are built around exactly ONE
+  sync per wave, so any extra one is either a perf bug or a deliberate
+  fetch point that must be marked (suppression + justification comment).
+- **TPL102 jit-static-scalar** — ``jax.jit`` applied without
+  ``static_argnums``/``static_argnames`` to a function whose signature
+  has a scalar-shaped config parameter (``chunk``, ``steps``, ``n_*``,
+  ``max_*``, an int default, ...).  If that scalar is meant to pick the
+  trace it must be declared static; if it varies per call while traced it
+  silently recompiles per value.  Declaring staticness explicitly is the
+  repo convention (every engine jit does).
+- **TPL201 guarded-field-access** — a field annotated
+  ``# guarded-by: _lock`` on its ``__init__`` assignment is read/written
+  in another method without ``with self._lock``.  The variant
+  ``# guarded-by: _lock (writes)`` guards mutation only (lock-free racy
+  reads are an accepted pattern for monotonic counters/health views).
+- **TPL202 blocking-under-lock** — a blocking call (``time.sleep``,
+  ``open``, ``subprocess.*``, ``urlopen``, ``.block_until_ready()``,
+  ``np.asarray`` device fetch, ``.item()``, ``jax.device_get``) lexically
+  inside a ``with <something>lock<something>:`` body.  Device syncs and
+  I/O under a lock serialize every other thread behind the chip/disk.
+- **TPL301 swallowed-exception** — a bare/broad ``except`` whose body
+  neither re-raises, logs, nor propagates via ``.set_exception``; scoped
+  to the serving and model packages where a silent swallow strands a
+  request.
+- **TPL302 span-leak** — a locally assigned ``.start_span(...)`` result
+  with no guaranteed ``.end()`` path (no ``finally``-based end, and not
+  ended on both the normal and the exception path).  A span that never
+  ends pins its whole trace in the live table until eviction.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional
+
+from tools.tpulint.core import FileContext, Finding, file_rule
+
+# --------------------------------------------------------------- TPL101
+#: the engine hot-loop modules where an unplanned host sync stalls the
+#: whole dispatch pipeline
+ENGINE_SCOPE = ("tpustack/models/llm_continuous.py",
+                "tpustack/models/llm_generate.py")
+
+_NP_SYNC_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "jax.device_get"}
+_SYNC_METHODS = {"item", "block_until_ready"}
+
+
+def _callee(node: ast.Call) -> str:
+    try:
+        return ast.unparse(node.func)
+    except Exception:  # pragma: no cover - unparse is total on parsed ASTs
+        return ""
+
+
+def _host_array_names(fn) -> set:
+    """Local names assigned from numpy constructors/conversions in ``fn``
+    — already host-resident, so scalar pulls off them are free."""
+    names = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+                and _callee(node.value).split("(")[0].startswith(
+                    ("np.", "numpy."))):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+@file_rule("TPL101", "host-sync-in-loop",
+           "host synchronisation inside an engine wave/step loop",
+           scope=ENGINE_SCOPE)
+def host_sync_in_loop(ctx: FileContext) -> Iterator[Finding]:
+    host_names_cache = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.in_loop(node):
+            continue
+        callee = _callee(node)
+        hit = None
+        if callee in _NP_SYNC_FUNCS:
+            hit = callee
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _SYNC_METHODS and not node.args):
+            hit = f".{node.func.attr}()"
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in ("float", "int") and len(node.args) == 1
+              and isinstance(node.args[0], ast.Subscript)):
+            # float(arr[i]) / int(arr[0]): the classic one-scalar device
+            # pull — each one is a full dispatch-queue drain.  Exempt
+            # subscripts of names the function assigned from np.* (the
+            # array is already host-resident, the pull is free).
+            sub = node.args[0]
+            fn = ctx.enclosing_function(node)
+            if fn is not None and id(fn) not in host_names_cache:
+                host_names_cache[id(fn)] = _host_array_names(fn)
+            host_names = host_names_cache.get(id(fn), set())
+            base = sub.value
+            already_host = (
+                (isinstance(base, ast.Name) and base.id in host_names)
+                or (isinstance(base, ast.Call)
+                    and _callee(base).startswith(("np.", "numpy."))))
+            if not already_host:
+                hit = f"{node.func.id}(<subscript>)"
+        if hit:
+            yield Finding(
+                "TPL101", ctx.rel, node.lineno,
+                f"host sync {hit} inside a loop — every call stalls the "
+                "dispatch pipeline; batch the fetch at the wave boundary "
+                "or mark the intended sync point with a suppression")
+
+
+# --------------------------------------------------------------- TPL102
+#: parameter names that smell like trace-shaping Python scalars
+_SCALAR_PARAM_RE = re.compile(
+    r"^(n|k|chunk|steps?|depth|width|height|frames|length|size|tokens"
+    r"|block\w*|n_\w+|num_\w+|max_\w+)$")
+
+
+def _jit_static_names(call: ast.Call) -> Optional[bool]:
+    """True when the jax.jit call declares static args, False when not,
+    None when this isn't a jit application."""
+    if _callee(call) not in ("jax.jit", "jit", "functools.partial"):
+        return None
+    if _callee(call) == "functools.partial":
+        if not call.args or ast.unparse(call.args[0]) not in ("jax.jit",
+                                                              "jit"):
+            return None
+    return any(kw.arg in ("static_argnums", "static_argnames")
+               for kw in call.keywords)
+
+
+def _suspect_params(fn) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    suspects = [n for n in names if n not in ("self", "cls")
+                and _SCALAR_PARAM_RE.match(n)]
+    # an int-literal default is as strong a signal as the name
+    for a, d in zip(reversed(args.args), reversed(args.defaults)):
+        if (isinstance(d, ast.Constant) and type(d.value) is int
+                and a.arg not in suspects and a.arg not in ("self", "cls")):
+            suspects.append(a.arg)
+    return suspects
+
+
+@file_rule("TPL102", "jit-static-scalar",
+           "jax.jit without static_argnums over scalar-shaped params")
+def jit_static_scalar(ctx: FileContext) -> Iterator[Finding]:
+    # local function defs by name, for resolving jax.jit(fn) call targets
+    local_defs = {n.name: n for n in ast.walk(ctx.tree)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in ast.walk(ctx.tree):
+        # decorator form: @jax.jit / @functools.partial(jax.jit, ...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                has_static = None
+                if isinstance(dec, ast.Call):
+                    has_static = _jit_static_names(dec)
+                elif ast.unparse(dec) in ("jax.jit", "jit"):
+                    has_static = False
+                if has_static is False:
+                    suspects = _suspect_params(node)
+                    if suspects:
+                        yield Finding(
+                            "TPL102", ctx.rel, node.lineno,
+                            f"@jax.jit on {node.name}() leaves scalar "
+                            f"param(s) {suspects} dynamic — declare "
+                            "static_argnums/static_argnames (a varying "
+                            "Python scalar silently retraces per value)")
+            continue
+        # call form: jax.jit(fn) where fn is a resolvable local def/lambda
+        if isinstance(node, ast.Call) and _jit_static_names(node) is False:
+            target = node.args[0] if node.args else None
+            fn = None
+            if isinstance(target, ast.Name):
+                fn = local_defs.get(target.id)
+            elif isinstance(target, ast.Lambda):
+                fn = target
+            if fn is None:
+                continue
+            suspects = _suspect_params(fn)
+            if suspects:
+                name = getattr(fn, "name", "<lambda>")
+                yield Finding(
+                    "TPL102", ctx.rel, node.lineno,
+                    f"jax.jit({name}) leaves scalar param(s) {suspects} "
+                    "dynamic — declare static_argnums/static_argnames")
+
+
+# --------------------------------------------------------------- TPL201
+_GUARDED_RE = re.compile(
+    r"#\s*guarded-by:\s*(\w+)(?:\s*\(\s*(writes)\s*\))?")
+
+
+def _class_of(ctx: FileContext, node: ast.AST) -> Optional[ast.ClassDef]:
+    for p in ctx.parents(node):
+        if isinstance(p, ast.ClassDef):
+            return p
+    return None
+
+
+def _guarded_fields(ctx: FileContext, cls: ast.ClassDef):
+    """{field: (lockname, writes_only)} from ``self.X = ...  # guarded-by:
+    _lock`` annotations anywhere in the class body."""
+    out = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and 1 <= node.lineno <= len(ctx.lines)):
+                m = _GUARDED_RE.search(ctx.lines[node.lineno - 1])
+                if m:
+                    out[t.attr] = (m.group(1), m.group(2) == "writes")
+    return out
+
+
+#: container methods that mutate their receiver — `self._free.append(x)`
+#: is a WRITE to the guarded field even though the attribute load is Load
+_MUTATORS = {"append", "appendleft", "extend", "insert", "add", "update",
+             "setdefault", "pop", "popleft", "remove", "discard", "clear",
+             "fill", "sort"}
+
+
+def _is_field_write(ctx: FileContext, node: ast.Attribute) -> bool:
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True  # rebinding / del (includes AugAssign targets)
+    parent = ctx.parent(node)
+    # element assignment / deletion: self._ref[bid] = 1, del self._x[k],
+    # self._ref[bid] += 1 (AugAssign subscript targets carry Store ctx)
+    if (isinstance(parent, ast.Subscript) and parent.value is node
+            and isinstance(parent.ctx, (ast.Store, ast.Del))):
+        return True
+    # mutating method call: self._free.append(...), self._pending.pop(...)
+    if (isinstance(parent, ast.Attribute) and parent.attr in _MUTATORS
+            and isinstance(ctx.parent(parent), ast.Call)):
+        return True
+    return False
+
+
+@file_rule("TPL201", "guarded-field-access",
+           "guarded-by annotated field accessed without its lock")
+def guarded_field_access(ctx: FileContext) -> Iterator[Finding]:
+    for cls in [n for n in ast.walk(ctx.tree)
+                if isinstance(n, ast.ClassDef)]:
+        guarded = _guarded_fields(ctx, cls)
+        if not guarded:
+            continue
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self" and node.attr in guarded):
+                continue
+            lock, writes_only = guarded[node.attr]
+            fn = ctx.enclosing_function(node)
+            # __init__ builds the object before it is shared; the lock
+            # itself need not (cannot) be held there
+            if fn is not None and getattr(fn, "name", "") == "__init__":
+                continue
+            is_write = _is_field_write(ctx, node)
+            if writes_only and not is_write:
+                continue
+            held = ctx.held_locks(node)
+            if any(h == f"self.{lock}" or h.endswith(f".{lock}")
+                   for h in held):
+                continue
+            kind = "write" if is_write else "read"
+            yield Finding(
+                "TPL201", ctx.rel, node.lineno,
+                f"{kind} of self.{node.attr} (guarded-by: {lock}) outside "
+                f"'with self.{lock}' — either take the lock, or suppress "
+                "with a comment explaining why the race is benign")
+
+
+# --------------------------------------------------------------- TPL202
+_BLOCKING_FUNCS = {"time.sleep", "open", "urllib.request.urlopen",
+                   "jax.device_get", "jax.block_until_ready",
+                   "np.asarray", "np.array"}
+_BLOCKING_PREFIXES = ("subprocess.", "requests.", "socket.")
+_BLOCKING_METHODS = {"block_until_ready", "item"}
+
+
+@file_rule("TPL202", "blocking-under-lock",
+           "device sync / blocking I-O while holding a lock")
+def blocking_under_lock(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        held = ctx.held_locks(node)
+        if not held:
+            continue
+        callee = _callee(node)
+        hit = None
+        if callee in _BLOCKING_FUNCS:
+            hit = callee
+        elif any(callee.startswith(p) for p in _BLOCKING_PREFIXES):
+            hit = callee
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _BLOCKING_METHODS and not node.args):
+            hit = f".{node.func.attr}()"
+        if hit:
+            yield Finding(
+                "TPL202", ctx.rel, node.lineno,
+                f"blocking call {hit} while holding {held[0]} — every "
+                "other thread queues behind the chip/disk; move the "
+                "blocking part outside the critical section")
+
+
+# --------------------------------------------------------------- TPL301
+EXC_SCOPE = ("tpustack/serving/*.py", "tpustack/models/*.py",
+             "tpustack/models/*/*.py")
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+
+
+def _handler_is_broad(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    names = []
+    if isinstance(h.type, ast.Tuple):
+        names = [ast.unparse(e) for e in h.type.elts]
+    else:
+        names = [ast.unparse(h.type)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handler_handles(h: ast.ExceptHandler) -> bool:
+    """True when the body re-raises, logs, or propagates the exception —
+    via ``.set_exception(...)`` or by handing the bound exception to any
+    call (``fail(e)``-style delegation)."""
+    for node in ast.walk(h):
+        if isinstance(node, ast.Raise):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            base = ast.unparse(node.func.value)
+            if attr in _LOG_METHODS and ("log" in base.lower()
+                                         or base == "logging"):
+                return True
+            if attr == "set_exception":
+                return True
+        if h.name and any(isinstance(a, ast.Name) and a.id == h.name
+                          for a in node.args):
+            return True
+    return False
+
+
+@file_rule("TPL301", "swallowed-exception",
+           "broad except that neither logs, re-raises, nor propagates",
+           scope=EXC_SCOPE)
+def swallowed_exception(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _handler_is_broad(node) and not _handler_handles(node):
+            what = ("bare except" if node.type is None
+                    else f"except {ast.unparse(node.type)}")
+            yield Finding(
+                "TPL301", ctx.rel, node.lineno,
+                f"{what} swallows the error (no raise / log / "
+                "set_exception) — a silent failure here strands a request "
+                "or hides a device error")
+
+
+# --------------------------------------------------------------- TPL302
+def _end_calls(fn: ast.AST, name: str):
+    """(node, in_finally, in_except) for every ``<name>.end(...)`` in fn."""
+    out = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "end"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == name):
+            out.append(node)
+    return out
+
+
+@file_rule("TPL302", "span-leak",
+           "span started without a guaranteed end path")
+def span_leak(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        value = node.value
+        # unwrap `x = tracer.start_span(...) if cond else None`
+        if isinstance(value, ast.IfExp):
+            value = value.body
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr == "start_span"):
+            continue
+        name = node.targets[0].id
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            continue
+        # lifecycle transfer: `with sp:` ends it on exit; `return sp`
+        # hands ownership to the caller (add_span-style factories)
+        transferred = False
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.With, ast.AsyncWith)) and any(
+                    isinstance(i.context_expr, ast.Name)
+                    and i.context_expr.id == name for i in n.items):
+                transferred = True
+            if (isinstance(n, ast.Return) and isinstance(n.value, ast.Name)
+                    and n.value.id == name):
+                transferred = True
+        if transferred:
+            continue
+        ends = _end_calls(fn, name)
+        if not ends:
+            yield Finding(
+                "TPL302", ctx.rel, node.lineno,
+                f"span '{name}' is never .end()ed in this function — the "
+                "trace stays open (pinned live) until eviction")
+            continue
+        in_finally, in_except, plain = False, False, False
+        for e in ends:
+            placed = False
+            for p in ctx.parents(e):
+                if p is fn:
+                    break
+                if isinstance(p, ast.Try):
+                    if any(e is n or any(e is d for d in ast.walk(n))
+                           for n in p.finalbody):
+                        in_finally, placed = True, True
+                        break
+                    if any(any(e is d for d in ast.walk(h))
+                           for h in p.handlers):
+                        in_except, placed = True, True
+                        break
+            if not placed:
+                plain = True
+        if in_finally or (in_except and plain):
+            continue
+        yield Finding(
+            "TPL302", ctx.rel, node.lineno,
+            f"span '{name}' has no guaranteed end path — end it in a "
+            "finally:, or on both the normal and the except path")

@@ -1,0 +1,158 @@
+"""Config-discipline rules: every knob flows through the typed registry.
+
+- **TPL401 raw-env-read** (file rule) — a ``TPUSTACK_*``/``LLM_*`` name
+  read straight off the environment (``os.environ.get``/``[]``,
+  ``os.getenv``, or ``<env>.get``) anywhere outside
+  ``tpustack/utils/knobs.py``.  Raw reads are exactly how the stack ended
+  up with ~40 knobs nobody could enumerate; the registry's typed
+  accessors are the only sanctioned path.
+- **TPL402 knob-registry-drift** (repo rule) — the three-way cross-check,
+  same shape as lint_metrics' catalog <-> doc contract:
+  registry <-> code (every declared knob is read through an accessor
+  somewhere; every accessor call names a declared knob) and
+  registry <-> docs (every knob has a row in docs/CONFIG.md with the
+  declared type/default; every doc row names a declared knob).
+  ``python -m tools.tpulint --list-knobs`` regenerates the table.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Iterator, List, Set
+
+from tools.tpulint.core import (DEFAULT_SCAN, FileContext, Finding,
+                                file_rule, iter_python_files, parse_cached,
+                                repo_rule)
+
+_KNOB_NAME_RE = re.compile(r"^(TPUSTACK|LLM)_[A-Z0-9_]+$")
+
+#: accessor functions of the registry (reads the cross-check collects)
+_ACCESSORS = {"get_str", "get_int", "get_float", "get_bool"}
+
+CONFIG_DOC = "docs/CONFIG.md"
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`((?:TPUSTACK|LLM)_[A-Z0-9_]+)`\s*\|\s*(\w+)\s*\|\s*`([^`]*)`")
+
+
+def _knob_literal(node: ast.AST):
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and _KNOB_NAME_RE.match(node.value)):
+        return node.value
+    return None
+
+
+# --------------------------------------------------------------- TPL401
+@file_rule("TPL401", "raw-env-read",
+           "TPUSTACK_*/LLM_* read bypassing the knob registry")
+def raw_env_read(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        name = None
+        if isinstance(node, ast.Call) and node.args:
+            callee = ast.unparse(node.func)
+            if callee == "os.getenv" or (
+                    callee.endswith(".get")
+                    and ("environ" in callee
+                         or ast.unparse(node.func.value) == "env")):
+                name = _knob_literal(node.args[0])
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx,
+                                                            ast.Load):
+            # loads only: writing os.environ["TPUSTACK_X"] = ... is how
+            # tools/tests CONFIGURE a child process, not a config read
+            base = ast.unparse(node.value)
+            if "environ" in base or base == "env":
+                name = _knob_literal(node.slice)
+        if name:
+            yield Finding(
+                "TPL401", ctx.rel, node.lineno,
+                f"raw environment read of {name} — go through "
+                "tpustack.utils.knobs (get_str/get_int/get_float/"
+                "get_bool), which validates against the registry")
+
+
+# --------------------------------------------------------------- TPL402
+def _registry(root: Path):
+    sys.path.insert(0, str(root))
+    try:
+        from tpustack.utils import knobs
+    finally:
+        sys.path.pop(0)
+    return knobs
+
+
+def _accessor_reads(root: Path) -> Set[str]:
+    """Knob names passed to registry accessors anywhere in the scan set."""
+    reads: Set[str] = set()
+    for f in iter_python_files(DEFAULT_SCAN, root):
+        try:
+            # lint_repo already parsed the scan set for the AST rules;
+            # parse_cached makes this second walk free
+            tree = parse_cached(f, f.read_text())
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # TPL000 reports it; don't double up here
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ACCESSORS):
+                continue
+            name = _knob_literal(node.args[0])
+            if name:
+                reads.add(name)
+    return reads
+
+
+@repo_rule("TPL402", "knob-registry-drift",
+           "registry <-> code <-> docs/CONFIG.md cross-check, all ways")
+def knob_registry_drift(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        knobs = _registry(root)
+    except Exception as e:
+        return [Finding("TPL402", "tpustack/utils/knobs.py", 1,
+                        f"cannot import the knob registry: {e}")]
+    declared = set(knobs.REGISTRY)
+    reads = _accessor_reads(root)
+    for name in sorted(declared - reads):
+        findings.append(Finding(
+            "TPL402", "tpustack/utils/knobs.py", 1,
+            f"{name} is declared but never read through a registry "
+            "accessor — dead knob (delete it) or a read the lint cannot "
+            "see (hoist the name into a literal accessor call)"))
+    for name in sorted(reads - declared):
+        findings.append(Finding(
+            "TPL402", "tpustack/utils/knobs.py", 1,
+            f"{name} is read through an accessor but not declared in the "
+            "registry — the read raises KeyError at runtime"))
+
+    doc = root / CONFIG_DOC
+    if not doc.is_file():
+        findings.append(Finding("TPL402", CONFIG_DOC, 1,
+                                "missing — generate the table with "
+                                "'python -m tools.tpulint --list-knobs'"))
+        return findings
+    documented = {}
+    for i, line in enumerate(doc.read_text().splitlines(), 1):
+        m = _DOC_ROW_RE.match(line.strip())
+        if m:
+            documented[m.group(1)] = (i, m.group(2), m.group(3))
+    for name in sorted(declared - set(documented)):
+        findings.append(Finding(
+            "TPL402", CONFIG_DOC, 1,
+            f"{name} is declared but has no row in the knob table — "
+            "regenerate with 'python -m tools.tpulint --list-knobs'"))
+    for name, (line, type_cell, default_cell) in sorted(documented.items()):
+        if name not in declared:
+            findings.append(Finding(
+                "TPL402", CONFIG_DOC, line,
+                f"{name} is documented but not declared in the registry"))
+            continue
+        knob = knobs.REGISTRY[name]
+        if type_cell != knob.type_name or default_cell != knob.default_str():
+            findings.append(Finding(
+                "TPL402", CONFIG_DOC, line,
+                f"{name} row says ({type_cell}, `{default_cell}`) but the "
+                f"registry declares ({knob.type_name}, "
+                f"`{knob.default_str()}`) — regenerate the table"))
+    return findings

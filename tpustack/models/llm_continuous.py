@@ -77,6 +77,7 @@ the served path):
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
@@ -288,7 +289,12 @@ class ContinuousEngine:
         self._to_park: List[int] = []  # retirements awaiting a fused park
         self._pending: List[_PendingWave] = []
         self._retired_tokens = 0
-        self._fetch_marks: List[Tuple[float, int, int]] = []
+        # fetch-boundary rate marks: appended by the engine thread once per
+        # wave, read by the SERVER thread computing projected block release
+        # for 429 Retry-After — the only engine state a foreign thread
+        # reads, so it gets a real lock (one uncontended acquire per wave)
+        self._marks_lock = threading.Lock()
+        self._fetch_marks: List[Tuple[float, int, int]] = []  # guarded-by: _marks_lock
 
     # ------------------------------------------------------------ device state
     def _fresh_state(self):
@@ -370,7 +376,8 @@ class ContinuousEngine:
         racing the engine thread — this is a hint, not a barrier."""
         from tpustack.serving.kv_pool import eta_until_blocks
 
-        marks = self._fetch_marks
+        with self._marks_lock:
+            marks = list(self._fetch_marks)
         wave_rate = None
         if len(marks) >= 2 and marks[-1][0] > marks[0][0]:
             wave_rate = max(1e-3, (marks[-1][2] - marks[0][2])
@@ -384,8 +391,10 @@ class ContinuousEngine:
                 rate = (max(1e-3, s.stride_ema) * wave_rate
                         if wave_rate is not None else fallback_rate)
                 rel.append((remaining / rate, len(s.blocks)))
-            except Exception:
-                continue
+            except Exception:  # tpulint: disable=TPL301 — racing the
+                continue  # engine thread by design: a torn slot read only
+                # costs this hint one sample, and logging per race would
+                # spam every Retry-After under load
         return eta_until_blocks(rel, need_blocks)
 
     # ---------------------------------------------------------------- admission
@@ -705,9 +714,11 @@ class ContinuousEngine:
             # this fetch costs only the transfer; a failing server-side
             # insert must not kill the engine run for every in-flight peer
             try:
-                req.on_prefill_kv(
-                    [{k: np.asarray(v) for k, v in layer.items()}
-                     for layer in dev])
+                req.on_prefill_kv(  # intended sync point: the firsts
+                    # fetch above already proved prefill landed, so this
+                    # fetch costs only the transfer
+                    [{k: np.asarray(v)  # tpulint: disable=TPL101
+                      for k, v in layer.items()} for layer in dev])
             except Exception:
                 log.exception("on_prefill_kv failed (prefix-cache insert "
                               "skipped)")
@@ -856,7 +867,8 @@ class ContinuousEngine:
         # the first and last marks — what the bench reports alongside
         # end-to-end tokens/s; the wave count feeds the per-slot
         # stride-aware projected-block-release estimate
-        self._fetch_marks: List[Tuple[float, int, int]] = []
+        with self._marks_lock:
+            self._fetch_marks = []
 
         def admit_free() -> None:
             nonlocal gen_ctr, admitted
@@ -914,7 +926,8 @@ class ContinuousEngine:
         stats = {"requests": admitted, "generated_tokens": n_tok,
                  "wall_s": dt,
                  "tokens_per_s": n_tok / dt if dt > 0 else 0.0}
-        fetch_marks = self._fetch_marks
+        with self._marks_lock:
+            fetch_marks = list(self._fetch_marks)
         if len(fetch_marks) >= 2:
             t0m, c0 = fetch_marks[0][0], fetch_marks[0][1]
             t1m, c1 = fetch_marks[-1][0], fetch_marks[-1][1]
@@ -977,10 +990,11 @@ class ContinuousEngine:
         if self._on_progress is not None:
             self._on_progress("wave")
         self._wave_ctr += 1
-        self._fetch_marks.append((
-            time.time(), self._retired_tokens + sum(
-                len(s.out) for s in slots if s.req is not None),
-            self._wave_ctr))
+        with self._marks_lock:
+            self._fetch_marks.append((
+                time.time(), self._retired_tokens + sum(
+                    len(s.out) for s in slots if s.req is not None),
+                self._wave_ctr))
         live = self._live(slots)
         for i, gid, offset in snapshot:
             s = slots[i]
@@ -1042,7 +1056,9 @@ class ContinuousEngine:
                 # already-computed tokens are never stalled behind them
                 self._resolve_pending(state, slots,
                                       needed_slots=pending_here)
-            self._consume_block(state, slots, np.asarray(block), snapshot)
+            # THE wave-boundary fetch: one sync per consumed chunk, with
+            # `depth` more chunks already dispatched behind it
+            self._consume_block(state, slots, np.asarray(block), snapshot)  # tpulint: disable=TPL101
 
     # ------------------------------------------------- speculative decoding
     def _slot_draft_budget(self, s: _Slot) -> int:
@@ -1146,10 +1162,11 @@ class ContinuousEngine:
         if self._on_progress is not None:
             self._on_progress("wave")
         self._wave_ctr += 1
-        self._fetch_marks.append((
-            time.time(), self._retired_tokens + sum(
-                len(s.out) for s in slots if s.req is not None),
-            self._wave_ctr))
+        with self._marks_lock:
+            self._fetch_marks.append((
+                time.time(), self._retired_tokens + sum(
+                    len(s.out) for s in slots if s.req is not None),
+                self._wave_ctr))
         alpha = spec.ema_alpha
         live = self._live(slots)
         for i, gid in rows:
@@ -1252,4 +1269,6 @@ class ContinuousEngine:
             if pending_here or self._pending:
                 self._resolve_pending(state, slots,
                                       needed_slots=pending_here)
-            self._consume_block(state, slots, np.asarray(block), snapshot)
+            # the spec loop's plain-chunk fallback shares the one-sync-
+            # per-wave contract of _run_loop above
+            self._consume_block(state, slots, np.asarray(block), snapshot)  # tpulint: disable=TPL101

@@ -518,7 +518,9 @@ class Generator:
                 dispatched += chunk
             if not chain:
                 break
-            if consume(np.asarray(chain.pop(0))):
+            # THE chain-boundary fetch: one sync per consumed chunk, with
+            # `depth` more already dispatched behind it
+            if consume(np.asarray(chain.pop(0))):  # tpulint: disable=TPL101
                 stopped = True
                 chain.clear()  # speculative chunks beyond the stop
 
@@ -1309,7 +1311,9 @@ class Generator:
                 self.params, jnp.asarray(state["tok"]),
                 jnp.asarray(state["step"], jnp.int32), lengths, bucket_arr,
                 state["caches"], step_key, temperature, top_k, greedy)
-            consume(np.asarray(nxt)[:, None].astype(np.int32))
+            # per-step fetch by design: this legacy batch path streams one
+            # token per dispatch (the continuous engine is the served path)
+            consume(np.asarray(nxt)[:, None].astype(np.int32))  # tpulint: disable=TPL101
         for i in range(b):  # stragglers: budget/cancel exits without done[i]
             notify(i)
         t_decode = time.time() - t0
@@ -1455,7 +1459,9 @@ class Generator:
                 jnp.asarray(n_prompt + i, jnp.int32), caches, step_key,
                 jnp.float32(sample.temperature), jnp.int32(sample.top_k),
                 jnp.bool_(sample.greedy))
-            next_tok = np.asarray(next_tok_arr)[0]
+            # per-token fetch by design: this is the streaming solo path —
+            # the on_token SSE cadence IS one token per dispatch
+            next_tok = np.asarray(next_tok_arr)[0]  # tpulint: disable=TPL101
         return out, self._finish_stats(out, n_prompt, t_prefill, t0, n_cached)
 
     def generate_fused(
@@ -1535,7 +1541,9 @@ class Generator:
                 jnp.asarray(n_prompt + len(out) - 1, jnp.int32),
                 caches, step_key, jnp.float32(sample.temperature),
                 jnp.int32(sample.top_k), jnp.bool_(sample.greedy))
-            tok = int(np.asarray(nxt)[0])
+            # per-token fetch by design: the stop-token check needs each
+            # token on the host before the next dispatch
+            tok = int(np.asarray(nxt)[0])  # tpulint: disable=TPL101
             out.append(tok)
         return out, self._finish_stats(out, n_prompt, t_prefill, t0, n_cached)
 

@@ -80,7 +80,9 @@ class BpeTextTokenizer:
 
 
 def load_text_tokenizer(vocab_size: int):
-    tok_dir = os.environ.get("LLM_TOKENIZER_DIR", "")
+    from tpustack.utils import knobs
+
+    tok_dir = knobs.get_str("LLM_TOKENIZER_DIR")
     if tok_dir and os.path.isdir(tok_dir):
         try:
             from transformers import AutoTokenizer

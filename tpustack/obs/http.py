@@ -236,11 +236,9 @@ def maybe_start_metrics_sidecar(registry: Optional[Registry] = None):
     matching scrape annotations) to make non-server workloads scrapeable.
     Unset/0 → None.  Bind failure logs and returns None — a metrics port
     collision must never kill a training job."""
-    import os
+    from tpustack.utils import get_logger, knobs
 
-    from tpustack.utils import get_logger
-
-    port = int(os.environ.get("TPUSTACK_METRICS_PORT", "0") or 0)
+    port = knobs.get_int("TPUSTACK_METRICS_PORT")
     if not port:
         return None
     try:

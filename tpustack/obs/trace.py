@@ -40,7 +40,6 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-import os
 import re
 import threading
 import time
@@ -286,11 +285,12 @@ class Tracer:
                  max_slowest: int = 32, max_kept: int = 64,
                  max_live: int = 256, slow_s: Optional[float] = None,
                  env=None):
-        env = os.environ if env is None else env
+        from tpustack.utils import knobs
+
         if max_recent is None:
-            max_recent = int(env.get("TPUSTACK_TRACE_BUFFER", "") or 128)
+            max_recent = knobs.get_int("TPUSTACK_TRACE_BUFFER", env=env)
         if slow_s is None:
-            slow_s = float(env.get("TPUSTACK_TRACE_SLOW_S", "") or 5.0)
+            slow_s = knobs.get_float("TPUSTACK_TRACE_SLOW_S", env=env)
         self.slow_s = slow_s
         self.max_recent = max(1, max_recent)
         self.max_slowest = max(1, max_slowest)

@@ -47,15 +47,20 @@ def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_failed
     if _lib is not None:
         return _lib
-    if _load_failed or os.environ.get("TPUSTACK_NO_NATIVE") == "1":
+    from tpustack.utils import knobs
+
+    if _load_failed or knobs.get_bool("TPUSTACK_NO_NATIVE"):
         return None
     with _load_lock:
         if _lib is not None or _load_failed:
             return _lib
         if not os.path.exists(_SO_PATH) or _stale():
             try:
-                subprocess.run(["make", "-C", _NATIVE_DIR, "-B"], check=True,
-                               capture_output=True, timeout=120)
+                # blocking build under the lock is the point: exactly one
+                # thread pays the compile, every other caller waits for
+                # the finished .so instead of racing a second make
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-B"],  # tpulint: disable=TPL202
+                               check=True, capture_output=True, timeout=120)
             except Exception:
                 if not os.path.exists(_SO_PATH):
                     _load_failed = True  # don't re-pay the failing build per call

@@ -211,7 +211,7 @@ class WanRuntime:
         self.output_dir = output_dir or os.environ.get("WAN_OUTPUT_DIR",
                                                        "/tmp/wan-outputs")
         os.makedirs(self.output_dir, exist_ok=True)
-        self._pipeline = pipeline
+        self._pipeline = pipeline  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # ---- model discovery (ComfyUI directory layout)
@@ -293,7 +293,7 @@ class GraphExecutor:
         self.metrics = obs_catalog.build(registry)
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
         self._counter_lock = threading.Lock()
-        self._counter = self._scan_counter()
+        self._counter = self._scan_counter()  # guarded-by: _counter_lock
 
     def _scan_counter(self) -> int:
         """Resume numbering after the max existing ``*_NNNNN_.*`` output so
@@ -679,21 +679,26 @@ class GraphServer:
         self.executor = GraphExecutor(self.rt, registry=registry,
                                       tracer=self.tracer)
         self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
-        self._pending: Dict[str, Dict] = {}
+        # event-loop handlers and the worker thread share every dict below;
+        # all of them ride self._lock (tpulint TPL201 enforces the
+        # annotations — dict ops are GIL-atomic individually, but the
+        # worker's pop-check-update sequences are not)
+        self._pending: Dict[str, Dict] = {}  # guarded-by: _lock
         # accept-and-poll tracing: /prompt returns in ~1ms while the worker
         # runs minutes, so the HTTP root span ends long before the work —
         # each accepted prompt opens a "prompt" child span here, ended by
         # the worker at publish; the tracer holds the trace open until then
-        self._prompt_spans: Dict[str, obs_trace.Span] = {}
-        self._history: Dict[str, HistoryEntry] = {}
-        self._running: List[str] = []  # dispatched, not yet finalized
+        self._prompt_spans: Dict[str, obs_trace.Span] = {}  # guarded-by: _lock
+        self._history: Dict[str, HistoryEntry] = {}  # guarded-by: _lock
+        self._running: List[str] = []  # guarded-by: _lock
         self._no_batch: set = set()  # signatures whose batched build failed
+        # (worker-thread private: written and read only from _work's paths)
         self._lock = threading.Lock()
         self.max_batch = max(1, int(os.environ.get("WAN_MAX_BATCH", "4")))
         # per-prompt absolute deadlines (monotonic); the worker refuses to
         # start a prompt past its deadline (phase=queued) — there is no
         # long-lived HTTP request to 504, so the verdict lands in /history
-        self._deadline_at: Dict[str, float] = {}
+        self._deadline_at: Dict[str, float] = {}  # guarded-by: _lock
         # shared resilience layer: drain on SIGTERM, queued-prompt
         # deadlines, 429 backpressure, hung-dispatch watchdog, TPUSTACK_
         # FAULT_* hooks.  /prompt answers immediately, so drain must wait
@@ -708,7 +713,7 @@ class GraphServer:
             extra_busy=self._graph_busy, observe_http=False,
             expected_service_s=60.0)  # video prompts run minutes, and the
         # cold-start seed must say so before the first publish is observed
-        self._t_submit: Dict[str, float] = {}
+        self._t_submit: Dict[str, float] = {}  # guarded-by: _lock
         self._worker = threading.Thread(target=self._work, daemon=True,
                                         name="wan-graph-worker")
         self._worker.start()
@@ -770,11 +775,14 @@ class GraphServer:
                     self._running.append(pid)
                     entry = self._history[pid]
                     pspan = self._prompt_spans.pop(pid, None)
-                deadline = self._deadline_at.pop(pid, None)
+                    # same lock as submit's writes: popping outside it
+                    # could interleave with a submit still stamping the
+                    # deadline (tpulint TPL201 found the original unlocked
+                    # pops here)
+                    deadline = self._deadline_at.pop(pid, None)
                 if deadline is not None and time.monotonic() > deadline:
                     # expired while queued: refuse to start it (its device
                     # work would be wasted), publish the verdict in history
-                    self._t_submit.pop(pid, None)
                     self.resilience.note_deadline("queued")
                     self.metrics["tpustack_graph_prompts_total"].labels(
                         status="error").inc()
@@ -782,6 +790,7 @@ class GraphServer:
                         pspan.add_event("deadline_exceeded", phase="queued")
                         pspan.end(status="error")
                     with self._lock:
+                        self._t_submit.pop(pid, None)
                         entry.status_str = "error"
                         entry.messages.append(
                             "DeadlineExceeded: request deadline exceeded "
@@ -803,7 +812,6 @@ class GraphServer:
                         graph, sample_hook=hook, trace_parent=pspan)
                 except Exception as e:  # noqa: BLE001 — via /history
                     log.exception("prompt %s failed", pid)
-                    self._t_submit.pop(pid, None)
                     self.metrics["tpustack_graph_prompts_total"].labels(
                         status="error").inc()
                     if pspan is not None:
@@ -811,6 +819,7 @@ class GraphServer:
                                             f"{type(e).__name__}: {e}")
                         pspan.end(status="error")
                     with self._lock:
+                        self._t_submit.pop(pid, None)
                         entry.status_str = "error"
                         entry.messages.append(f"{type(e).__name__}: {e}")
                         entry.completed = True
@@ -968,12 +977,12 @@ class GraphServer:
                 entry.outputs = outputs       # completed+non-success as failure
                 entry.status_str = "success"
                 entry.completed = True
+                t_submit = self._t_submit.pop(pid, None)
             if pspan is not None:
                 pspan.end()  # publishes the trace (last open span)
             self.metrics["tpustack_graph_prompts_total"].labels(
                 status="success").inc()
             # the Retry-After basis: true submit→publish wall time
-            t_submit = self._t_submit.pop(pid, None)
             if t_submit is not None:
                 self.resilience.observe_service_time(
                     time.monotonic() - t_submit)
@@ -991,8 +1000,8 @@ class GraphServer:
                 entry.messages.append(f"{type(e).__name__}: {e}")
                 entry.completed = True
         finally:
-            self._t_submit.pop(pid, None)  # error paths must not leak
             with self._lock:
+                self._t_submit.pop(pid, None)  # error paths must not leak
                 if pid in self._running:
                     self._running.remove(pid)
         return None
@@ -1053,12 +1062,16 @@ class GraphServer:
                 # HTTP request answers in ~1ms
                 self._prompt_spans[pid] = self.tracer.start_span(
                     "prompt", parent=parent, attrs={"prompt_id": pid})
-        if deadline_s is not None:
-            self._deadline_at[pid] = time.monotonic() + deadline_s
-        self._t_submit[pid] = time.monotonic()
+            # deadline/submit stamps ride the same lock as the worker's
+            # pops: the worker is concurrently popping OTHER prompts out
+            # of these dicts while this handler inserts
+            if deadline_s is not None:
+                self._deadline_at[pid] = time.monotonic() + deadline_s
+            self._t_submit[pid] = time.monotonic()
+            number = len(self._history)
         self._queue.put(pid)
         self.metrics["tpustack_graph_queue_depth"].set(self._queue.qsize())
-        return web.json_response({"prompt_id": pid, "number": len(self._history)})
+        return web.json_response({"prompt_id": pid, "number": number})
 
     async def history(self, request: web.Request) -> web.Response:
         pid = request.match_info["prompt_id"]

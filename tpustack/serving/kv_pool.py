@@ -101,12 +101,16 @@ class KVBlockPool:
         self.n_blocks = n_blocks
         self.block = block_tokens
         self._lock = threading.RLock()
-        self._free: deque = deque(range(1, n_blocks))
-        self._ref = np.zeros(n_blocks, np.int64)
-        self._filled = np.zeros(n_blocks, np.int64)
+        # the free list and refcounts are the allocator's whole integrity:
+        # every mutation holds the lock (tpulint TPL201); the lock-free
+        # n_free/refcount READS are advisory (len() is atomic, admission
+        # re-checks under the lock inside alloc_tokens)
+        self._free: deque = deque(range(1, n_blocks))  # guarded-by: _lock (writes)
+        self._ref = np.zeros(n_blocks, np.int64)  # guarded-by: _lock (writes)
+        self._filled = np.zeros(n_blocks, np.int64)  # guarded-by: _lock (writes)
         # monotonic counters for stats()
-        self.allocated_blocks_total = 0
-        self.freed_blocks_total = 0
+        self.allocated_blocks_total = 0  # guarded-by: _lock (writes)
+        self.freed_blocks_total = 0  # guarded-by: _lock (writes)
 
     # ------------------------------------------------------------ capacity
     @property
@@ -265,9 +269,9 @@ class PagedPrefixCache:
         #: blocks an evict() pass freed — the server bumps its eviction
         #: counter here, mirroring the dense store's contract
         self.on_evict = on_evict
-        self._root = _Node((), None, -1)
+        self._root = _Node((), None, -1)  # guarded-by: _lock (writes)
         self._lock = threading.Lock()
-        self._tick = 0
+        self._tick = 0  # guarded-by: _lock (writes)
         # stats
         self.entries = 0
         self.hits = 0

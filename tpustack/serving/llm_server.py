@@ -100,7 +100,7 @@ from tpustack.obs import trace as obs_trace
 from tpustack.serving.resilience import (DeadlineExceeded,
                                          InjectedDeviceError,
                                          ResilienceManager)
-from tpustack.utils import get_logger
+from tpustack.utils import get_logger, knobs
 
 log = get_logger("serving.llm_server")
 
@@ -153,8 +153,8 @@ def _build_generator():
 
     import dataclasses
 
-    preset = os.environ.get("LLM_PRESET", "qwen25_7b")
-    ctx = int(os.environ.get("LLM_CTX", "4096"))
+    preset = knobs.get_str("LLM_PRESET")
+    ctx = knobs.get_int("LLM_CTX")
     if preset == "tiny":
         cfg = LlamaConfig.tiny(max_seq=min(ctx, 128))
         dtype = jnp.float32
@@ -165,10 +165,10 @@ def _build_generator():
         cfg = dataclasses.replace(LlamaConfig.qwen25_7b(), max_seq=ctx)
         dtype = jnp.bfloat16
 
-    quant = os.environ.get("LLM_QUANT", "").lower() or None
+    quant = knobs.get_str("LLM_QUANT").lower() or None
     if quant not in (None, "int8"):
         raise ValueError(f"LLM_QUANT={quant!r} unsupported (want int8)")
-    kv_quant = os.environ.get("LLM_KV_QUANT", "").lower() or None
+    kv_quant = knobs.get_str("LLM_KV_QUANT").lower() or None
     if kv_quant not in (None, "int8"):
         raise ValueError(f"LLM_KV_QUANT={kv_quant!r} unsupported (want int8)")
     cfg = dataclasses.replace(cfg, quant=quant, kv_quant=kv_quant)
@@ -177,7 +177,7 @@ def _build_generator():
     # axis) — the whole-model-per-chip ceiling lifts to N x HBM (70B-class
     # on a v5e-8 pod, the scale story llama.cpp's GPU/CPU split approximated)
     mesh = None
-    tp = int(os.environ.get("LLM_TP", "0") or 0)
+    tp = knobs.get_int("LLM_TP")
     if tp > 1:
         import jax
 
@@ -299,7 +299,7 @@ class LLMServer:
         self.tok = tokenizer
         self.model_name = model_name
         self._lock = asyncio.Lock()
-        self.max_batch = (int(os.environ.get("LLM_MAX_BATCH", "8"))
+        self.max_batch = (knobs.get_int("LLM_MAX_BATCH")
                           if max_batch is None else max_batch)
         # paged KV substrate (tpustack.serving.kv_pool) — the default
         # serving engine: one HBM block pool + per-slot block tables,
@@ -350,14 +350,16 @@ class LLMServer:
         self._spec_accepted = 0
         # live engine during a busy period — the projected-block-release
         # estimate behind 429 Retry-After reads it opportunistically
-        self._engine = None
+        # (reads are advisory; the write happens on the executor thread
+        # that holds the device lock)
+        self._engine = None  # guarded-by: _lock (writes)
         # legacy knob (pre-continuous window batching): accepted, unused
         self.batch_window_ms = (
-            float(os.environ.get("LLM_BATCH_WINDOW_MS", "0"))
+            knobs.get_float("LLM_BATCH_WINDOW_MS")
             if batch_window_ms is None else batch_window_ms)
         # decode tokens per fused scan dispatch: larger chunks amortise the
         # per-dispatch tail (chunk 64 measured ~6% over 32 at 7B int8)
-        self.chunk = max(1, int(os.environ.get("LLM_CHUNK", "32")))
+        self.chunk = max(1, knobs.get_int("LLM_CHUNK"))
         # the continuous engine's chunk is ALSO the admission + SSE cadence,
         # so it defaults latency-first to min(LLM_CHUNK, 16); the measured
         # throughput cost of 16 vs 32 is ~4% steady aggregate (708 vs 736
@@ -365,7 +367,7 @@ class LLMServer:
         # throughput-first deployments that accept the coarser cadence
         # 0/empty means "no override" (the LLM_BATCH_WINDOW_MS convention),
         # not a 1-token cadence
-        override = int(os.environ.get("LLM_ENGINE_CHUNK", "0") or 0)
+        override = knobs.get_int("LLM_ENGINE_CHUNK")
         self._engine_chunk_override = override if override > 0 else None
         import collections
 
@@ -386,12 +388,12 @@ class LLMServer:
     def _build_prefix_cache():
         from tpustack.serving.prefix_cache import PrefixCache
 
-        if os.environ.get("TPUSTACK_PREFIX_CACHE", "1").lower() in (
-                "0", "false", "no", "off"):
+        if not knobs.get_bool("TPUSTACK_PREFIX_CACHE"):
             return None
-        mb = float(os.environ.get("TPUSTACK_PREFIX_CACHE_MB", "512") or 512)
-        chunk = int(os.environ.get("TPUSTACK_PREFIX_CACHE_CHUNK", "256")
-                    or 256)
+        # registry owns the defaults; an explicit 0 stays 0 (the store
+        # then clamps capacity to its 1-byte floor)
+        mb = knobs.get_float("TPUSTACK_PREFIX_CACHE_MB")
+        chunk = knobs.get_int("TPUSTACK_PREFIX_CACHE_CHUNK")
         return PrefixCache(chunk_tokens=chunk,
                            capacity_bytes=max(1, int(mb * 1024 * 1024)))
 
@@ -403,8 +405,7 @@ class LLMServer:
         defaults to dense HBM parity (``max_batch x ctx`` tokens) — the
         concurrency win comes from admission charging each request its
         ACTUAL ``prompt + max_new`` instead of a whole ctx line."""
-        if os.environ.get("TPUSTACK_PAGED_KV", "1").lower() in (
-                "0", "false", "no", "off"):
+        if not knobs.get_bool("TPUSTACK_PAGED_KV"):
             return None
         if max_batch < 2:
             return None
@@ -413,19 +414,18 @@ class LLMServer:
                                               PagedPrefixCache)
 
         max_seq = gen.cfg.max_seq
-        block = int(os.environ.get("TPUSTACK_KV_BLOCK", "0") or 0)
+        block = knobs.get_int("TPUSTACK_KV_BLOCK")
         if block <= 0:
             block = min(64, max(8, max_seq // 8))
         block = min(block, max_seq)
         while block > 1 and max_seq % block:
             block //= 2
-        n_blocks = int(os.environ.get("TPUSTACK_KV_POOL_BLOCKS", "0") or 0)
+        n_blocks = knobs.get_int("TPUSTACK_KV_POOL_BLOCKS")
         if n_blocks <= 0:
             n_blocks = max_batch * (max_seq // block)
         pool = KVBlockPool(n_blocks + 1, block)  # +1: reserved block 0
         cache = None
-        if os.environ.get("TPUSTACK_PREFIX_CACHE", "1").lower() not in (
-                "0", "false", "no", "off"):
+        if knobs.get_bool("TPUSTACK_PREFIX_CACHE"):
             cache = PagedPrefixCache(pool)
         arrays = init_kv_pool(gen.cfg, n_blocks + 1, block,
                               dtype=gen.cache_dtype)
@@ -444,12 +444,12 @@ class LLMServer:
         random — the verify step owns correctness either way)."""
         from tpustack.serving.speculative import SpecConfig
 
-        k = int(os.environ.get("TPUSTACK_SPEC_TOKENS", "4") or 0)
+        k = knobs.get_int("TPUSTACK_SPEC_TOKENS")
         if k <= 0:
             return None
-        ngram = max(1, int(os.environ.get("TPUSTACK_SPEC_NGRAM", "3") or 3))
+        ngram = max(1, knobs.get_int("TPUSTACK_SPEC_NGRAM"))
         drafter = None
-        preset = (os.environ.get("TPUSTACK_SPEC_DRAFT", "") or "").strip()
+        preset = knobs.get_str("TPUSTACK_SPEC_DRAFT").strip()
         if preset:
             drafter = LLMServer._build_draft_drafter(gen, preset)
         return SpecConfig(tokens=k, ngram_max=ngram, drafter=drafter)
@@ -472,7 +472,7 @@ class LLMServer:
                if preset == "tiny" else _dc.replace(
                    getattr(LlamaConfig, preset)(), max_seq=gen.cfg.max_seq))
         dtype = jnp.float32 if preset == "tiny" else jnp.bfloat16
-        model_dir = os.environ.get("TPUSTACK_SPEC_DRAFT_DIR", "")
+        model_dir = knobs.get_str("TPUSTACK_SPEC_DRAFT_DIR")
         if model_dir:
             draft_gen = Generator.from_checkpoint(cfg, model_dir,
                                                   dtype=dtype)
@@ -518,6 +518,11 @@ class LLMServer:
             try:
                 ra = eng.projected_block_release_s(shortfall_blocks)
             except Exception:
+                # the p50 fallback below still answers the client, but a
+                # broken estimator must not fail silently forever
+                # (tpulint TPL301 caught exactly that here)
+                log.debug("projected block-release estimate failed; "
+                          "falling back to p50 Retry-After", exc_info=True)
                 ra = None
         if ra is None:
             return self.resilience.retry_after_s()
@@ -809,7 +814,10 @@ class LLMServer:
                     on_progress=self.resilience.progress,
                     tracer=self.tracer, paged=self.paged,
                     spec=self.spec_cfg, on_spec=self._note_spec)
-                self._engine = engine
+                # work() runs on the executor thread WHILE _run_on_device
+                # holds self._lock — the guard is real, just lexically
+                # invisible to the AST walk
+                self._engine = engine  # tpulint: disable=TPL201
 
                 def feed():
                     if self._solo_waiting > 0:

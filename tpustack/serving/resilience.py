@@ -69,7 +69,7 @@ from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
 from tpustack.obs import catalog as obs_catalog
-from tpustack.utils import get_logger
+from tpustack.utils import get_logger, knobs
 
 log = get_logger("serving.resilience")
 
@@ -92,20 +92,6 @@ class DeadlineExceeded(Exception):
         self.phase = phase
 
 
-def _env_float(env, name: str, default: float) -> float:
-    try:
-        return float(env.get(name, "") or default)
-    except ValueError:
-        raise ValueError(f"{name}={env.get(name)!r} is not a number")
-
-
-def _env_int(env, name: str, default: int) -> int:
-    try:
-        return int(env.get(name, "") or default)
-    except ValueError:
-        raise ValueError(f"{name}={env.get(name)!r} is not an integer")
-
-
 class FaultInjector:
     """Deterministic failure injection, keyed on dispatch/wave counts.
 
@@ -115,12 +101,14 @@ class FaultInjector:
     fire from engine/executor threads)."""
 
     def __init__(self, env=None):
-        env = os.environ if env is None else env
-        self.slow_prefill_s = _env_float(env, "TPUSTACK_FAULT_SLOW_PREFILL_S", 0.0)
-        self.device_error_nth = _env_int(env, "TPUSTACK_FAULT_DEVICE_ERROR_NTH", 0)
-        self.hang_nth = _env_int(env, "TPUSTACK_FAULT_HANG_NTH", 0)
-        self.hang_s = _env_float(env, "TPUSTACK_FAULT_HANG_S", 3600.0)
-        self.sigterm_after = _env_int(env, "TPUSTACK_FAULT_SIGTERM_AFTER", 0)
+        self.slow_prefill_s = knobs.get_float("TPUSTACK_FAULT_SLOW_PREFILL_S",
+                                              env=env)
+        self.device_error_nth = knobs.get_int(
+            "TPUSTACK_FAULT_DEVICE_ERROR_NTH", env=env)
+        self.hang_nth = knobs.get_int("TPUSTACK_FAULT_HANG_NTH", env=env)
+        self.hang_s = knobs.get_float("TPUSTACK_FAULT_HANG_S", env=env)
+        self.sigterm_after = knobs.get_int("TPUSTACK_FAULT_SIGTERM_AFTER",
+                                           env=env)
         #: set by the manager so an injected SIGTERM takes the exact code
         #: path the real signal handler takes; standalone default is a real
         #: kernel signal to our own pid
@@ -129,9 +117,9 @@ class FaultInjector:
         #: metrics hook (kind -> counted); set by the manager
         self.on_inject: Optional[Callable[[str], None]] = None
         self._lock = threading.Lock()
-        self.dispatches = 0
-        self.waves = 0
-        self._sigterm_fired = False
+        self.dispatches = 0  # guarded-by: _lock (writes)
+        self.waves = 0  # guarded-by: _lock (writes)
+        self._sigterm_fired = False  # guarded-by: _lock (writes)
 
     @property
     def active(self) -> bool:
@@ -197,7 +185,6 @@ class ResilienceManager:
                  env=None, fault: Optional[FaultInjector] = None,
                  observe_http: bool = True,
                  expected_service_s: float = 1.0):
-        env = os.environ if env is None else env
         self.server = server
         # accept-and-poll servers (graph /prompt answers in ~1ms while the
         # work runs minutes) pass observe_http=False and feed real
@@ -210,15 +197,18 @@ class ResilienceManager:
         self.expected_service_s = max(0.001, expected_service_s)
         self.metrics = obs_catalog.build(registry)
         self.concurrency = max(1, concurrency)
-        self.drain_timeout_s = _env_float(env, "TPUSTACK_DRAIN_TIMEOUT_S", 30.0)
+        self.drain_timeout_s = knobs.get_float("TPUSTACK_DRAIN_TIMEOUT_S",
+                                               env=env)
         # accept-and-poll servers (graph): keep serving reads for this long
         # AFTER the last accepted prompt publishes, so clients polling
         # /history can still fetch their results before the process exits
-        self.drain_linger_s = _env_float(env, "TPUSTACK_DRAIN_LINGER_S", 0.0)
-        self.request_timeout_s = _env_float(env, "TPUSTACK_REQUEST_TIMEOUT_S",
-                                            600.0)
-        self.max_queue_depth = _env_int(env, "TPUSTACK_MAX_QUEUE_DEPTH", 64)
-        self.watchdog_s = _env_float(env, "TPUSTACK_WATCHDOG_S", 0.0)
+        self.drain_linger_s = knobs.get_float("TPUSTACK_DRAIN_LINGER_S",
+                                              env=env)
+        self.request_timeout_s = knobs.get_float("TPUSTACK_REQUEST_TIMEOUT_S",
+                                                 env=env)
+        self.max_queue_depth = knobs.get_int("TPUSTACK_MAX_QUEUE_DEPTH",
+                                             env=env)
+        self.watchdog_s = knobs.get_float("TPUSTACK_WATCHDOG_S", env=env)
         self.fault = fault if fault is not None else FaultInjector(env)
         self.fault.sigterm_cb = self.begin_drain
         self.fault.on_inject = (
@@ -235,9 +225,12 @@ class ResilienceManager:
         self._drain_once = threading.Lock()
         self._state = SERVING
         self._hung = False
-        self._inflight = 0
+        self._inflight = 0  # guarded-by: _lock (writes)
         self._last_beat = time.monotonic()
-        self._service_times: deque = deque(maxlen=64)
+        # appended from worker/engine threads, median'd on the event loop —
+        # iterating a deque during a concurrent append raises RuntimeError,
+        # so BOTH sides hold the lock (tpulint TPL201 enforces it)
+        self._service_times: deque = deque(maxlen=64)  # guarded-by: _lock
         self._drain_thread: Optional[threading.Thread] = None
         self._watchdog_stop = threading.Event()
         self._watchdog_thread: Optional[threading.Thread] = None
@@ -382,14 +375,17 @@ class ResilienceManager:
         return max(0, self._inflight - self.concurrency)
 
     def observe_service_time(self, seconds: float) -> None:
-        self._service_times.append(seconds)
+        with self._lock:
+            self._service_times.append(seconds)
 
     def retry_after_s(self) -> int:
         """p50 service time scaled by how many service periods the current
         queue represents — a client retrying after this has a real chance
         of admission instead of re-shedding."""
-        p50 = (statistics.median(self._service_times)
-               if self._service_times else self.expected_service_s)
+        with self._lock:
+            samples = list(self._service_times)
+        p50 = (statistics.median(samples)
+               if samples else self.expected_service_s)
         periods = (self.queue_depth() + 1) / self.concurrency
         ra = min(max(1, math.ceil(p50 * periods)), 120)
         self.metrics["tpustack_retry_after_seconds"].labels(

@@ -110,8 +110,10 @@ class SDServer:
         self._lock = asyncio.Lock()
         # device arrays dispatched but not yet fetched — /profile drains
         # these before tracing so a capture never interleaves with an
-        # earlier batch still computing/transferring
-        self._inflight: list = []
+        # earlier batch still computing/transferring.  Mutations hold the
+        # dispatch lock so /profile's drain snapshot can never see a
+        # half-applied update (tpulint TPL201)
+        self._inflight: list = []  # guarded-by: _lock
         # ---- dynamic micro-batcher (TPU-native: one fused program serves
         # many queued requests at once; the reference serialised requests on
         # its single GPU, configmap.yaml:38-39) ----
@@ -428,8 +430,9 @@ class SDServer:
                 # remove by identity: list.remove uses ==, which on jax.Array
                 # raises "truth value is ambiguous" whenever two batches
                 # overlap and ours is no longer at index 0
-                self._inflight[:] = [a for a in self._inflight
-                                     if a is not dev_imgs]
+                async with self._lock:
+                    self._inflight[:] = [a for a in self._inflight
+                                         if a is not dev_imgs]
         except Exception as e:
             for r in batch:
                 if not r.future.done():

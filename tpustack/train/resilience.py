@@ -62,10 +62,7 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from tpustack.obs import catalog as obs_catalog
-# the serving resilience layer is this module's twin (same PR-3 fault
-# contract); share its env parsing instead of forking a copy
-from tpustack.serving.resilience import _env_int
-from tpustack.utils import get_logger
+from tpustack.utils import get_logger, knobs
 
 log = get_logger("train.resilience")
 
@@ -238,9 +235,10 @@ class TrainFaultInjector:
     boundary forever."""
 
     def __init__(self, env=None):
-        env = os.environ if env is None else env
-        self.kill_step = _env_int(env, "TPUSTACK_FAULT_TRAIN_KILL_STEP", 0)
-        self.corrupt_step = _env_int(env, "TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT", 0)
+        self.kill_step = knobs.get_int("TPUSTACK_FAULT_TRAIN_KILL_STEP",
+                                       env=env)
+        self.corrupt_step = knobs.get_int("TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT",
+                                          env=env)
         #: metrics hook (kind -> counted); set by the checkpointer
         self.on_inject = None
         #: marker-file directory; set by the checkpointer when there is one

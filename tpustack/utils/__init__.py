@@ -1,6 +1,5 @@
-from tpustack.utils.config import (EnvConfig, enable_compile_cache, env_flag,
-                                   env_int, env_str)
+from tpustack.utils import knobs
+from tpustack.utils.config import enable_compile_cache
 from tpustack.utils.logging import get_logger
 
-__all__ = ["EnvConfig", "enable_compile_cache", "env_flag", "env_int",
-           "env_str", "get_logger"]
+__all__ = ["enable_compile_cache", "get_logger", "knobs"]

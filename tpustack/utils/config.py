@@ -1,120 +1,20 @@
-"""Env-var flag system.
+"""Runtime configuration helpers.
 
-The reference configures its apps entirely through k8s env vars — ``MODEL_ID``,
-``VAE_CPU`` (reference ``cluster-config/apps/sd15-api/deployment.yaml:43-53``),
-``CTX_SIZE``, ``GPU_LAYERS`` (``cluster-config/apps/llm/deployment.yaml:64-74``)
-— plus argparse CLIs.  This module gives the TPU build the same layered story
-with one small, typed helper instead of ad-hoc ``os.environ`` reads.
+The ad-hoc env helper layer that used to live here (``env_str`` /
+``env_int`` / ``env_flag`` / ``EnvConfig``) was replaced in PR 8 by the
+typed knob registry in :mod:`tpustack.utils.knobs` — every
+``TPUSTACK_*``/``LLM_*`` read now goes through declared, documented,
+lint-enforced accessors (see docs/CONFIG.md).  Keeping the old helpers
+around would reopen a registry bypass that tpulint's TPL401 cannot see,
+so they are gone rather than deprecated.
+
+What remains is the one config helper that is behaviour, not parsing:
 """
 
 from __future__ import annotations
 
-import dataclasses
 import os
-from typing import Any, Callable, Dict, Optional, Type, TypeVar
-
-T = TypeVar("T", bound="EnvConfig")
-
-_TRUTHY = {"1", "true", "yes", "on"}
-_FALSY = {"0", "false", "no", "off", ""}
-
-
-def env_str(name: str, default: str = "") -> str:
-    return os.environ.get(name, default)
-
-
-def env_int(name: str, default: int = 0) -> int:
-    raw = os.environ.get(name)
-    if raw is None or raw.strip() == "":
-        return default
-    return int(raw)
-
-
-def env_float(name: str, default: float = 0.0) -> float:
-    raw = os.environ.get(name)
-    if raw is None or raw.strip() == "":
-        return default
-    return float(raw)
-
-
-def env_flag(name: str, default: bool = False) -> bool:
-    """Boolean env flag with the same loose semantics as the reference app's
-    ``VAE_CPU`` check (any of 1/true/yes toggles it on)."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    raw = raw.strip().lower()
-    if raw in _TRUTHY:
-        return True
-    if raw in _FALSY:
-        return False
-    raise ValueError(f"env var {name}={raw!r} is not a boolean")
-
-
-_CASTERS: Dict[type, Callable[[str, Any], Any]] = {
-    str: env_str,
-    int: env_int,
-    float: env_float,
-    bool: env_flag,
-}
-
-# With `from __future__ import annotations`, dataclass field.type is a string
-# like "int" or "Optional[int]" — resolve casters by name too.
-_CASTERS_BY_NAME: Dict[str, Callable[[str, Any], Any]] = {
-    "str": env_str,
-    "int": env_int,
-    "float": env_float,
-    "bool": env_flag,
-    "Optional[str]": env_str,
-    "Optional[int]": env_int,
-    "Optional[float]": env_float,
-    "Optional[bool]": env_flag,
-}
-
-
-def _caster_for(field: dataclasses.Field) -> Callable[[str, Any], Any]:
-    if isinstance(field.type, type):
-        return _CASTERS.get(field.type, env_str)
-    if isinstance(field.type, str) and field.type in _CASTERS_BY_NAME:
-        return _CASTERS_BY_NAME[field.type]
-    default = _default_of(field)
-    if default is not None:
-        return _CASTERS.get(type(default), env_str)
-    return env_str
-
-
-@dataclasses.dataclass
-class EnvConfig:
-    """Base class: a dataclass whose fields can be overridden from env vars.
-
-    Subclass with typed fields; ``MyConfig.from_env(prefix="SD15_")`` reads
-    ``SD15_<FIELD_UPPER>`` for each field, falling back to the dataclass
-    default.  Explicit ``overrides`` win over env vars.
-    """
-
-    @classmethod
-    def from_env(cls: Type[T], prefix: str = "", **overrides: Any) -> T:
-        kwargs: Dict[str, Any] = {}
-        for field in dataclasses.fields(cls):
-            if not field.init:
-                continue
-            env_name = f"{prefix}{field.name.upper()}"
-            if field.name in overrides:
-                kwargs[field.name] = overrides[field.name]
-            elif env_name in os.environ:
-                kwargs[field.name] = _caster_for(field)(env_name, _default_of(field))
-        return cls(**kwargs)
-
-    def replace(self: T, **changes: Any) -> T:
-        return dataclasses.replace(self, **changes)
-
-
-def _default_of(field: dataclasses.Field) -> Any:
-    if field.default is not dataclasses.MISSING:
-        return field.default
-    if field.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
-        return field.default_factory()  # type: ignore[misc]
-    return None
+from typing import Optional
 
 
 def enable_compile_cache(default_dir: Optional[str] = None) -> Optional[str]:
@@ -139,7 +39,9 @@ def enable_compile_cache(default_dir: Optional[str] = None) -> Optional[str]:
         default_dir = os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))), ".cache", "xla")
-    cache = (os.environ.get("TPUSTACK_COMPILE_CACHE")
+    from tpustack.utils import knobs
+
+    cache = (knobs.get_str("TPUSTACK_COMPILE_CACHE")
              or os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_dir)
     try:
         os.makedirs(cache, exist_ok=True)

@@ -1,0 +1,278 @@
+"""Typed registry of every ``TPUSTACK_*``/``LLM_*`` environment knob.
+
+The stack is configured the way the reference app is — k8s env vars — but
+by PR 7 those had grown into ~40 ad-hoc ``os.environ`` reads scattered over
+a dozen modules, each with its own parsing idiom, no central list of what
+exists, and no doc an operator could trust.  This module is the single
+source of truth:
+
+- every knob is **declared** once (:data:`REGISTRY`): name, type, default,
+  one-line doc;
+- every knob is **read** through the typed accessors here
+  (:func:`get_str` / :func:`get_int` / :func:`get_float` / :func:`get_bool`),
+  which validate against the declaration — reading an undeclared name or
+  with the wrong type raises immediately instead of silently drifting;
+- the operator table in ``docs/CONFIG.md`` is **generated** from the
+  registry (``python -m tools.tpulint --list-knobs``), and
+  ``tools/tpulint``'s config-discipline rules (TPL401/TPL402) cross-check
+  code ↔ registry ↔ docs both ways, exactly like ``lint_metrics`` does for
+  the metric catalog.
+
+Accessors take an optional ``env`` mapping (default ``os.environ``) so
+components constructed with injected env dicts (``FaultInjector``,
+``Tracer``, the resilience manager — a test-isolation contract) keep
+working unchanged.
+
+Parsing semantics, shared by every knob (this replaces the per-site
+idioms):
+
+- int/float: unset or blank → default; otherwise ``int()``/``float()``
+  with a ``ValueError`` naming the knob on garbage;
+- bool: unset or blank → default (a manifest stub with ``value: ""``
+  must not silently flip a default-on feature off); ``1/true/yes/on`` →
+  True; ``0/false/no/off`` → False; anything else raises (a typo'd flag
+  must not silently pick a side);
+- str: unset → default, no further parsing.
+
+This module is dependency-free (stdlib only) and imported by
+``tpustack.utils.logging`` — it must never import anything from tpustack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterable, Mapping, Optional
+
+_TRUTHY = frozenset(("1", "true", "yes", "on"))
+_FALSY = frozenset(("0", "false", "no", "off"))
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared environment knob."""
+
+    name: str
+    type: type  # str | int | float | bool
+    default: object
+    doc: str
+
+    @property
+    def type_name(self) -> str:
+        return self.type.__name__
+
+    def default_str(self) -> str:
+        """Rendering used by the generated doc table (and checked against
+        it by tpulint's TPL402)."""
+        if self.type is str:
+            return f'"{self.default}"'
+        return str(self.default)
+
+
+REGISTRY: Dict[str, Knob] = {}
+
+
+def _declare(name: str, type_: type, default, doc: str) -> None:
+    if name in REGISTRY:
+        raise ValueError(f"duplicate knob declaration {name}")
+    if type_ not in (str, int, float, bool):
+        raise TypeError(f"{name}: unsupported knob type {type_!r}")
+    if not isinstance(default, type_):
+        raise TypeError(f"{name}: default {default!r} is not {type_.__name__}")
+    REGISTRY[name] = Knob(name, type_, default, doc)
+
+
+# --------------------------------------------------------------------- model
+_declare("LLM_PRESET", str, "qwen25_7b",
+         "Model preset served by llm_server (qwen25_7b | llama2_7b | tiny).")
+_declare("LLM_CTX", int, 4096,
+         "Context window in tokens (llama.cpp --ctx-size parity).")
+_declare("LLM_QUANT", str, "",
+         "Weight quantisation: 'int8' for weight-only int8 serving, "
+         "empty for bf16.")
+_declare("LLM_KV_QUANT", str, "",
+         "KV-cache quantisation: 'int8' halves KV HBM and decode traffic, "
+         "empty for the compute dtype.")
+_declare("LLM_TP", int, 0,
+         "Tensor-parallel ways: GSPMD-shard the model over N chips "
+         "(0/1 = single chip).")
+_declare("LLM_TOKENIZER_DIR", str, "",
+         "Directory holding the HF tokenizer files; empty falls back to "
+         "the byte-fallback BPE baked into the repo.")
+_declare("LLM_MAX_BATCH", int, 8,
+         "Continuous-batching slot count (llama.cpp --parallel analog); "
+         "1 disables batching (solo path).")
+_declare("LLM_CHUNK", int, 32,
+         "Decode tokens per fused dispatch on the solo path.")
+_declare("LLM_ENGINE_CHUNK", int, 0,
+         "Override for the continuous engine's chunk (admission + SSE "
+         "cadence); 0 = default min(LLM_CHUNK, 16).")
+_declare("LLM_BATCH_WINDOW_MS", float, 0.0,
+         "Legacy pre-continuous batching window; accepted, unused.")
+
+# ----------------------------------------------------------------- KV cache
+_declare("TPUSTACK_PAGED_KV", bool, True,
+         "Paged KV substrate for batched serving (block pool + block "
+         "tables); 0 falls back to the dense per-slot engine (bisection).")
+_declare("TPUSTACK_KV_BLOCK", int, 0,
+         "KV block size in tokens; 0 = min(64, max(8, ctx/8)) snapped to "
+         "divide ctx.")
+_declare("TPUSTACK_KV_POOL_BLOCKS", int, 0,
+         "Allocatable pool size in blocks; 0 = LLM_MAX_BATCH x ctx / block "
+         "(dense HBM parity).")
+_declare("TPUSTACK_PREFIX_CACHE", bool, True,
+         "Cross-request prefix KV cache (refcounted block trie under "
+         "paging, host radix store under the dense fallback).")
+_declare("TPUSTACK_PREFIX_CACHE_MB", float, 512.0,
+         "Resident host-byte cap for the DENSE prefix cache store.")
+_declare("TPUSTACK_PREFIX_CACHE_CHUNK", int, 256,
+         "Snap granularity in tokens for the dense prefix cache.")
+
+# -------------------------------------------------------------- speculative
+_declare("TPUSTACK_SPEC_TOKENS", int, 4,
+         "Draft tokens per speculative verify step on the continuous "
+         "engine; 0 disables (bisection: the wave loop is byte-for-byte "
+         "the spec-free engine).")
+_declare("TPUSTACK_SPEC_NGRAM", int, 3,
+         "Max n-gram length for the prompt-lookup drafter.")
+_declare("TPUSTACK_SPEC_DRAFT", str, "",
+         "Draft-model preset (tiny | llama2_7b | qwen25_7b); empty keeps "
+         "the n-gram prompt-lookup drafter.")
+_declare("TPUSTACK_SPEC_DRAFT_DIR", str, "",
+         "Safetensors dir for the draft model; empty = random weights "
+         "(rehearsal-grade).")
+
+# --------------------------------------------------------------- resilience
+_declare("TPUSTACK_DRAIN_TIMEOUT_S", float, 30.0,
+         "Max seconds to wait for in-flight work after SIGTERM before "
+         "exiting.")
+_declare("TPUSTACK_DRAIN_LINGER_S", float, 0.0,
+         "Accept-and-poll servers: keep the read surface alive this long "
+         "after the last prompt publishes so pollers can fetch results.")
+_declare("TPUSTACK_REQUEST_TIMEOUT_S", float, 600.0,
+         "Default per-request deadline in seconds (0 disables; request "
+         "body timeout_s overrides).")
+_declare("TPUSTACK_MAX_QUEUE_DEPTH", int, 64,
+         "Waiting-work cap before shedding with 429 + Retry-After "
+         "(0 disables).")
+_declare("TPUSTACK_WATCHDOG_S", float, 0.0,
+         "No-progress seconds before liveness flips 503 (0 disables; set "
+         "above the worst cold-compile dispatch).")
+
+# ------------------------------------------------------------ fault injection
+_declare("TPUSTACK_FAULT_SLOW_PREFILL_S", float, 0.0,
+         "Sleep injected before every device dispatch (deterministic "
+         "fault).")
+_declare("TPUSTACK_FAULT_DEVICE_ERROR_NTH", int, 0,
+         "The Nth dispatch raises a one-shot transient device error.")
+_declare("TPUSTACK_FAULT_HANG_NTH", int, 0,
+         "The Nth dispatch hangs for TPUSTACK_FAULT_HANG_S.")
+_declare("TPUSTACK_FAULT_HANG_S", float, 3600.0,
+         "Hang duration for the injected dispatch hang.")
+_declare("TPUSTACK_FAULT_SIGTERM_AFTER", int, 0,
+         "Begin drain after the Nth completed wave (mid-request SIGTERM).")
+_declare("TPUSTACK_FAULT_TRAIN_KILL_STEP", int, 0,
+         "Training chaos: real SIGTERM to the trainer at this exact step "
+         "boundary (0 disables).")
+_declare("TPUSTACK_FAULT_TRAIN_CORRUPT_CKPT", int, 0,
+         "Training chaos: corrupt the checkpoint written at this step "
+         "(restore must quarantine + fall back).")
+
+# ------------------------------------------------------------ observability
+_declare("TPUSTACK_LOG_FORMAT", str, "text",
+         "Log line format: 'text' (kubectl-logs friendly) or 'json' "
+         "(one object per line).")
+_declare("TPUSTACK_LOG_LEVEL", str, "INFO",
+         "Root log level for the tpustack logger tree.")
+_declare("TPUSTACK_METRICS_PORT", int, 0,
+         "Stdlib /metrics sidecar port for batch/train jobs (0 disables).")
+_declare("TPUSTACK_TRACE_BUFFER", int, 128,
+         "Recent-traces ring buffer size in the in-process trace store.")
+_declare("TPUSTACK_TRACE_SLOW_S", float, 5.0,
+         "Traces at or above this duration are always kept (survive the "
+         "ring buffer's churn).")
+
+# ------------------------------------------------------------------ runtime
+_declare("TPUSTACK_COMPILE_CACHE", str, "",
+         "Persistent XLA compilation cache dir (the manifests' PVC-backed "
+         "volume); empty falls back to JAX_COMPILATION_CACHE_DIR, then "
+         "<repo>/.cache/xla.")
+_declare("TPUSTACK_NO_NATIVE", bool, False,
+         "Skip building/loading the native (C) helpers; pure-python "
+         "fallbacks serve instead.")
+
+
+# ------------------------------------------------------------------ readers
+def _knob(name: str, expect: type) -> Knob:
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(
+            f"unknown knob {name!r}: declare it in tpustack/utils/knobs.py "
+            "(tpulint TPL402 enforces registry <-> code <-> docs agreement)")
+    if knob.type is not expect:
+        raise TypeError(f"knob {name} is declared {knob.type_name}, "
+                        f"read as {expect.__name__}")
+    return knob
+
+
+def get_str(name: str, env: Optional[Mapping[str, str]] = None) -> str:
+    knob = _knob(name, str)
+    val = (os.environ if env is None else env).get(name)
+    return knob.default if val is None else val
+
+
+def get_int(name: str, env: Optional[Mapping[str, str]] = None) -> int:
+    knob = _knob(name, int)
+    val = (os.environ if env is None else env).get(name)
+    if val is None or not val.strip():
+        return knob.default
+    try:
+        return int(val)
+    except ValueError:
+        raise ValueError(f"{name}={val!r} is not an integer")
+
+
+def get_float(name: str, env: Optional[Mapping[str, str]] = None) -> float:
+    knob = _knob(name, float)
+    val = (os.environ if env is None else env).get(name)
+    if val is None or not val.strip():
+        return knob.default
+    try:
+        return float(val)
+    except ValueError:
+        raise ValueError(f"{name}={val!r} is not a number")
+
+
+def get_bool(name: str, env: Optional[Mapping[str, str]] = None) -> bool:
+    knob = _knob(name, bool)
+    val = (os.environ if env is None else env).get(name)
+    if val is None or not val.strip():
+        return knob.default
+    low = val.strip().lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    raise ValueError(f"{name}={val!r} is not a boolean "
+                     "(want 1/true/yes/on or 0/false/no/off)")
+
+
+# ---------------------------------------------------------------- rendering
+def knobs(prefix: str = "") -> Iterable[Knob]:
+    """Declared knobs, sorted by name, optionally prefix-filtered."""
+    return [REGISTRY[n] for n in sorted(REGISTRY) if n.startswith(prefix)]
+
+
+def markdown_table() -> str:
+    """The operator table docs/CONFIG.md embeds — regenerate it with
+    ``python -m tools.tpulint --list-knobs`` whenever the registry changes
+    (tpulint TPL402 fails when the two drift)."""
+    lines = ["| Knob | Type | Default | Description |",
+             "|------|------|---------|-------------|"]
+    for k in knobs():
+        # GFM splits cells on raw '|' even inside code spans — escape the
+        # free-text column so docs like "(a | b | c)" stay one cell
+        doc = k.doc.replace("|", "\\|")
+        lines.append(f"| `{k.name}` | {k.type_name} | `{k.default_str()}` "
+                     f"| {doc} |")
+    return "\n".join(lines)

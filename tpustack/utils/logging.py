@@ -21,8 +21,9 @@ from __future__ import annotations
 import datetime
 import json
 import logging
-import os
 import sys
+
+from tpustack.utils import knobs
 
 _TEXT_FORMAT = "%(asctime)s %(levelname)s [%(name)s] [rid=%(request_id)s] %(message)s"
 _configured = False
@@ -63,7 +64,7 @@ class _JsonFormatter(logging.Formatter):
 
 def _build_handler() -> logging.Handler:
     handler = logging.StreamHandler(sys.stdout)
-    if os.environ.get("TPUSTACK_LOG_FORMAT", "text").lower() == "json":
+    if knobs.get_str("TPUSTACK_LOG_FORMAT").lower() == "json":
         handler.setFormatter(_JsonFormatter())
     else:
         handler.setFormatter(logging.Formatter(_TEXT_FORMAT))
@@ -82,7 +83,7 @@ def configure_logging(force: bool = False) -> None:
     for h in list(root.handlers):
         root.removeHandler(h)
     root.addHandler(_build_handler())
-    root.setLevel(os.environ.get("TPUSTACK_LOG_LEVEL", "INFO").upper())
+    root.setLevel(knobs.get_str("TPUSTACK_LOG_LEVEL").upper())
     root.propagate = False
     _configured = True
 
