@@ -44,6 +44,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+# Runtime sanitizers (tpustack.sanitize): the plugin defaults
+# TPUSTACK_SANITIZE=1 + MODE=raise for the whole run — tier-1 IS the
+# sanitizer-enabled run, per the acceptance bar of the tpusan PR.  An
+# explicit TPUSTACK_SANITIZE=0 in the environment bisects back to the
+# uninstrumented suite.
+pytest_plugins = ("tpustack.sanitize.pytest_plugin",)
+
 
 def pytest_configure(config):
     if TPU_MODE:

@@ -359,14 +359,16 @@ def test_projected_release_uses_per_slot_stride_ema(gen):
         s.blocks = [1, 2, 3]
         s.stride_ema = stride
     eng._slots_view = [slow]
-    eng._fetch_marks = [(0.0, 0, 0), (10.0, 100, 10)]  # 1 wave/s measured
+    with eng._marks_lock:  # the runtime sanitizer enforces the guard
+        eng._fetch_marks = [(0.0, 0, 0), (10.0, 100, 10)]  # 1 wave/s
     eta_slow = eng.projected_block_release_s(3)
     eng._slots_view = [fast]
     eta_fast = eng.projected_block_release_s(3)
     # same remaining budget, 5x the stride → 5x sooner
     assert eta_fast == pytest.approx(eta_slow / 5.0)
     # and with no marks at all, the fallback rate still answers
-    eng._fetch_marks = []
+    with eng._marks_lock:
+        eng._fetch_marks = []
     assert eng.projected_block_release_s(3) > 0
 
 

@@ -177,6 +177,63 @@ def test_tpl201_catches_container_mutation(tmp_path):
     assert len(found) == 2
 
 
+# ------------------------------------------ TPL203 sanitizer-registry-drift
+def test_tpl203_repo_is_in_sync():
+    """Every guarded-by annotation in the instrumented modules has a
+    matching tpustack.sanitize.registry declaration, and vice versa."""
+    assert lint_repo(select=["TPL203"]) == []
+
+
+def test_tpl203_detects_stale_registry_entry(monkeypatch):
+    from tpustack.sanitize import registry
+
+    monkeypatch.setitem(
+        registry.GUARDED,
+        ("tpustack.serving.kv_pool", "KVBlockPool"),
+        registry.GUARDED[("tpustack.serving.kv_pool", "KVBlockPool")]
+        + (registry.GuardedSpec("_ghost_field", "_lock"),))
+    findings = lint_repo(select=["TPL203"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "_ghost_field" in msgs and "stale" in msgs
+
+
+def test_tpl203_detects_unregistered_annotation(monkeypatch):
+    from tpustack.sanitize import registry
+
+    specs = registry.GUARDED[("tpustack.serving.kv_pool", "KVBlockPool")]
+    monkeypatch.setitem(
+        registry.GUARDED, ("tpustack.serving.kv_pool", "KVBlockPool"),
+        tuple(s for s in specs if s.field != "_free"))
+    findings = lint_repo(select=["TPL203"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "_free" in msgs and "no declaration" in msgs
+
+
+def test_tpl203_detects_lock_mismatch(monkeypatch):
+    from tpustack.sanitize import registry
+
+    key = ("tpustack.models.llm_continuous", "ContinuousEngine")
+    monkeypatch.setitem(
+        registry.GUARDED, key,
+        (registry.GuardedSpec("_fetch_marks", "_wrong_lock"),))
+    findings = lint_repo(select=["TPL203"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "_fetch_marks" in msgs and "disagree" in msgs
+
+
+def test_tpl203_runtime_optout_requires_note(monkeypatch):
+    from tpustack.sanitize import registry
+
+    key = ("tpustack.serving.llm_server", "LLMServer")
+    monkeypatch.setitem(
+        registry.GUARDED, key,
+        (registry.GuardedSpec("_engine", "_lock", writes_only=True,
+                              runtime=False, note=""),))
+    findings = lint_repo(select=["TPL203"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "_engine" in msgs and "WHY" in msgs
+
+
 # ----------------------------------------------- TPL202 blocking-under-lock
 def test_tpl202_fires_on_sleep_under_lock(tmp_path):
     found = _lint(tmp_path, """
@@ -410,6 +467,30 @@ def test_cli_json_output(tmp_path, capsys):
     assert finding["line"] == 4
 
 
+def test_cli_github_format(tmp_path, capsys):
+    """--format=github emits one ::error workflow command per finding,
+    with %/newline escaping so multi-line messages stay one command."""
+    f = tmp_path / "bad.py"
+    f.write_text("def f():\n    try:\n        g()\n"
+                 "    except Exception:\n        pass\n")
+    rc = tpulint_main([str(f), "--no-scope", "--select", "TPL301",
+                       "--format", "github", "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    (line,) = [l for l in out.splitlines() if l.startswith("::error")]
+    assert line.startswith("::error file=bad.py,line=4,title=TPL301::")
+    assert "\n" not in line and "swallows" in line
+
+
+def test_cli_github_format_clean_repo_fixture(tmp_path, capsys):
+    f = tmp_path / "ok.py"
+    f.write_text("def f():\n    return 1\n")
+    rc = tpulint_main([str(f), "--no-scope", "--format", "github",
+                       "--root", str(tmp_path)])
+    assert rc == 0
+    assert "::error" not in capsys.readouterr().out
+
+
 def test_cli_nonexistent_path_is_usage_error(tmp_path, capsys):
     """A typo'd path must exit 2, not print 'clean' over zero files."""
     rc = tpulint_main([str(tmp_path / "no_such_dir"),
@@ -421,8 +502,9 @@ def test_cli_nonexistent_path_is_usage_error(tmp_path, capsys):
 def test_cli_list_rules(capsys):
     assert tpulint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for code in ("TPL101", "TPL102", "TPL201", "TPL202", "TPL301",
-                 "TPL302", "TPL401", "TPL402", "TPL501", "TPL601"):
+    for code in ("TPL101", "TPL102", "TPL201", "TPL202", "TPL203",
+                 "TPL301", "TPL302", "TPL401", "TPL402", "TPL501",
+                 "TPL601"):
         assert code in out
 
 

@@ -26,6 +26,7 @@ from tools.tpulint.core import (Finding, Rule, all_rules,  # noqa: E402
 # importing the rule modules registers their rules
 from tools.tpulint import rules_code  # noqa: F401,E402
 from tools.tpulint import rules_config  # noqa: F401,E402
+from tools.tpulint import rules_sanitize  # noqa: F401,E402
 from tools.tpulint import checker_metrics  # noqa: F401,E402
 from tools.tpulint import checker_manifests  # noqa: F401,E402
 

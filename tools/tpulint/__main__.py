@@ -28,7 +28,12 @@ def main(argv=None) -> int:
                     help="files/dirs for the AST rules; default = full "
                          "repo lint including the repo-level checkers")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit findings as JSON")
+                    help="emit findings as JSON (alias for --format=json)")
+    ap.add_argument("--format", default="text", dest="fmt",
+                    choices=("text", "json", "github"),
+                    help="output format: text (default), json, or github "
+                         "(GitHub Actions ::error annotations — the CI "
+                         "lint job's format)")
     ap.add_argument("--select", default="",
                     help="comma-separated rule-code prefixes to run "
                          "(e.g. TPL1,TPL402)")
@@ -72,11 +77,26 @@ def main(argv=None) -> int:
     else:
         findings = lint_repo(root, select=select)
 
-    if args.as_json:
+    fmt = "json" if args.as_json else args.fmt
+    if fmt == "json":
         print(json.dumps({
             "findings": [f.as_json() for f in findings],
             "count": len(findings),
         }, indent=2))
+    elif fmt == "github":
+        # GitHub Actions workflow commands: one ::error per finding, so
+        # the lint job annotates the offending line in the PR diff view.
+        # Values are %-escaped per the workflow-command spec (%, CR, LF;
+        # message-only escaping would break on a multi-line finding)
+        def esc(s: str) -> str:
+            return (s.replace("%", "%25").replace("\r", "%0D")
+                    .replace("\n", "%0A"))
+
+        for f in findings:
+            print(f"::error file={esc(f.path)},line={f.line},"
+                  f"title={esc(f.code)}::{esc(f.message)}")
+        if findings:
+            print(f"tpulint: {len(findings)} finding(s)", file=sys.stderr)
     else:
         for f in findings:
             print(f.render(), file=sys.stderr)

@@ -45,13 +45,19 @@ from typing import Iterator, List, Optional
 from tools.tpulint.core import FileContext, Finding, file_rule
 
 # --------------------------------------------------------------- TPL101
-#: the engine hot-loop modules where an unplanned host sync stalls the
-#: whole dispatch pipeline
+#: the hot-loop modules where an unplanned host sync stalls the dispatch
+#: pipeline: the LLM engine's wave loops, the sd micro-batcher's dispatch/
+#: fetch overlap, the graph server's prompt-pipelining worker, and the
+#: train step loops (async dispatch means an extra sync serialises the
+#: whole step chain)
 ENGINE_SCOPE = ("tpustack/models/llm_continuous.py",
-                "tpustack/models/llm_generate.py")
+                "tpustack/models/llm_generate.py",
+                "tpustack/serving/sd_server.py",
+                "tpustack/serving/graph_server.py",
+                "tpustack/train/*.py")
 
 _NP_SYNC_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
-                  "jax.device_get"}
+                  "jax.device_get", "jax.block_until_ready"}
 _SYNC_METHODS = {"item", "block_until_ready"}
 
 

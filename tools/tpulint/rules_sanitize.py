@@ -1,0 +1,137 @@
+"""TPL203: guarded-by annotations ↔ the runtime sanitizer registry.
+
+PR 8's TPL201 made ``# guarded-by:`` annotations enforceable lexically;
+the tpusan PR makes the same contracts enforceable at runtime — but only
+for fields the sanitizer knows about
+(:data:`tpustack.sanitize.registry.GUARDED`).  An annotation the registry
+misses is silently un-instrumented; a registry entry whose annotation was
+deleted enforces a contract nobody declared.  TPL203 is the both-ways
+cross-check (the TPL402/TPL501 drift pattern):
+
+- every ``# guarded-by:`` annotation in the instrumented modules has a
+  registry declaration with the SAME lock attribute and writes-only flag;
+- every registry declaration corresponds to a live annotation;
+- fields opted out of runtime enforcement (``runtime=False``) must say
+  why (non-empty ``note``) — an opt-out without a reason is drift waiting
+  to happen.
+
+The file set checked is derived from the registry itself
+(:data:`tpustack.sanitize.registry.MODULE_FILES`), so adding a class to
+the registry automatically brings its module under the cross-check.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from tools.tpulint.core import Finding, parse_cached, repo_rule
+from tools.tpulint.rules_code import _GUARDED_RE
+
+
+def _registry(root: Path):
+    sys.path.insert(0, str(root))
+    try:
+        from tpustack.sanitize import registry
+    finally:
+        sys.path.pop(0)
+    return registry
+
+
+def _annotations(path: Path) -> Dict[Tuple[str, str], Tuple[str, bool, int]]:
+    """(class, field) -> (lock, writes_only, line) from the ``guarded-by``
+    annotations in one module (the same convention TPL201 parses)."""
+    src = path.read_text()
+    lines = src.splitlines()
+    tree = parse_cached(path, src)
+    out: Dict[Tuple[str, str], Tuple[str, bool, int]] = {}
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and 1 <= node.lineno <= len(lines)):
+                    continue
+                m = _GUARDED_RE.search(lines[node.lineno - 1])
+                if m:
+                    out[(cls.name, t.attr)] = (m.group(1),
+                                               m.group(2) == "writes",
+                                               node.lineno)
+    return out
+
+
+@repo_rule("TPL203", "sanitizer-registry-drift",
+           "guarded-by annotations <-> tpustack.sanitize registry, "
+           "both ways")
+def sanitizer_registry_drift(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        registry = _registry(root)
+    except Exception as e:
+        return [Finding("TPL203", "tpustack/sanitize/registry.py", 1,
+                        f"cannot import the sanitizer registry: {e}")]
+
+    declared: Dict[str, Dict[Tuple[str, str], object]] = {}
+    files = dict(registry.MODULE_FILES)
+    for (module, cls), specs in registry.GUARDED.items():
+        rel = files.setdefault(module, module.replace(".", "/") + ".py")
+        for spec in specs:
+            declared.setdefault(rel, {})[(cls, spec.field)] = spec
+
+    for rel in sorted(set(declared) | set(files.values())):
+        path = root / rel
+        if not path.is_file():
+            findings.append(Finding(
+                "TPL203", rel, 1,
+                "registered in tpustack/sanitize/registry.py but the "
+                "module does not exist"))
+            continue
+        try:
+            annotated = _annotations(path)
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # TPL000 reports it; don't double up
+        regd = declared.get(rel, {})
+        for key, (lock, writes, line) in sorted(annotated.items()):
+            cls, field = key
+            spec = regd.get(key)
+            if spec is None:
+                findings.append(Finding(
+                    "TPL203", rel, line,
+                    f"{cls}.{field} carries a guarded-by annotation but "
+                    "has no declaration in tpustack/sanitize/registry.py "
+                    "— the runtime sanitizer cannot enforce it; declare "
+                    "it (runtime=False with a note if enforcement cannot "
+                    "apply)"))
+                continue
+            if spec.lock != lock or spec.writes_only != writes:
+                findings.append(Finding(
+                    "TPL203", rel, line,
+                    f"{cls}.{field}: annotation says guarded-by {lock}"
+                    f"{' (writes)' if writes else ''} but the sanitizer "
+                    f"registry declares {spec.lock}"
+                    f"{' (writes)' if spec.writes_only else ''} — "
+                    "lexical and runtime enforcement disagree"))
+        for key, spec in sorted(regd.items()):
+            cls, field = key
+            if key not in annotated:
+                findings.append(Finding(
+                    "TPL203", rel, 1,
+                    f"{cls}.{field} is declared in the sanitizer registry "
+                    "but carries no guarded-by annotation here — stale "
+                    "declaration (delete it) or a missing annotation "
+                    "(add it; TPL201 then enforces it lexically)"))
+            if not spec.runtime and not spec.note:
+                findings.append(Finding(
+                    "TPL203", rel, 1,
+                    f"{cls}.{field} opts out of runtime enforcement "
+                    "(runtime=False) without a note — say WHY the "
+                    "ownership check cannot model this guard"))
+    return findings

@@ -86,6 +86,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpustack import sanitize
 from tpustack.models.llama import init_kv_caches
 from tpustack.models.llm_generate import Generator, SampleConfig
 from tpustack.utils import get_logger
@@ -224,7 +225,8 @@ class ContinuousEngine:
     def __init__(self, gen: Generator, slots: int = 8, chunk: int = 32,
                  stop_tokens: Tuple[int, ...] = (), depth: int = 2,
                  on_progress: Optional[Callable[[str], None]] = None,
-                 tracer=None, paged=None, spec=None, on_spec=None):
+                 tracer=None, paged=None, spec=None, on_spec=None,
+                 compile_budgets: Optional[Dict[str, int]] = None):
         self.gen = gen
         self.B = slots
         self.chunk = chunk
@@ -295,6 +297,25 @@ class ContinuousEngine:
         # reads, so it gets a real lock (one uncontended acquire per wave)
         self._marks_lock = threading.Lock()
         self._fetch_marks: List[Tuple[float, int, int]] = []  # guarded-by: _marks_lock
+        sanitize.install_guards(self)
+        # runtime sanitizer (TPUSTACK_SANITIZE): recompile budgets for the
+        # steady-state entry points — the cold trace per (B, chunk, dtype)
+        # configuration plus one slack; growth past that at a wave
+        # boundary means the serving path is silently retracing.  None
+        # when disabled (and CompileWatch methods no-op regardless), so
+        # the =0 hot path is byte-for-byte the unwatched engine.
+        self._san: Optional[sanitize.CompileWatch] = None
+        if sanitize.enabled():
+            watch = sanitize.CompileWatch()
+            budgets = dict(compile_budgets or {})
+            cls = type(gen)
+            for name in ("_decode_scan_cont", "_decode_scan_paged",
+                         "_spec_verify_cont", "_spec_verify_paged"):
+                watch.watch(name, cls.__dict__.get(name),
+                            budgets.pop(name, 2))
+            for name, budget in budgets.items():  # caller-declared extras
+                watch.watch(name, cls.__dict__.get(name), budget)
+            self._san = watch
 
     # ------------------------------------------------------------ device state
     def _fresh_state(self):
@@ -921,6 +942,7 @@ class ContinuousEngine:
                 self.paged.arrays = state["pool"]
             self._slots_view = None
 
+        self._sanitize_wave()  # drain-time recompile + conservation sweep
         dt = time.time() - t_start
         n_tok = self._retired_tokens
         stats = {"requests": admitted, "generated_tokens": n_tok,
@@ -984,11 +1006,25 @@ class ContinuousEngine:
                 slots[i].dispatched += self.chunk
             chain.append((toks, snapshot))
 
+    def _sanitize_wave(self) -> None:
+        """Wave-boundary sanitizer checks (no-op unless TPUSTACK_SANITIZE):
+        recompile budgets on the decode/verify entry points and, under
+        paging, pool conservation — the engine's quiesce cadence, so a
+        violation surfaces within one wave of the bug instead of at
+        drain."""
+        if self._san is None:
+            return
+        self._san.check(where="wave boundary")
+        if self.paged is not None:
+            sanitize.check_kv_conservation(self.paged.pool,
+                                           where="wave boundary")
+
     def _consume_block(self, state, slots, block, snapshot):
         """Host bookkeeping for one fetched plain chunk block (the consume
         half of the wave loop, shared by both run loops)."""
         if self._on_progress is not None:
             self._on_progress("wave")
+        self._sanitize_wave()
         self._wave_ctr += 1
         with self._marks_lock:
             self._fetch_marks.append((
@@ -1161,6 +1197,7 @@ class ContinuousEngine:
         accs = np.asarray(n_acc)
         if self._on_progress is not None:
             self._on_progress("wave")
+        self._sanitize_wave()
         self._wave_ctr += 1
         with self._marks_lock:
             self._fetch_marks.append((

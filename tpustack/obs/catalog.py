@@ -234,6 +234,16 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "ended, evicted from the live table).", ("kind",),
                unit="total"),
 
+    # ---- runtime sanitizers (tpustack.sanitize; tpusan) ----
+    MetricSpec("tpustack_sanitizer_violations_total", "counter",
+               "Runtime sanitizer violations, by check (guarded_by | "
+               "lock_order | recompile | kv_leak | span_leak | "
+               "thread_leak).  Counted in BOTH modes; under "
+               "TPUSTACK_SANITIZE_MODE=report (production) this counter "
+               "is the only signal — any nonzero value is a real "
+               "correctness bug caught live, not noise.",
+               ("check",), unit="total"),
+
     # ---- black-box prober (tools/probe.py, the prober CronJob sidecar) ----
     MetricSpec("tpustack_probe_attempts_total", "counter",
                "Prober checks run, by target (llm|sd|graph), check "

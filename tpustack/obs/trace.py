@@ -461,6 +461,17 @@ class Tracer:
         self._slowest.sort(key=lambda r: -r["duration_s"])
         del self._slowest[self.max_slowest:]
 
+    def open_spans(self) -> Dict[str, List[str]]:
+        """Live traces with unfinished spans: trace_id → the open spans'
+        names.  A span here after its request quiesced is a leak — the
+        trace sits pinned in the live table until ``max_live`` eviction
+        marks it ``incomplete``.  The runtime sanitizer
+        (``tpustack.sanitize.leaks.check_span_leaks``) sweeps this at
+        pytest teardown."""
+        with self._lock:
+            return {tid: [s.name for s in lt.spans if not s._ended]
+                    for tid, lt in self._live.items()}
+
     # ------------------------------------------------------------- querying
     @staticmethod
     def _summary(record: Dict[str, Any]) -> Dict[str, Any]:

@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 from aiohttp import web
 
+from tpustack import sanitize
 from tpustack.obs import Trace
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
@@ -213,6 +214,7 @@ class WanRuntime:
         os.makedirs(self.output_dir, exist_ok=True)
         self._pipeline = pipeline  # guarded-by: _lock
         self._lock = threading.Lock()
+        sanitize.install_guards(self)
 
     # ---- model discovery (ComfyUI directory layout)
     def _list(self, sub: str, canonical: str) -> List[str]:
@@ -294,6 +296,7 @@ class GraphExecutor:
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
         self._counter_lock = threading.Lock()
         self._counter = self._scan_counter()  # guarded-by: _counter_lock
+        sanitize.install_guards(self)
 
     def _scan_counter(self) -> int:
         """Resume numbering after the max existing ``*_NNNNN_.*`` output so
@@ -714,6 +717,7 @@ class GraphServer:
             expected_service_s=60.0)  # video prompts run minutes, and the
         # cold-start seed must say so before the first publish is observed
         self._t_submit: Dict[str, float] = {}  # guarded-by: _lock
+        sanitize.install_guards(self)
         self._worker = threading.Thread(target=self._work, daemon=True,
                                         name="wan-graph-worker")
         self._worker.start()

@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tpustack import sanitize
 from tpustack.utils import get_logger
 
 log = get_logger("serving.kv_pool")
@@ -111,6 +112,7 @@ class KVBlockPool:
         # monotonic counters for stats()
         self.allocated_blocks_total = 0  # guarded-by: _lock (writes)
         self.freed_blocks_total = 0  # guarded-by: _lock (writes)
+        sanitize.install_guards(self)
 
     # ------------------------------------------------------------ capacity
     @property
@@ -280,6 +282,7 @@ class PagedPrefixCache:
         self.evictions = 0
         self.hit_tokens = 0
         self.inserted_tokens = 0
+        sanitize.install_guards(self)
 
     # ------------------------------------------------------------- lookup
     def match(self, ids: List[int]) -> PagedMatch:

@@ -93,6 +93,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from tpustack import sanitize
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import http as obs_http
@@ -383,6 +384,7 @@ class LLMServer:
             "llm", registry, concurrency=self.max_batch,
             queue_depth=lambda: len(self._queue) + self._solo_waiting,
             expected_service_s=2.0)
+        sanitize.install_guards(self)
 
     @staticmethod
     def _build_prefix_cache():
@@ -880,12 +882,27 @@ class LLMServer:
                     # engine yielded with work left (solo preemption):
                     # re-enter after the lock's FIFO queue services it
                     self._wake.set()
+            self._sanitize_quiesce()
             if stats["requests"]:
                 self.metrics["tpustack_llm_batch_occupancy_slots"].observe(
                     stats["requests"])
                 log.info("continuous run: %d requests, %d gen tok, "
                          "%.1f tok/s aggregate", stats["requests"],
                          stats["generated_tokens"], stats["tokens_per_s"])
+
+    def _sanitize_quiesce(self) -> None:
+        """Runtime-sanitizer KV accounting at engine drain (no-op unless
+        TPUSTACK_SANITIZE): with nothing queued and no open work request
+        (a TRUE quiesce — a stream handler between paged admission and
+        enqueue legitimately holds unaccounted blocks), every used pool
+        block must belong to the prefix cache at refcount exactly 1.
+        Anything else is a leaked slot reference: capacity gone until
+        restart."""
+        if (not sanitize.enabled() or self.paged is None
+                or self._queue or self.resilience._inflight
+                or self._solo_waiting):
+            return
+        sanitize.check_kv_quiesce(self.paged, where="llm engine drain")
 
     async def _complete_routed(self, prompt: str, n_predict: int,
                                temperature: float, top_k: int, seed,

@@ -68,6 +68,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
+from tpustack import sanitize
 from tpustack.obs import catalog as obs_catalog
 from tpustack.utils import get_logger, knobs
 
@@ -120,6 +121,7 @@ class FaultInjector:
         self.dispatches = 0  # guarded-by: _lock (writes)
         self.waves = 0  # guarded-by: _lock (writes)
         self._sigterm_fired = False  # guarded-by: _lock (writes)
+        sanitize.install_guards(self)
 
     @property
     def active(self) -> bool:
@@ -234,6 +236,7 @@ class ResilienceManager:
         self._drain_thread: Optional[threading.Thread] = None
         self._watchdog_stop = threading.Event()
         self._watchdog_thread: Optional[threading.Thread] = None
+        sanitize.install_guards(self)
         self.metrics["tpustack_serving_drain_state"].labels(
             server=server).set(SERVING)
         if self.watchdog_s > 0:

@@ -52,6 +52,7 @@ import numpy as np
 from aiohttp import web
 from pydantic import BaseModel, ValidationError
 
+from tpustack import sanitize
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import http as obs_http
@@ -156,6 +157,7 @@ class SDServer:
         self.resilience = ResilienceManager("sd", registry,
                                             concurrency=self.max_batch,
                                             expected_service_s=5.0)
+        sanitize.install_guards(self)
 
     @staticmethod
     def _pipeline_from_env():

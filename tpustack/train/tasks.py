@@ -116,10 +116,14 @@ def _train_loop(state, ckpt, step, make_batch, args, task: str = "train") -> Any
                 state, metrics = step(state, batch,
                                       jax.random.fold_in(rng, i))
             if i == start:
-                jax.block_until_ready(metrics["loss"])
+                # intended sync: the compile barrier — steps/s must not
+                # amortise the first step's trace+compile time
+                jax.block_until_ready(metrics["loss"])  # tpulint: disable=TPL101
                 t0 = time.time()
             elif (i + 1) % 10 == 0 or i == args.steps - 1:
-                jax.block_until_ready(metrics["loss"])
+                # intended sync: once per 10 steps for the progress report
+                # (the only fetch in the steady-state step chain)
+                jax.block_until_ready(metrics["loss"])  # tpulint: disable=TPL101
                 _report(i + 1, metrics, t0, i - start, args.batch)
             resilience.beat(task)
             if ckpt is not None:

@@ -192,6 +192,17 @@ _declare("TPUSTACK_TRACE_SLOW_S", float, 5.0,
          "Traces at or above this duration are always kept (survive the "
          "ring buffer's churn).")
 
+# ---------------------------------------------------------------- sanitizers
+_declare("TPUSTACK_SANITIZE", bool, False,
+         "Runtime sanitizer suite (tpustack.sanitize): guarded-by "
+         "enforcement, lock-order detection, recompile budgets, KV/span/"
+         "thread leak checks.  The tier-1 pytest plugin turns it on for "
+         "the whole suite; production keeps it off (zero overhead).")
+_declare("TPUSTACK_SANITIZE_MODE", str, "report",
+         "What a sanitizer violation does: 'raise' (tests — fail at the "
+         "faulting line) or 'report' (production — count "
+         "tpustack_sanitizer_violations_total and log, never crash).")
+
 # ------------------------------------------------------------------ runtime
 _declare("TPUSTACK_COMPILE_CACHE", str, "",
          "Persistent XLA compilation cache dir (the manifests' PVC-backed "
