@@ -1,0 +1,180 @@
+"""tpusan — runtime sanitizers enforcing tpulint's contracts dynamically.
+
+tpulint (PR 8) proved the value of repo-tuned correctness tooling, but an
+AST walk can only see code that *textually* touches an annotated field.
+The stack's hottest invariants are runtime properties: which thread holds
+which lock, the global lock acquisition order, whether a jitted serving
+entry point silently recompiles mid-traffic, whether every KV block a
+cancelled request held went back to the pool, whether a worker thread
+left a span open.  This package is the TSan/ASan-style dynamic
+complement — the same contracts, enforced at the faulting line:
+
+- **guarded-by enforcement** (:mod:`tpustack.sanitize.guarded`) — the
+  ``# guarded-by:`` annotations tpulint's TPL201 parses are ALSO declared
+  in :mod:`tpustack.sanitize.registry` (tpulint TPL203 fails on drift).
+  ``install_guards(obj)`` — one line at the end of each participating
+  ``__init__`` — installs data descriptors for the declared fields and
+  wraps their guard locks, so an off-lock rebind or container mutation
+  raises (or reports) where it happens instead of racing silently.
+- **lock-order / deadlock detection** (:mod:`tpustack.sanitize.locks`) —
+  :class:`TrackedLock` / :class:`TrackedAsyncLock` wrappers record the
+  global acquired-before graph; acquiring B while holding A when B→…→A
+  is already on record reports the AB/BA inversion with both stacks.
+- **recompile sanitizer** (:mod:`tpustack.sanitize.recompile`) —
+  :class:`CompileWatch` polls jitted entry points' trace-cache sizes
+  against declared budgets; steady-state serving that retraces
+  ``_decode_scan_*`` / ``_spec_verify_*`` fails at the wave boundary.
+- **resource-leak checks** (:mod:`tpustack.sanitize.leaks`) — KV pool
+  conservation at wave boundaries, pool-vs-prefix-cache accounting at
+  engine drain, open-span and non-daemon-thread checks at pytest
+  teardown.
+
+Activation: the ``TPUSTACK_SANITIZE`` knob (the tier-1 pytest plugin,
+:mod:`tpustack.sanitize.pytest_plugin`, turns it on for the whole run).
+``TPUSTACK_SANITIZE_MODE`` picks what a violation does: ``raise`` (tests)
+or ``report`` (production: increment
+``tpustack_sanitizer_violations_total{check=...}`` + log, never crash).
+With the knob off every hook is a no-op returning at an ``enabled()``
+check — the hot paths are byte-for-byte the uninstrumented code.
+
+This package imports only the stdlib and ``tpustack.utils.knobs`` at
+module level (the obs registry is imported lazily inside
+:func:`violation`), so the dependency-free modules it instruments
+(``kv_pool``, ``resilience``) stay dependency-free.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import List, Optional
+
+from tpustack.utils import knobs
+
+__all__ = [
+    "SanitizerViolation", "enabled", "mode", "activate", "deactivate",
+    "refresh", "violation", "install_guards", "assert_held",
+    "TrackedLock", "TrackedAsyncLock", "CompileWatch",
+    "check_kv_conservation", "check_kv_quiesce", "check_span_leaks",
+    "check_thread_leaks", "teardown_checks", "violations_seen",
+]
+
+
+class SanitizerViolation(AssertionError):
+    """A runtime sanitizer check failed.  ``check`` names the check class
+    (``guarded_by`` | ``lock_order`` | ``recompile`` | ``kv_leak`` |
+    ``span_leak`` | ``thread_leak``); the message carries the actionable
+    report (field/lock/stacks/blocks involved and how to fix it)."""
+
+    def __init__(self, check: str, message: str):
+        super().__init__(f"sanitizer[{check}]: {message}")
+        self.check = check
+
+
+# resolved lazily from the knob registry so the pytest plugin (or a test)
+# can set the environment before the first check runs; activate() /
+# deactivate() override explicitly
+_state_lock = threading.Lock()
+_state = {"enabled": None, "mode": None}
+
+#: every violation reported this process, newest last (bounded) — report
+#: mode's in-process audit trail, and what teardown_checks() surfaces
+_SEEN: List[str] = []
+_SEEN_MAX = 256
+
+
+def enabled() -> bool:
+    e = _state["enabled"]
+    if e is None:
+        with _state_lock:
+            if _state["enabled"] is None:
+                _state["enabled"] = knobs.get_bool("TPUSTACK_SANITIZE")
+            e = _state["enabled"]
+    return e
+
+
+def mode() -> str:
+    m = _state["mode"]
+    if m is None:
+        with _state_lock:
+            if _state["mode"] is None:
+                m = knobs.get_str("TPUSTACK_SANITIZE_MODE").strip().lower()
+                _state["mode"] = m if m in ("raise", "report") else "report"
+            m = _state["mode"]
+    return m
+
+
+def activate(mode: Optional[str] = None) -> None:
+    """Force the sanitizer on (tests / the pytest plugin)."""
+    with _state_lock:
+        _state["enabled"] = True
+        if mode is not None:
+            if mode not in ("raise", "report"):
+                raise ValueError(f"sanitize mode {mode!r} (raise|report)")
+            _state["mode"] = mode
+
+
+def deactivate() -> None:
+    """Force the sanitizer off (tests proving the =0 path)."""
+    with _state_lock:
+        _state["enabled"] = False
+
+
+def refresh() -> None:
+    """Drop the cached knob reads (re-resolve from the environment)."""
+    with _state_lock:
+        _state["enabled"] = None
+        _state["mode"] = None
+
+
+def violations_seen() -> List[str]:
+    """Violations reported so far this process (both modes), oldest first."""
+    with _state_lock:
+        return list(_SEEN)
+
+
+def _clear_seen() -> None:
+    with _state_lock:
+        _SEEN.clear()
+
+
+def violation(check: str, message: str, *, stack: bool = False) -> None:
+    """Report one sanitizer violation.
+
+    Always counts ``tpustack_sanitizer_violations_total{check=...}`` (the
+    metric must tell the truth in both modes) and records the report in
+    the in-process audit list; then raises :class:`SanitizerViolation`
+    in ``raise`` mode or logs an error in ``report`` mode.  ``stack``
+    appends the current stack so a report-mode log still points at the
+    faulting line.
+    """
+    report = f"{check}: {message}"
+    with _state_lock:
+        _SEEN.append(report)
+        del _SEEN[:-_SEEN_MAX]
+    try:  # the metric is best-effort: a half-initialised obs stack (early
+        # import order in a crashing process) must not mask the violation
+        from tpustack.obs import catalog as obs_catalog
+
+        obs_catalog.build(None)[
+            "tpustack_sanitizer_violations_total"].labels(check=check).inc()
+    except Exception:
+        pass
+    if mode() == "raise":
+        raise SanitizerViolation(check, message)
+    if stack:
+        frames = "".join(traceback.format_stack(limit=12)[:-2])
+        message = f"{message}\nat:\n{frames}"
+    from tpustack.utils import get_logger
+
+    get_logger("sanitize").error("sanitizer violation [%s]: %s", check,
+                                 message)
+
+
+# re-exports (after violation/enabled exist — the submodules import them)
+from tpustack.sanitize.guarded import assert_held, install_guards  # noqa: E402
+from tpustack.sanitize.leaks import (check_kv_conservation,  # noqa: E402
+                                     check_kv_quiesce, check_span_leaks,
+                                     check_thread_leaks, teardown_checks)
+from tpustack.sanitize.locks import TrackedAsyncLock, TrackedLock  # noqa: E402
+from tpustack.sanitize.recompile import CompileWatch  # noqa: E402

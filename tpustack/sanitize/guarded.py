@@ -1,0 +1,221 @@
+"""Runtime guarded-by enforcement: descriptors + checking containers.
+
+``install_guards(obj)`` — the one-line hook each participating class
+calls at the END of ``__init__`` — is a no-op unless the sanitizer is
+enabled.  Enabled, it:
+
+1. installs (once per class) a data descriptor for every field the
+   :mod:`tpustack.sanitize.registry` declares for that class, shadowing
+   the instance value under a mangled key;
+2. wraps each declared guard lock attribute in a
+   :class:`~tpustack.sanitize.locks.TrackedLock` /
+   :class:`~tpustack.sanitize.locks.TrackedAsyncLock` (ownership +
+   lock-order tracking);
+3. wraps list/deque/dict field values in checking proxies so container
+   MUTATIONS (``append``/``pop``/``__setitem__``/...) are verified
+   against lock ownership, not just rebinds.
+
+The ``__init__`` window needs no special casing: until ``install_guards``
+wraps the lock, ownership cannot be judged (``lock_held`` returns None)
+and access is allowed — exactly TPL201's ``__init__`` exemption, derived
+instead of hard-coded.
+
+``assert_held(lock)`` is the explicit checkpoint form for code paths a
+descriptor cannot cover (helpers that REQUIRE a caller-held lock).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Tuple
+
+from tpustack.sanitize import registry as _registry
+from tpustack.sanitize.locks import lock_held, wrap_lock
+
+_SHADOW = "_tpusan_val_"
+_instrumented: set = set()
+_instrument_lock = threading.Lock()
+
+
+def _check_access(obj, field: str, lock_attr: str, kind: str) -> None:
+    from tpustack import sanitize
+
+    if not sanitize.enabled():
+        return
+    lock = getattr(obj, lock_attr, None)
+    held = lock_held(lock)
+    if held is None or held:
+        return  # untracked lock (still in __init__) or properly held
+    sanitize.violation(
+        "guarded_by",
+        f"{kind} of {type(obj).__name__}.{field} (guarded-by: {lock_attr}) "
+        f"without holding it in thread {threading.current_thread().name!r} "
+        f"— take 'with self.{lock_attr}:' around the access, or register "
+        "the field runtime=False in tpustack/sanitize/registry.py with a "
+        "note explaining why the race is benign", stack=True)
+
+
+class _Checker:
+    """Bound access-checker a container proxy carries (pickles the field's
+    identity without holding the owner strongly)."""
+
+    __slots__ = ("_ref", "field", "lock_attr")
+
+    def __init__(self, obj, field: str, lock_attr: str):
+        import weakref
+
+        self._ref = weakref.ref(obj)
+        self.field = field
+        self.lock_attr = lock_attr
+
+    def __call__(self, kind: str) -> None:
+        obj = self._ref()
+        if obj is not None:
+            _check_access(obj, self.field, self.lock_attr, kind)
+
+
+def _make_checked(base, mutators):
+    """Build a subclass of ``base`` whose mutating methods verify lock
+    ownership first (``_tpusan_checker`` is set per instance; None — e.g.
+    after an unpickle — degrades to the plain container)."""
+    ns = {"_tpusan_checker": None}
+    for m in mutators:
+        if not hasattr(base, m):
+            continue
+
+        def make(mname):
+            def op(self, *a, **kw):
+                if self._tpusan_checker is not None:
+                    self._tpusan_checker(f"mutation (.{mname})")
+                return getattr(base, mname)(self, *a, **kw)
+            op.__name__ = mname
+            return op
+        ns[m] = make(m)
+    return type("Checked" + base.__name__.capitalize(), (base,), ns)
+
+
+_LIST_MUTATORS = ("append", "extend", "insert", "pop", "remove", "clear",
+                  "sort", "reverse", "__setitem__", "__delitem__",
+                  "__iadd__")
+_DEQUE_MUTATORS = ("append", "appendleft", "extend", "extendleft", "pop",
+                   "popleft", "remove", "clear", "insert", "rotate",
+                   "__setitem__", "__delitem__", "__iadd__")
+_DICT_MUTATORS = ("pop", "popitem", "clear", "update", "setdefault",
+                  "__setitem__", "__delitem__", "__ior__")
+
+_CheckedList = _make_checked(list, _LIST_MUTATORS)
+_CheckedDict = _make_checked(dict, _DICT_MUTATORS)
+_CheckedDeque = _make_checked(collections.deque, _DEQUE_MUTATORS)
+
+
+def _wrap_container(value, checker: _Checker):
+    """Rewrap a list/deque/dict value in its checking subclass; other
+    types pass through (numpy arrays, scalars, objects)."""
+    if type(value) is list:
+        out = _CheckedList(value)
+    elif type(value) is dict:
+        out = _CheckedDict(value)
+    elif type(value) is collections.deque:
+        out = _CheckedDeque(value, maxlen=value.maxlen)
+    else:
+        return value
+    out._tpusan_checker = checker
+    return out
+
+
+class _GuardedDescriptor:
+    """Data descriptor for one declared guarded field: stores under a
+    shadow key, checks lock ownership on every store/delete, and keeps
+    container values wrapped."""
+
+    __slots__ = ("field", "lock_attr", "shadow")
+
+    def __init__(self, field: str, lock_attr: str):
+        self.field = field
+        self.lock_attr = lock_attr
+        self.shadow = _SHADOW + field
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        try:
+            return obj.__dict__[self.shadow]
+        except KeyError:
+            raise AttributeError(
+                f"{objtype.__name__ if objtype else type(obj).__name__} "
+                f"object has no attribute {self.field!r}") from None
+
+    def __set__(self, obj, value):
+        _check_access(obj, self.field, self.lock_attr, "write")
+        obj.__dict__[self.shadow] = _wrap_container(
+            value, _Checker(obj, self.field, self.lock_attr))
+
+    def __delete__(self, obj):
+        _check_access(obj, self.field, self.lock_attr, "delete")
+        try:
+            del obj.__dict__[self.shadow]
+        except KeyError:
+            raise AttributeError(self.field) from None
+
+
+def _instrument_class(cls) -> Tuple[_GuardedDescriptor, ...]:
+    """Install the declared descriptors on ``cls`` (once)."""
+    key = (cls.__module__, cls.__name__)
+    with _instrument_lock:
+        if key in _instrumented:
+            return
+        specs = _registry.GUARDED.get(key, ())
+        for spec in specs:
+            if spec.runtime:
+                setattr(cls, spec.field,
+                        _GuardedDescriptor(spec.field, spec.lock))
+        _instrumented.add(key)
+
+
+def install_guards(obj) -> None:
+    """Activate runtime guarded-by enforcement for ``obj`` (call at the
+    END of ``__init__``).  No-op when the sanitizer is disabled or the
+    class has no registry entry — the disabled path costs one boolean
+    check and touches nothing."""
+    from tpustack import sanitize
+
+    if not sanitize.enabled():
+        return
+    cls = type(obj)
+    specs = _registry.GUARDED.get((cls.__module__, cls.__name__))
+    if not specs:
+        return
+    _instrument_class(cls)
+    runtime_specs = [s for s in specs if s.runtime]
+    # wrap the guard locks first (ownership tracking from here on)
+    for lock_attr in {s.lock for s in runtime_specs}:
+        lock = obj.__dict__.get(lock_attr)
+        if lock is not None:
+            obj.__dict__[lock_attr] = wrap_lock(
+                lock, f"{cls.__name__}.{lock_attr}")
+    # migrate init-time values into the shadow slots + container proxies
+    # (only the class's FIRST instance stored under the plain names —
+    # every later __init__ already went through the descriptor, which
+    # shadows and wraps on __set__)
+    for s in runtime_specs:
+        if s.field in obj.__dict__:
+            obj.__dict__[_SHADOW + s.field] = _wrap_container(
+                obj.__dict__.pop(s.field), _Checker(obj, s.field, s.lock))
+
+
+def assert_held(lock, what: str = "") -> None:
+    """Explicit checkpoint: violation unless the current thread/task holds
+    ``lock``.  Untracked locks (sanitizer off, or a raw lock) pass — the
+    checkpoint must not fire on the uninstrumented path."""
+    from tpustack import sanitize
+
+    if not sanitize.enabled():
+        return
+    held = lock_held(lock)
+    if held is False:
+        sanitize.violation(
+            "guarded_by",
+            f"checkpoint{f' ({what})' if what else ''}: "
+            f"{getattr(lock, 'name', lock)!r} is not held by "
+            f"{threading.current_thread().name!r}", stack=True)

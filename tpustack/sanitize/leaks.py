@@ -1,0 +1,187 @@
+"""Resource-leak checks at quiesce points: KV blocks, spans, threads.
+
+Leaks are invisible to lexical analysis by construction — the code that
+*should have run* (the decref, the ``span.end()``, the thread join) is
+exactly what's missing.  They are, however, trivially visible at quiesce
+points, where the expected state is exact:
+
+- **KV pool conservation** (:func:`check_kv_conservation`) — at any wave
+  boundary: the free list holds no duplicates, never the reserved block
+  0, only refcount-0 blocks; and free + referenced = capacity (a block
+  in neither state has fallen out of the accounting entirely).
+- **KV quiesce accounting** (:func:`check_kv_quiesce`) — at engine drain
+  with nothing queued or in flight: every used block must belong to the
+  prefix cache (refcount exactly 1 — the cache's own reference).  A
+  block still referenced by a retired/cancelled slot is a leak: paged
+  capacity shrinks forever, and admission starts 429ing below the real
+  HBM limit.
+- **span leaks** (:func:`check_span_leaks`) — a started-never-ended span
+  pins its whole trace in the tracer's live table until eviction (the
+  lexical TPL302 catches the obvious shapes; this catches the rest at
+  pytest teardown).
+- **thread leaks** (:func:`check_thread_leaks`) — a non-daemon thread
+  the suite leaves alive outlives pytest and wedges CI; the stack's own
+  long-lived threads are all daemon by convention, so anything non-daemon
+  and unexpected at teardown is a bug.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+#: non-daemon threads that are expected to be alive at teardown: the
+#: interpreter's main thread, executor pools (non-daemon since py3.9,
+#: joined by their own atexit hook), debugger machinery, and orbax's
+#: process-lifetime checkpoint pools ("metadata_store"/"base_pytree_ch"
+#: are renamed ThreadPoolExecutor threads the library keeps by design)
+THREAD_ALLOW_PREFIXES = ("MainThread", "ThreadPoolExecutor", "asyncio_",
+                         "pydevd", "Profile", "metadata_store",
+                         "base_pytree_ch")
+
+
+def check_kv_conservation(pool, where: str = "") -> None:
+    """Pool-internal invariants; cheap enough for every wave boundary."""
+    from tpustack import sanitize
+
+    if not sanitize.enabled():
+        return
+    at = f" at {where}" if where else ""
+    with pool._lock:
+        free = list(pool._free)
+        refd = [int(b) for b in range(pool.n_blocks) if pool._ref[b] > 0]
+    if len(set(free)) != len(free):
+        dupes = sorted({b for b in free if free.count(b) > 1})
+        sanitize.violation(
+            "kv_leak", f"free list holds duplicate block(s) {dupes}{at} — "
+            "a double-free: the same block will be handed to two slots")
+        return
+    if 0 in free:
+        sanitize.violation(
+            "kv_leak", f"reserved block 0 is on the free list{at} — "
+            "idle block-table entries point at it; allocating it corrupts "
+            "every idle row")
+        return
+    bad_free = sorted(set(free) & set(refd))
+    if bad_free:
+        sanitize.violation(
+            "kv_leak", f"block(s) {bad_free} are simultaneously free and "
+            f"referenced{at} — refcount/free-list drift")
+        return
+    if len(free) + len(refd) != pool.capacity_blocks:
+        lost = sorted(set(range(1, pool.n_blocks)) - set(free) - set(refd))
+        sanitize.violation(
+            "kv_leak",
+            f"conservation broken{at}: {len(free)} free + {len(refd)} "
+            f"referenced != capacity {pool.capacity_blocks} "
+            f"(unaccounted block(s): {lost}) — a block left the free list "
+            "without gaining a reference (or a decref skipped the list)")
+
+
+def _cache_resident(cache) -> List[int]:
+    with cache._lock:
+        return [n.block_id for n in cache._walk()]
+
+
+def check_kv_quiesce(runtime, external_refs: int = 0,
+                     where: str = "") -> None:
+    """Engine-drain accounting: used = cache-resident + external.
+
+    ``external_refs`` is the block count the caller knows is legitimately
+    held outside the pool+cache (the server's pre-allocated blocks for
+    still-queued requests).  Anything above that is a leaked slot
+    reference — the capacity is gone until process restart."""
+    from tpustack import sanitize
+
+    if not sanitize.enabled():
+        return
+    check_kv_conservation(runtime.pool, where=where)
+    at = f" at {where}" if where else ""
+    resident = _cache_resident(runtime.cache) if runtime.cache is not None \
+        else []
+    expected = len(resident) + external_refs
+    used = runtime.pool.n_used
+    if used != expected:
+        over = [b for b in range(1, runtime.pool.n_blocks)
+                if runtime.pool._ref[b] > 0 and b not in set(resident)]
+        sanitize.violation(
+            "kv_leak",
+            f"pool quiesce{at}: {used} block(s) in use but only "
+            f"{len(resident)} cache-resident + {external_refs} externally "
+            f"held are accounted for (suspects: {over[:16]}) — a retired/"
+            "cancelled request's blocks were never decref'd; paged "
+            "capacity shrinks until restart (engine failure path or a "
+            "cancel race dropped the release)")
+        return
+    # at quiesce, a cache-resident block is held by exactly the cache
+    over_refd = sorted(b for b in resident if runtime.pool.refcount(b) != 1)
+    if over_refd:
+        sanitize.violation(
+            "kv_leak",
+            f"pool quiesce{at}: cache-resident block(s) {over_refd[:16]} "
+            "hold extra references with no slot alive — a retire decref "
+            "went missing for a prefix-shared block")
+
+
+def check_span_leaks(tracer, where: str = "pytest teardown") -> List[str]:
+    """Open spans in ``tracer``'s live table.  Returns the reports (one
+    per trace) so the pytest plugin can aggregate across tracers; also
+    feeds :func:`tpustack.sanitize.violation` per leaked trace."""
+    from tpustack import sanitize
+
+    if not sanitize.enabled():
+        return []
+    reports = []
+    for trace_id, names in tracer.open_spans().items():
+        reports.append(
+            f"trace {trace_id} holds {len(names)} open span(s) "
+            f"{names[:8]} at {where} — every start_span needs a "
+            "guaranteed .end() (finally/with/ownership transfer; tpulint "
+            "TPL302 catches the lexical shapes)")
+    for r in reports:
+        sanitize.violation("span_leak", r)
+    return reports
+
+
+def check_thread_leaks(allow_prefixes: Optional[Sequence[str]] = None,
+                       where: str = "pytest teardown") -> List[str]:
+    """Non-daemon threads alive at teardown (beyond the allow list)."""
+    from tpustack import sanitize
+
+    if not sanitize.enabled():
+        return []
+    allow = tuple(allow_prefixes if allow_prefixes is not None
+                  else THREAD_ALLOW_PREFIXES)
+    main = threading.main_thread()
+    leaked = [t for t in threading.enumerate()
+              if t.is_alive() and not t.daemon and t is not main
+              and not t.name.startswith(allow)]
+    reports = [
+        f"non-daemon thread {t.name!r} still alive at {where} — it "
+        "outlives the process teardown; join it or mark it daemon "
+        "(the stack's long-lived service threads are all daemon)"
+        for t in leaked]
+    for r in reports:
+        sanitize.violation("thread_leak", r)
+    return reports
+
+
+def teardown_checks() -> List[str]:
+    """The pytest-teardown sweep: span leaks on the process-wide tracer +
+    thread leaks.  Runs in report-collection style (never raises, whatever
+    the mode) — the plugin turns a non-empty return into a red session."""
+    from tpustack import sanitize
+
+    if not sanitize.enabled():
+        return []
+    from tpustack.obs import trace as obs_trace
+
+    reports: List[str] = []
+    saved = sanitize._state["mode"]
+    sanitize._state["mode"] = "report"  # collect, don't raise, at teardown
+    try:
+        reports += check_span_leaks(obs_trace.TRACER)
+        reports += check_thread_leaks()
+    finally:
+        sanitize._state["mode"] = saved
+    return reports

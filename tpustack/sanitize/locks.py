@@ -1,0 +1,265 @@
+"""Tracked lock wrappers: ownership answers + the acquired-before graph.
+
+``threading.Lock`` cannot answer "does the CURRENT thread hold you?", so
+neither guarded-by enforcement nor deadlock detection can be built on raw
+locks.  :class:`TrackedLock` (sync) and :class:`TrackedAsyncLock`
+(asyncio) wrap a real lock and add exactly that:
+
+- **ownership** — ``held_by_current()``: the calling thread (sync) or the
+  calling task (asyncio) currently holds the lock.  Reentrant acquires of
+  a wrapped ``RLock`` are counted, so ``stats()``-style nesting works.
+- **acquired-before graph** — acquiring B while holding A records the
+  directed edge A→B (with the stack that first created it).  If B→…→A is
+  already on record, that acquisition is an AB/BA inversion — the classic
+  deadlock-in-waiting — and is reported with BOTH stacks, without needing
+  the unlucky interleaving that would actually deadlock.
+
+Graph nodes are per-lock-instance uids (never-reused monotonic ids), so
+two unrelated instances of the same class can never manufacture a false
+cycle; the report still prints the human name (``KVBlockPool._lock``).
+Sync locks scope their held-set per thread; asyncio locks per task (two
+tasks interleaving on one event-loop thread must not see each other's
+held locks).
+
+Everything here is active only while the sanitizer is enabled — the
+wrappers are only ever installed by ``install_guards``/tests, never on
+the ``TPUSTACK_SANITIZE=0`` path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+_UIDS = itertools.count(1)
+
+# the global acquired-before graph: uid -> {uid -> (names, stack)} where
+# stack is the formatted acquisition stack that FIRST recorded the edge
+_graph_lock = threading.Lock()
+_EDGES: Dict[int, Dict[int, Tuple[str, str]]] = {}
+_NAMES: Dict[int, str] = {}
+# inversions already reported, as (held uid, acquiring uid) — an inverted
+# pair on a per-request path must report ONCE, not once per acquire
+# (report mode would otherwise drown the log; same rationale as
+# CompileWatch._reported).  The inverted edge is never added to _EDGES —
+# the graph stays acyclic so later DFS answers stay meaningful.
+_REPORTED: set = set()
+
+# held tracked locks per execution scope: thread ident for sync locks,
+# (thread ident, task id) for asyncio locks
+_tls = threading.local()
+_task_held: Dict[int, List[int]] = {}
+
+
+def _fmt_stack(limit: int = 10) -> str:
+    # drop the two innermost frames (this helper + the acquire wrapper)
+    return "".join(traceback.format_stack(limit=limit)[:-2])
+
+
+def _find_path(src: int, dst: int) -> Optional[List[int]]:
+    """DFS over _EDGES (caller holds _graph_lock); path src→…→dst."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _EDGES.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _record_edges(held: List[int], acquiring_uid: int, name: str) -> None:
+    """Record held→acquiring edges; report a cycle if the reverse order is
+    already on file.  Called BEFORE blocking on the inner lock, so the
+    report fires even when the actual deadlock interleaving never
+    happens."""
+    from tpustack import sanitize
+
+    with _graph_lock:
+        _NAMES[acquiring_uid] = name
+        for h in held:
+            if h == acquiring_uid:
+                continue  # reentrant
+            edges = _EDGES.setdefault(h, {})
+            if acquiring_uid in edges:
+                continue  # order already on record — nothing new to learn
+            path = _find_path(acquiring_uid, h)
+            if path is not None:
+                if (h, acquiring_uid) in _REPORTED:
+                    continue  # this inversion already reported once
+                _REPORTED.add((h, acquiring_uid))
+                chain = " -> ".join(_NAMES.get(u, f"lock#{u}") for u in path)
+                prior = _EDGES[path[0]][path[1]][1]
+                sanitize.violation(
+                    "lock_order",
+                    f"acquiring {name} while holding "
+                    f"{_NAMES.get(h, f'lock#{h}')} inverts the recorded "
+                    f"order {chain} — a concurrent run of both paths "
+                    "deadlocks.  Fix: acquire these locks in one global "
+                    f"order everywhere.\n--- this acquisition ---\n"
+                    f"{_fmt_stack()}--- recorded {chain.split(' -> ')[0]} "
+                    f"-> {chain.split(' -> ')[1]} at ---\n{prior}")
+                continue  # report mode: still record the other held edges
+            edges[acquiring_uid] = (f"{_NAMES.get(h)}->{name}", _fmt_stack())
+
+
+def _reset_graph() -> None:
+    """Test isolation: drop every recorded edge."""
+    with _graph_lock:
+        _EDGES.clear()
+        _NAMES.clear()
+        _REPORTED.clear()
+
+
+def _thread_held() -> List[int]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+class TrackedLock:
+    """A ``threading.Lock``/``RLock`` wrapper with ownership + order
+    tracking.  Drop-in for the ``with``/``acquire``/``release``/
+    ``locked`` surface the stack uses."""
+
+    __slots__ = ("_inner", "name", "uid", "_owner", "_count")
+
+    def __init__(self, inner=None, name: str = ""):
+        self._inner = inner if inner is not None else threading.Lock()
+        self.uid = next(_UIDS)
+        self.name = name or f"lock#{self.uid}"
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        # only an indefinitely-BLOCKING fresh acquisition seeds order
+        # edges (recorded before blocking, so the inversion reports even
+        # without the unlucky interleaving): a trylock / timed acquire is
+        # the deadlock-AVOIDANCE idiom — it backs off instead of waiting,
+        # so it can neither deadlock nor define an ordering constraint
+        if self._owner != me and blocking and timeout < 0:
+            _record_edges(list(_thread_held()), self.uid, self.name)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if self._owner != me:
+                self._owner = me
+                _thread_held().append(self.uid)
+            self._count += 1
+        return ok
+
+    def release(self) -> None:
+        me = threading.get_ident()
+        if self._owner == me:
+            self._count -= 1
+            if self._count == 0:
+                self._owner = None
+                held = _thread_held()
+                if self.uid in held:
+                    held.remove(self.uid)
+        self._inner.release()
+
+    def held_by_current(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    # back-compat alias used in docs/tests
+    held_by_current_thread = held_by_current
+
+    def locked(self) -> bool:
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else self._owner is not None
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<TrackedLock {self.name} owner={self._owner}>"
+
+
+class TrackedAsyncLock:
+    """An ``asyncio.Lock`` wrapper with per-task ownership + order
+    tracking.  Covers the ``async with`` surface the servers use."""
+
+    __slots__ = ("_inner", "name", "uid", "_owner_task")
+
+    def __init__(self, inner=None, name: str = ""):
+        self._inner = inner if inner is not None else asyncio.Lock()
+        self.uid = next(_UIDS)
+        self.name = name or f"alock#{self.uid}"
+        self._owner_task: Optional[int] = None
+
+    @staticmethod
+    def _task_id() -> Optional[int]:
+        try:
+            task = asyncio.current_task()
+        except RuntimeError:
+            return None
+        return id(task) if task is not None else None
+
+    async def acquire(self) -> bool:
+        tid = self._task_id()
+        if tid is not None:
+            _record_edges(list(_task_held.get(tid, ())), self.uid, self.name)
+        await self._inner.acquire()
+        self._owner_task = tid
+        if tid is not None:
+            _task_held.setdefault(tid, []).append(self.uid)
+        return True
+
+    def release(self) -> None:
+        tid = self._owner_task
+        self._owner_task = None
+        if tid is not None:
+            held = _task_held.get(tid)
+            if held and self.uid in held:
+                held.remove(self.uid)
+            if held is not None and not held:
+                _task_held.pop(tid, None)
+        self._inner.release()
+
+    def held_by_current(self) -> bool:
+        tid = self._task_id()
+        return tid is not None and self._owner_task == tid
+
+    held_by_current_task = held_by_current
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    async def __aenter__(self) -> "TrackedAsyncLock":
+        await self.acquire()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<TrackedAsyncLock {self.name}>"
+
+
+def lock_held(lock) -> Optional[bool]:
+    """Does the current thread/task hold ``lock``?  None when the lock is
+    not a tracked wrapper (no basis to judge — callers must not flag)."""
+    if isinstance(lock, (TrackedLock, TrackedAsyncLock)):
+        return lock.held_by_current()
+    return None
+
+
+def wrap_lock(lock, name: str = ""):
+    """Wrap a raw lock in its tracked counterpart (idempotent)."""
+    if isinstance(lock, (TrackedLock, TrackedAsyncLock)):
+        return lock
+    if isinstance(lock, asyncio.Lock):
+        return TrackedAsyncLock(lock, name)
+    return TrackedLock(lock, name)

@@ -1,0 +1,46 @@
+"""pytest plugin: run the whole suite under the runtime sanitizers.
+
+Loaded via ``pytest_plugins`` in ``tests/conftest.py``.  It:
+
+- defaults ``TPUSTACK_SANITIZE=1`` + ``TPUSTACK_SANITIZE_MODE=raise`` for
+  the run (and every subprocess the suite spawns — the resilience/chaos
+  tests inherit the environment), so tier-1 IS the sanitizer-enabled run;
+  an explicit ``TPUSTACK_SANITIZE=0`` in the caller's environment wins
+  (bisection: the uninstrumented suite);
+- at session finish, sweeps the teardown checks (open spans on the
+  process-wide tracer, leaked non-daemon threads) and turns any finding
+  into a red session with the full reports printed.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pytest_configure(config):
+    os.environ.setdefault("TPUSTACK_SANITIZE", "1")
+    os.environ.setdefault("TPUSTACK_SANITIZE_MODE", "raise")
+    from tpustack import sanitize
+
+    sanitize.refresh()  # re-resolve from the env just set
+
+
+def pytest_sessionfinish(session, exitstatus):
+    from tpustack import sanitize
+
+    if not sanitize.enabled():
+        return
+    reports = sanitize.teardown_checks()
+    if reports:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        lines = ["tpusan teardown violations "
+                 f"({len(reports)}):"] + [f"  - {r}" for r in reports]
+        if tr is not None:
+            tr.write_line("")
+            for line in lines:
+                tr.write_line(line, red=True)
+        else:  # pragma: no cover - terminalreporter always present in CI
+            print("\n".join(lines))
+        # wrap_session returns session.exitstatus after this hook — a
+        # leak at teardown must fail the run, not just print
+        session.exitstatus = 1

@@ -1,0 +1,109 @@
+"""The sanitizer's guarded-field declaration table.
+
+Every ``# guarded-by:`` annotation in the serving/engine modules (the
+convention tpulint's TPL201 enforces lexically) is ALSO declared here, so
+the runtime layer knows what to instrument — and tpulint's TPL203
+cross-checks annotation ↔ registry BOTH ways (an annotation with no
+declaration, a stale declaration, or a lock/writes mismatch fails lint,
+the same drift contract TPL402 runs for knobs).
+
+``runtime=False`` opts a field out of runtime enforcement while keeping
+it declared (TPL203 still sees it): use it for reviewed cross-context
+guards the ownership check cannot model — e.g. ``LLMServer._engine``,
+written from the executor thread WHILE the event-loop task holds the
+asyncio device lock (the lexical TPL201 suppression at the write site
+documents the same fact).
+
+Runtime semantics per field (see ``tpustack.sanitize.guarded``):
+rebinds/scalar stores are checked via a data descriptor; list/deque/dict
+values are wrapped in checking proxies so container MUTATIONS
+(``append``/``pop``/``__setitem__``/...) are checked too; reads are
+covered lexically by TPL201 (benign racy reads are an accepted pattern
+for ``(writes)`` fields, and runtime read checks would flag test
+introspection).  numpy-array fields (``KVBlockPool._ref``/``_filled``)
+cannot be proxied and rely on the lexical rule plus the pool
+conservation checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardedSpec:
+    """One declared guarded field: its lock attribute, whether the
+    annotation is writes-only, and whether the runtime layer enforces
+    it (``note`` says why not when it doesn't)."""
+
+    field: str
+    lock: str
+    writes_only: bool = False
+    runtime: bool = True
+    note: str = ""
+
+
+def _s(field, lock, writes_only=False, runtime=True, note=""):
+    return GuardedSpec(field, lock, writes_only, runtime, note)
+
+
+#: (module, class) -> declared guarded fields.  Keep in lock-step with the
+#: ``# guarded-by:`` annotations — tpulint TPL203 fails on any drift.
+GUARDED: Dict[Tuple[str, str], Tuple[GuardedSpec, ...]] = {
+    ("tpustack.serving.resilience", "FaultInjector"): (
+        _s("dispatches", "_lock", writes_only=True),
+        _s("waves", "_lock", writes_only=True),
+        _s("_sigterm_fired", "_lock", writes_only=True),
+    ),
+    ("tpustack.serving.resilience", "ResilienceManager"): (
+        _s("_inflight", "_lock", writes_only=True),
+        _s("_service_times", "_lock"),
+    ),
+    ("tpustack.serving.kv_pool", "KVBlockPool"): (
+        _s("_free", "_lock", writes_only=True),
+        _s("_ref", "_lock", writes_only=True,
+           note="numpy array: element stores are invisible to the "
+                "descriptor; covered by TPL201 + conservation checks"),
+        _s("_filled", "_lock", writes_only=True,
+           note="numpy array, as _ref"),
+        _s("allocated_blocks_total", "_lock", writes_only=True),
+        _s("freed_blocks_total", "_lock", writes_only=True),
+    ),
+    ("tpustack.serving.kv_pool", "PagedPrefixCache"): (
+        _s("_root", "_lock", writes_only=True),
+        _s("_tick", "_lock", writes_only=True),
+    ),
+    ("tpustack.serving.sd_server", "SDServer"): (
+        _s("_inflight", "_lock"),
+    ),
+    ("tpustack.serving.llm_server", "LLMServer"): (
+        _s("_engine", "_lock", writes_only=True, runtime=False,
+           note="written from the executor thread while the event-loop "
+                "task holds the asyncio device lock — a real guard the "
+                "per-task ownership check cannot model (the lexical "
+                "TPL201 suppression at the write site says the same)"),
+    ),
+    ("tpustack.serving.graph_server", "WanRuntime"): (
+        _s("_pipeline", "_lock"),
+    ),
+    ("tpustack.serving.graph_server", "GraphExecutor"): (
+        _s("_counter", "_counter_lock"),
+    ),
+    ("tpustack.serving.graph_server", "GraphServer"): (
+        _s("_pending", "_lock"),
+        _s("_prompt_spans", "_lock"),
+        _s("_history", "_lock"),
+        _s("_running", "_lock"),
+        _s("_deadline_at", "_lock"),
+        _s("_t_submit", "_lock"),
+    ),
+    ("tpustack.models.llm_continuous", "ContinuousEngine"): (
+        _s("_fetch_marks", "_marks_lock"),
+    ),
+}
+
+#: module -> repo-relative file, for tpulint TPL203's annotation parse
+MODULE_FILES: Dict[str, str] = {
+    mod: mod.replace(".", "/") + ".py" for mod, _ in GUARDED
+}
