@@ -38,19 +38,20 @@ def test_llm_extras_schema(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     out = bench._llm_extras(lambda *a: None)
     assert set(out) == {"continuous_e2e", "prefill_8k", "shared_prefix",
-                        "paged", "speculative"}
+                        "paged", "speculative", "tp"}
     for sub in out.values():
         assert sub["value"] == 1.0
         assert sub["steady_decode_tokens_per_sec"] == 2.0
         assert "ignored_key" not in sub
-    # the five bench_llm invocations: batch-8 continuous + the 8k prefill
+    # the six bench_llm invocations: batch-8 continuous + the 8k prefill
     # + the shared-prefix (prefix KV cache) + the paged-KV sweep + the
-    # speculative-decoding sweep workloads
+    # speculative-decoding sweep + the tensor-parallel sweep workloads
     assert any("--continuous" in c for c in calls)
     assert any("8192" in c for c in calls)
     assert any("--shared-prefix" in c for c in calls)
     assert any("--paged" in c for c in calls)
     assert any("--speculative" in c for c in calls)
+    assert any("--tp" in c for c in calls)
 
 
 def test_wan_extras_schema(monkeypatch):

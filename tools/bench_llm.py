@@ -262,6 +262,118 @@ def _paged_bench(args, gen, cfg, log) -> int:
     return 0
 
 
+def _tp_bench(args, gen, cfg, log) -> int:
+    """``--tp N``: the tensor-parallel serving sweep — the continuous
+    engine (the served path) run UNSHARDED then over a (1, 1, N, 1) mesh
+    with the same weights, dense and paged, asserting greedy outputs
+    byte-identical tp-on vs tp-off.  Reports end-to-end + steady tokens/s,
+    TTFT/TPOT p50-p99, and the per-chip HBM bill (weights + KV largest
+    single-device shard) in each mode — the latency/model-size trade the
+    mesh exists for.  On real hardware tp=N needs N chips; short device
+    counts emit an error record instead of crashing the extras run."""
+    import jax
+
+    from tpustack.models.llama import init_kv_pool
+    from tpustack.models.llm_continuous import ContinuousEngine, SlotRequest
+    from tpustack.models.llm_generate import Generator, SampleConfig
+    from tpustack.parallel import build_mesh
+    from tpustack.parallel.sharding import tree_per_shard_bytes
+    from tpustack.serving.kv_pool import KVBlockPool, PagedKVRuntime
+
+    tp = args.tp
+    if len(jax.devices()) < tp:
+        print(json.dumps({
+            "metric": f"{args.preset}_tp{tp}_continuous_e2e_tokens_per_sec",
+            "error": f"tp={tp} needs {tp} devices, "
+                     f"{len(jax.devices())} visible"}))
+        return 0
+    mesh = build_mesh((1, 1, tp, 1), devices=jax.devices()[:tp])
+    tp_gen = Generator(cfg, params=jax.device_get(gen.params),
+                       dtype=gen.cache_dtype, mesh=mesh)
+    ctx, vocab = cfg.max_seq, cfg.vocab_size
+    new = min(args.new_tokens, ctx // 2)
+    p_len = min(args.prompt_tokens, ctx - new - 1)
+    batch = max(1, min(args.batch if args.batch > 1 else 4, 8))
+    n_req = 2 * batch
+    chunk = min(args.chunk, new, 16)
+    reqs = [[(5 + i) % (vocab - 1) + 1]
+            + [(11 + i + j) % (vocab - 1) + 1 for j in range(p_len - 1)]
+            for i in range(n_req)]
+
+    def make_rt(g):
+        block = max(1, min(args.kv_block, ctx))
+        while block > 1 and ctx % block:
+            block //= 2
+        cap = batch * (ctx // block)
+        pool = KVBlockPool(cap + 1, block)
+        return PagedKVRuntime(
+            init_kv_pool(cfg, cap + 1, block, dtype=g.cache_dtype,
+                         mesh=g.kv_mesh), pool, ctx)
+
+    def run_fleet(g, paged):
+        rt = make_rt(g) if paged else None
+        eng = ContinuousEngine(g, slots=batch, chunk=chunk, paged=rt)
+        results = {}
+        queue = [SlotRequest(ids=ids, max_new=new,
+                             sample=SampleConfig(greedy=True),
+                             on_done=lambda t, s, i=i:
+                             results.__setitem__(i, (t, s)))
+                 for i, ids in enumerate(reqs)]
+        stats = eng.run(lambda: queue.pop(0) if queue else None)
+        per = [st for _, st in results.values()]
+        ttfts = sorted(st["prefill_s"] for st in per)
+        tpots = sorted(st["decode_s"] / max(1, st["generated_tokens"] - 1)
+                       for st in per)
+        q = lambda xs, p: xs[min(len(xs) - 1, int(round(p * (len(xs) - 1))))]
+        cell = {
+            "tokens_per_s": round(stats["tokens_per_s"], 2),
+            "steady_tokens_per_s": round(
+                stats.get("steady_tokens_per_s", 0.0), 2),
+            "ttft_p50_ms": round(q(ttfts, 0.50) * 1e3, 2),
+            "ttft_p99_ms": round(q(ttfts, 0.99) * 1e3, 2),
+            "tpot_p50_ms": round(q(tpots, 0.50) * 1e3, 2),
+            "tpot_p99_ms": round(q(tpots, 0.99) * 1e3, 2),
+            "weights_per_chip_bytes": tree_per_shard_bytes(g.params),
+            "kv_per_chip_bytes": (rt.per_shard_bytes if rt is not None
+                                  else None),
+        }
+        return results, cell
+
+    sweep = []
+    identical = True
+    for mode, paged in (("dense", False), ("paged", True)):
+        run_fleet(gen, paged)       # warm (compile) — uncounted
+        run_fleet(tp_gen, paged)
+        res_off, off = run_fleet(gen, paged)
+        res_on, on = run_fleet(tp_gen, paged)
+        same = all(res_off[i][0] == res_on[i][0] for i in range(n_req))
+        identical = identical and same
+        sweep.append({"mode": mode, "batch": batch, "tp_off": off,
+                      "tp_on": on, "outputs_identical": same})
+        log(f"[bench_llm] tp sweep {mode} batch {batch}: tp=1 "
+            f"{off['tokens_per_s']} tok/s vs tp={tp} {on['tokens_per_s']} "
+            f"tok/s (per-chip weights {on['weights_per_chip_bytes'] / 1e9:.2f}"
+            f" GB vs {off['weights_per_chip_bytes'] / 1e9:.2f} GB, "
+            f"identical={same})")
+    if not identical:
+        log("[bench_llm] WARNING: tp outputs diverged from unsharded")
+    paged_cell = sweep[1]
+    print(json.dumps({
+        "metric": f"{args.preset}_{args.quant or 'bf16'}_ctx{args.ctx}"
+                  f"_tp{tp}_continuous_e2e_tokens_per_sec",
+        "value": paged_cell["tp_on"]["tokens_per_s"],
+        "unit": "tokens/s",
+        "tp_ways": tp,
+        "batch": batch,
+        "sweep": sweep,
+        "outputs_identical": identical,
+        "weights_per_chip_bytes": paged_cell["tp_on"]
+        ["weights_per_chip_bytes"],
+        "kv_per_chip_bytes": paged_cell["tp_on"]["kv_per_chip_bytes"],
+    }))
+    return 0
+
+
 def _speculative_bench(args, gen, cfg, log) -> int:
     """``--speculative``: the bandwidth-amortisation workload speculative
     decoding exists for — the continuous engine run spec OFF then spec ON
@@ -453,6 +565,12 @@ def main() -> int:
     p.add_argument("--max-paged-slots", type=int, default=32,
                    help="paged mode: engine slot ceiling (each slot count "
                         "compiles its own decode program)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel sweep: the continuous engine "
+                        "unsharded vs over a tp=N mesh (dense AND paged), "
+                        "reporting tok/s, TTFT/TPOT p50-p99 and per-chip "
+                        "weight/KV HBM, greedy outputs asserted identical "
+                        "(LLM_TP analog; needs N devices)")
     args = p.parse_args()
     if args.tiny:
         args.preset = "tiny"
@@ -460,6 +578,9 @@ def main() -> int:
         args.dense_slots = min(args.dense_slots, 2)
         args.kv_block = min(args.kv_block, 16)
         args.max_paged_slots = min(args.max_paged_slots, 8)
+        if args.tp:
+            args.batch = min(args.batch if args.batch > 1 else 2, 2)
+            args.new_tokens = min(args.new_tokens, 16)
 
     import jax
     import jax.numpy as jnp
@@ -511,6 +632,8 @@ def main() -> int:
         gen = Generator(cfg, params=params, dtype=dtype)
     log(f"[bench_llm] init {time.time() - t0:.1f}s")
 
+    if args.tp:
+        return _tp_bench(args, gen, cfg, log)
     if args.paged:
         return _paged_bench(args, gen, cfg, log)
     if args.speculative:

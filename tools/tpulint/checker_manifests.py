@@ -290,6 +290,78 @@ def _check_train_ckpt_contract(where: str, doc, errors: List[str]) -> None:
                 "SIGKILL could land mid-checkpoint-flush")
 
 
+#: env vars that declare device-level parallelism; a container's
+#: google.com/tpu request must equal their product (divided across the
+#: processes of a multi-host JobSet)
+_PARALLELISM_ENVS = ("LLM_TP", "SD15_DP")
+
+
+def _check_tpu_parallelism(where: str, doc, errors: List[str]) -> None:
+    """The accelerator request must match the declared parallelism: a
+    container setting LLM_TP / SD15_DP must request exactly their product
+    in google.com/tpu chips (per host: the global product divides by
+    NUM_PROCESSES on multi-host JobSets), and a serving container
+    requesting >1 chip must say HOW it uses them — this is the rule that
+    catches the 1-chip-manifest-vs-tp-comment drift the tp rehearsal era
+    left behind (a pod requesting 8 chips while the server builds no mesh
+    wastes 7, and LLM_TP=8 on a 1-chip pod fails at mesh build)."""
+    for tmpl in _pod_templates(doc):
+        for container in (tmpl.get("spec", {}).get("containers") or []):
+            cname = container.get("name")
+            res = container.get("resources") or {}
+            tpu = None
+            for section in ("limits", "requests"):
+                if "google.com/tpu" in (res.get(section) or {}):
+                    tpu = int(res[section]["google.com/tpu"])
+                    break
+            declared = {}
+            for name in _PARALLELISM_ENVS + ("NUM_PROCESSES",):
+                raw = _env_value(container, name)
+                if raw is None:
+                    continue
+                try:
+                    declared[name] = int(raw)
+                except (TypeError, ValueError):
+                    errors.append(f"{where}: container {cname!r} env "
+                                  f"{name}={raw!r} is not an integer")
+            hosts = max(1, declared.pop("NUM_PROCESSES", 1))
+            if declared and all(v <= 1 for v in declared.values()) \
+                    and tpu is None:
+                # explicit off-switches (LLM_TP=0/1, SD15_DP=1) on a
+                # container that requests no accelerator — a CPU-only
+                # smoke/dev manifest, not a drift
+                declared = {}
+            if declared:
+                product = 1
+                for v in declared.values():
+                    product *= max(1, v)  # LLM_TP=0 means single-chip
+                if product % hosts:
+                    errors.append(
+                        f"{where}: container {cname!r} parallelism product "
+                        f"{product} does not divide across NUM_PROCESSES="
+                        f"{hosts} hosts")
+                    continue
+                expect = product // hosts
+                if (tpu or 0) != expect:
+                    errors.append(
+                        f"{where}: container {cname!r} declares "
+                        + "x".join(f"{k}={v}" for k, v in declared.items())
+                        + (f" over {hosts} hosts" if hosts > 1 else "")
+                        + f" but requests google.com/tpu: {tpu} "
+                        f"(want {expect}) — the mesh build and the "
+                        "scheduler would disagree about chip count")
+            elif tpu and tpu > 1:
+                argv = [str(a) for a in ((container.get("command") or [])
+                                         + (container.get("args") or []))]
+                if any("tpustack.serving" in a for a in argv):
+                    errors.append(
+                        f"{where}: serving container {cname!r} requests "
+                        f"google.com/tpu: {tpu} but declares no "
+                        f"{'/'.join(_PARALLELISM_ENVS)} env — the server "
+                        "would build a 1-chip mesh and idle "
+                        f"{tpu - 1} chips")
+
+
 def lint(root: Path = None) -> List[str]:
     """Return a list of violation strings (empty = clean)."""
     root = Path(root) if root is not None else REPO / "cluster-config"
@@ -325,6 +397,7 @@ def lint(root: Path = None) -> List[str]:
             _check_drain_consistency(where, doc, errors)
             _check_train_ckpt_contract(where, doc, errors)
             _check_prober_contract(where, doc, errors)
+            _check_tpu_parallelism(where, doc, errors)
     return errors
 
 

@@ -45,6 +45,8 @@ UNIT_SUFFIXES = (
     "depth", "slots", "tokens", "images", "requests", "entries", "prompts",
     # paged-KV pool accounting (fixed-size KV blocks, kv_pool.py)
     "blocks",
+    # mesh-shape accounting (devices per mesh axis, parallel/mesh.py)
+    "chips",
     # enum gauges (value is a documented small-integer state machine)
     "state",
     # index gauges (value identifies a position, e.g. the last-saved

@@ -404,21 +404,38 @@ class LlamaModel(nn.Module):
         return logits, new_caches
 
 
-def init_kv_caches(cfg: LlamaConfig, batch: int, dtype=jnp.bfloat16):
+def _shard_kv(caches, cfg: "LlamaConfig", mesh):
+    """Serving-KV head-axis sharding (``parallel.sharding.shard_kv_tree``):
+    host call sites pass the tp mesh so every cache/pool/buffer tensor
+    lands split over its kv-head axis — the per-chip KV HBM bill divides
+    by tp and decode's cache traffic stays chip-local.  ``mesh=None`` (and
+    every in-graph/traced call, which never passes one) is byte-for-byte
+    the unsharded layout, GSPMD propagation untouched."""
+    if mesh is None:
+        return caches
+    from tpustack.parallel.sharding import shard_kv_tree
+
+    return shard_kv_tree(caches, mesh, cfg.n_kv_heads)
+
+
+def init_kv_caches(cfg: LlamaConfig, batch: int, dtype=jnp.bfloat16,
+                   mesh=None):
     shape = (batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim)
     if cfg.kv_quant == "int8":
         sshape = shape[:-1]  # one scale per cached K/V vector
-        return [{"k": jnp.zeros(shape, jnp.int8),
-                 "k_scale": jnp.zeros(sshape, jnp.float32),
-                 "v": jnp.zeros(shape, jnp.int8),
-                 "v_scale": jnp.zeros(sshape, jnp.float32)}
-                for _ in range(cfg.n_layers)]
-    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-            for _ in range(cfg.n_layers)]
+        caches = [{"k": jnp.zeros(shape, jnp.int8),
+                   "k_scale": jnp.zeros(sshape, jnp.float32),
+                   "v": jnp.zeros(shape, jnp.int8),
+                   "v_scale": jnp.zeros(sshape, jnp.float32)}
+                  for _ in range(cfg.n_layers)]
+    else:
+        caches = [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                  for _ in range(cfg.n_layers)]
+    return _shard_kv(caches, cfg, mesh)
 
 
 def init_kv_pool(cfg: LlamaConfig, n_blocks: int, block: int,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, mesh=None):
     """Per-layer PAGED KV pool tensors: ``[n_blocks, block, kv_heads,
     head_dim]`` (+ per-vector scales when the cache is int8).  The paged
     serving substrate (``tpustack.serving.kv_pool``): a sequence's cache
@@ -431,13 +448,15 @@ def init_kv_pool(cfg: LlamaConfig, n_blocks: int, block: int,
     shape = (n_blocks, block, cfg.n_kv_heads, cfg.head_dim)
     if cfg.kv_quant == "int8":
         sshape = shape[:-1]
-        return [{"k": jnp.zeros(shape, jnp.int8),
+        pool = [{"k": jnp.zeros(shape, jnp.int8),
                  "k_scale": jnp.zeros(sshape, jnp.float32),
                  "v": jnp.zeros(shape, jnp.int8),
                  "v_scale": jnp.zeros(sshape, jnp.float32)}
                 for _ in range(cfg.n_layers)]
-    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-            for _ in range(cfg.n_layers)]
+    else:
+        pool = [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                for _ in range(cfg.n_layers)]
+    return _shard_kv(pool, cfg, mesh)
 
 
 def init_chunk_bufs(cfg: LlamaConfig, batch: int, chunk: int,

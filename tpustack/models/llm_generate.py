@@ -54,18 +54,41 @@ class Generator:
     """Holds params + compiled prefill/decode programs."""
 
     def __init__(self, config: LlamaConfig, params: Optional[Dict] = None,
-                 dtype=jnp.bfloat16, seed: int = 0, mesh=None, rules=None):
+                 dtype=jnp.bfloat16, seed: int = 0, mesh=None, rules=None,
+                 shard_kv: bool = True):
         """``mesh``: optional ``jax.sharding.Mesh`` — tensor-parallel
         serving.  Params shard per ``rules`` (default ``LLAMA_RULES``: qkv/
         gate column-wise, o/down row-wise over the ``tp`` axis) and every
         compiled prefill/decode program is GSPMD-partitioned across the mesh,
         with XLA inserting the ICI collectives — this is how models larger
         than one chip's HBM serve (e.g. 70B over v5e-8), the inference-side
-        counterpart of the training mesh (SURVEY §2.10)."""
+        counterpart of the training mesh (SURVEY §2.10).
+
+        ``shard_kv`` (with a mesh): host-allocated KV caches and paged pool
+        tensors are placed EXPLICITLY head-axis-sharded over ``tp``
+        (``kv_mesh`` — passed by the serving call sites into
+        ``init_kv_caches``/``init_kv_pool``), so the per-chip KV HBM bill
+        divides by tp deterministically instead of riding GSPMD's
+        propagation choice.  False (``LLM_SHARD_KV=0``) is the bisection
+        path: mesh-partitioned compute, compiler-placed caches — the
+        pre-tp-serving behavior."""
         self.cfg = config
         self.model = LlamaModel(config, dtype=dtype)
         self.cache_dtype = dtype
         self.mesh = mesh
+        #: mesh the serving KV substrate shards over (None = unsharded
+        #: caches even when compute is mesh-partitioned)
+        self.kv_mesh = mesh if shard_kv else None
+        if self.kv_mesh is not None and "tp" in self.kv_mesh.axis_names:
+            tp_ways = int(self.kv_mesh.shape["tp"])
+            if tp_ways > 1 and config.n_kv_heads % tp_ways:
+                # GQA at high tp: the KV substrate REPLICATES per chip —
+                # correct, but the per-chip HBM bill does not divide; size
+                # batch/ctx from the replicated figure (/props reports it)
+                log.warning(
+                    "%d KV heads do not divide tp=%d: serving KV caches "
+                    "replicate per chip (weights still shard)",
+                    config.n_kv_heads, tp_ways)
         if params is None:
             log.warning("Initialising %s-layer LLM with RANDOM weights", config.n_layers)
             tokens = jnp.zeros((1, 8), jnp.int32)
@@ -111,7 +134,7 @@ class Generator:
     @classmethod
     def from_checkpoint(cls, config: LlamaConfig, model_dir: str,
                         dtype=jnp.bfloat16, mesh=None,
-                        rules=None) -> "Generator":
+                        rules=None, shard_kv: bool = True) -> "Generator":
         """Load HF safetensors without materialising a random template first
         (jax.eval_shape gives the converter shapes at zero device cost).
         With ``config.quant`` the bf16 checkpoint is quantised in one jitted
@@ -144,7 +167,8 @@ class Generator:
                                         shardings=shardings)
         if config.quant:
             params = cls._quantize(config, params)
-        return cls(config, params=params, dtype=dtype, mesh=mesh, rules=rules)
+        return cls(config, params=params, dtype=dtype, mesh=mesh, rules=rules,
+                   shard_kv=shard_kv)
 
     # -------------------------------------------------------------- compiled
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(4,))
@@ -1222,7 +1246,8 @@ class Generator:
         tokens = np.zeros((b, bucket), np.int32)
         for i, p in enumerate(prompts):
             tokens[i, :len(p)] = p
-        caches = init_kv_caches(c, b, dtype=self.cache_dtype)
+        caches = init_kv_caches(c, b, dtype=self.cache_dtype,
+                                mesh=self.kv_mesh)
         lengths = jnp.asarray(lens, jnp.int32)
         if bucket > self.PREFILL_CHUNK:
             logits, caches = self._prefill_long(tokens, lengths, caches)
@@ -1383,11 +1408,13 @@ class Generator:
                     jnp.asarray(n_cached, jnp.int32), length, prefix_dev)
             else:
                 caches = self._restore_kv_rows(
-                    init_kv_caches(c, 1, dtype=self.cache_dtype), prefix_dev)
+                    init_kv_caches(c, 1, dtype=self.cache_dtype,
+                                   mesh=self.kv_mesh), prefix_dev)
                 logits, caches = self._prefill_from(tokens, n_cached, length,
                                                     caches)
         else:
-            caches = init_kv_caches(c, 1, dtype=self.cache_dtype)
+            caches = init_kv_caches(c, 1, dtype=self.cache_dtype,
+                                    mesh=self.kv_mesh)
             bucket = self._bucket(n_prompt)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :n_prompt] = prompt_tokens

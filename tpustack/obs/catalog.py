@@ -136,6 +136,29 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "the verify degenerated to a plain decode step).",
                buckets=(0, 1, 2, 3, 4, 6, 8, 16), unit="tokens"),
 
+    # ---- serving mesh (tensor/data-parallel GSPMD serving) ----
+    MetricSpec("tpustack_mesh_axis_chips", "gauge",
+               "Serving-mesh axis sizes (dp/fsdp/tp/sp ways) of the "
+               "process's device mesh; every axis 1 (or the series "
+               "absent) means unsharded single-chip serving.",
+               ("server", "axis"), unit="chips"),
+    MetricSpec("tpustack_llm_weights_per_chip_bytes", "gauge",
+               "Model weight bytes resident on ONE chip: total/tp for "
+               "tp-sharded tensors, whole for replicated ones.  With "
+               "tpustack_llm_kv_per_chip_bytes this is the serving HBM "
+               "bill the 70B-over-v5e-8 sizing works from.", unit="bytes"),
+    MetricSpec("tpustack_llm_kv_per_chip_bytes", "gauge",
+               "Serving KV bytes resident on ONE chip: the paged pool's "
+               "(or dense slot caches') largest single-device shard — "
+               "pool/tp under head-axis sharding, the whole substrate "
+               "unsharded (LLM_SHARD_KV=0 or no mesh).", unit="bytes"),
+    MetricSpec("tpustack_llm_tp_collective_bytes", "gauge",
+               "Estimated tensor-parallel all-reduce traffic per decoded "
+               "token per chip (2 partial-sum reduces per layer x hidden "
+               "dim x activation bytes x (tp-1)/tp) — the ICI bytes a "
+               "decode step pays for running sharded; 0 unsharded.",
+               unit="bytes"),
+
     # ---- SD server (signature-keyed micro-batcher) ----
     MetricSpec("tpustack_sd_queue_depth", "gauge",
                "Generate requests waiting in micro-batch groups.",

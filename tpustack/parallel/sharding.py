@@ -18,7 +18,7 @@ TP with FSDP on the complementary axis:
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
@@ -110,3 +110,93 @@ def constrain(x, mesh: Mesh, spec: PS):
 
 
 BATCH_SPEC = PS(("dp", "fsdp"), "sp")  # tokens [B, S]: batch over dp+fsdp, seq over sp
+
+
+# --------------------------------------------------------------- serving KV
+#
+# Serving-time KV tensors shard on the HEAD axis over ``tp`` (Pope et al.
+# 2022: attention is embarrassingly parallel per head, so each chip holds
+# only its heads' K/V and the decode step's cache read/write never crosses
+# ICI).  Every serving KV layout puts kv_heads at axis 2:
+#
+#     dense slot caches  [B, max_seq, kvh, hd]
+#     paged pool tensors [n_blocks, block, kvh, hd]
+#     chunk-local bufs   [B, chunk, kvh, hd]
+#     int8 scale arrays  [..., kvh]           (axis 2 is the LAST axis)
+#
+# When ``n_kv_heads`` does not divide the tp ways (GQA at high tp — e.g.
+# 4 kv heads over tp=8), the K/V heads replicate per chip, matching what
+# megatron-style sharding does to the kv projections in that regime; the
+# partitioned programs stay correct either way, this only decides whether
+# the cache HBM bill divides by tp.
+
+def kv_head_axis_spec(ndim: int) -> PS:
+    """PartitionSpec sharding axis 2 (kv heads) on ``tp``; rank-3 scale
+    arrays have the head axis last, so the same spec serves both."""
+    return PS(*([None, None, "tp"] + [None] * (ndim - 3)))
+
+
+def can_shard_kv_heads(mesh: Optional[Mesh], n_kv_heads: int) -> bool:
+    """Head-axis KV sharding is available: a real tp axis whose ways
+    divide the kv head count."""
+    if mesh is None or "tp" not in mesh.axis_names:
+        return False
+    tp = int(mesh.shape["tp"])
+    return tp > 1 and n_kv_heads % tp == 0
+
+
+def shard_kv_tree(caches, mesh: Mesh, n_kv_heads: int):
+    """device_put every serving-KV leaf (per-layer dicts of k/v [+ scales])
+    with the head-axis NamedSharding; replicated when the heads don't
+    divide tp.  Idempotent on already-sharded trees."""
+    shard = can_shard_kv_heads(mesh, n_kv_heads)
+
+    def put(x):
+        spec = kv_head_axis_spec(x.ndim) if shard else PS()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, caches)
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes across a pytree of arrays (global, all shards)."""
+    import numpy as np
+
+    return int(sum(np.prod(l.shape) * jax.numpy.dtype(l.dtype).itemsize
+                   for l in jax.tree.leaves(tree)))
+
+
+def tree_per_shard_bytes(tree) -> int:
+    """Per-device bytes of a pytree of (possibly sharded) arrays — the
+    honest per-chip HBM bill: each leaf counts its largest single-device
+    shard (``NamedSharding.shard_shape``); unsharded/host leaves count
+    whole.  This is what ``/props`` and the admission math report."""
+    import numpy as np
+
+    total = 0
+    for l in jax.tree.leaves(tree):
+        itemsize = jax.numpy.dtype(l.dtype).itemsize
+        sharding = getattr(l, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(l.shape)
+        else:
+            shape = l.shape
+        total += int(np.prod(shape)) * itemsize
+    return total
+
+
+def mesh_axis_sizes(mesh: Optional[Mesh]) -> Dict[str, int]:
+    """{axis: ways} of a mesh ({} when None) — the /props + gauge shape."""
+    if mesh is None:
+        return {}
+    return {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+
+
+def export_mesh_axis_gauges(metrics, server: str, mesh: Optional[Mesh]) -> None:
+    """Set ``tpustack_mesh_axis_chips{server,axis}`` for every mesh axis
+    (the unsharded fallback exports dp=tp=1 so dashboards always have the
+    series) — ONE exporter shared by the serving processes, so the gauge
+    shape cannot drift between them."""
+    for axis, ways in (mesh_axis_sizes(mesh) or {"dp": 1, "tp": 1}).items():
+        metrics["tpustack_mesh_axis_chips"].labels(server=server,
+                                                   axis=axis).set(ways)

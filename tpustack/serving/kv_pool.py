@@ -449,6 +449,19 @@ class PagedKVRuntime:
         self.max_seq = max_seq
         self.block = pool.block
         self.blocks_per_seq = max_seq // pool.block
+        # per-shard HBM accounting (tensor-parallel serving): total pool
+        # bytes, the largest single-device shard (what one chip actually
+        # holds — pool/tp when the kv-head axis shards, the whole pool
+        # unsharded), and the implied shard ways.  Computed once — the
+        # pool's shape and sharding are fixed for its lifetime (donation
+        # rotates buffers, never layouts).
+        from tpustack.parallel.sharding import (tree_bytes,
+                                                tree_per_shard_bytes)
+
+        self.pool_bytes = tree_bytes(arrays)
+        self.per_shard_bytes = tree_per_shard_bytes(arrays)
+        self.kv_shards = max(1, round(self.pool_bytes
+                                      / max(1, self.per_shard_bytes)))
 
     # ------------------------------------------------------ admission math
     def need_tokens(self, n_prompt: int, max_new: int) -> int:
@@ -483,6 +496,9 @@ class PagedKVRuntime:
     def stats(self) -> Dict[str, object]:
         out = dict(self.pool.stats())
         out["blocks_per_seq"] = self.blocks_per_seq
+        out["pool_bytes"] = self.pool_bytes
+        out["per_shard_bytes"] = self.per_shard_bytes
+        out["kv_shards"] = self.kv_shards
         out["prefix_cache"] = (self.cache.stats() if self.cache is not None
                                else {"enabled": False})
         return out

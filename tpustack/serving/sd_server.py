@@ -157,6 +157,11 @@ class SDServer:
         self.resilience = ResilienceManager("sd", registry,
                                             concurrency=self.max_batch,
                                             expected_service_s=5.0)
+        # mesh-shape gauges: operators confirm a google.com/tpu: N pod is
+        # actually fanning batches out dp-ways (SD15_DP) from /metrics
+        from tpustack.parallel.sharding import export_mesh_axis_gauges
+
+        export_mesh_axis_gauges(self.metrics, "sd", self.mesh)
         sanitize.install_guards(self)
 
     @staticmethod
@@ -202,6 +207,7 @@ class SDServer:
         status, payload = self.resilience.health_payload(extra={
             "max_batch": self.max_batch,
             "batch_window_ms": self.batch_window_s * 1e3,
+            "dp": self._mesh_data_size() or 1,
         })
         return web.json_response(payload, status=status)
 

@@ -95,7 +95,17 @@ _declare("LLM_KV_QUANT", str, "",
          "empty for the compute dtype.")
 _declare("LLM_TP", int, 0,
          "Tensor-parallel ways: GSPMD-shard the model over N chips "
-         "(0/1 = single chip).")
+         "(0/1 = single chip).  The manifest's google.com/tpu request "
+         "must equal the LLM_TP/dp product (lint_manifests enforces it).")
+_declare("LLM_SHARD_KV", bool, True,
+         "Under LLM_TP, place serving KV caches and the paged block pool "
+         "head-axis-sharded over the tp mesh (per-chip KV HBM = total/tp); "
+         "0 bisects back to compiler-placed caches.")
+_declare("LLM_MULTIHOST_PROMPTS", str, "",
+         "llm_multihost driver: path to a prompts file (one per line); "
+         "empty serves a synthetic fleet.")
+_declare("LLM_MULTIHOST_NEW_TOKENS", int, 128,
+         "llm_multihost driver: tokens generated per prompt.")
 _declare("LLM_TOKENIZER_DIR", str, "",
          "Directory holding the HF tokenizer files; empty falls back to "
          "the byte-fallback BPE baked into the repo.")
