@@ -30,6 +30,12 @@ def test_llm_extras_schema(monkeypatch):
                    "steady_decode_tokens_per_sec": 2.0,
                    "prefill_tokens_per_sec": 3.0, "roofline_pct": 4.0,
                    "prefill_roofline_pct": 5.0,
+                   # the continuous run's flight-recorder aggregates: the
+                   # artifact must record utilization, not just throughput
+                   "flight": {"mean_occupancy": 7.5, "spec_acceptance": 0.6,
+                              "tokens_per_weight_pass": 2.1,
+                              "live_mfu": None, "live_hbm_util": None,
+                              "device_kind": None},
                    "ignored_key": "must not leak into the artifact"}
         return subprocess.CompletedProcess(cmd, 0,
                                            stdout=json.dumps(payload) + "\n",
@@ -43,6 +49,9 @@ def test_llm_extras_schema(monkeypatch):
         assert sub["value"] == 1.0
         assert sub["steady_decode_tokens_per_sec"] == 2.0
         assert "ignored_key" not in sub
+    # the flight aggregates ride the continuous cell into the artifact
+    assert out["continuous_e2e"]["flight"]["mean_occupancy"] == 7.5
+    assert out["continuous_e2e"]["flight"]["spec_acceptance"] == 0.6
     # the six bench_llm invocations: batch-8 continuous + the 8k prefill
     # + the shared-prefix (prefix KV cache) + the paged-KV sweep + the
     # speculative-decoding sweep + the tensor-parallel sweep workloads

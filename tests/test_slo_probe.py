@@ -140,6 +140,57 @@ def test_slo_report_cli_json(tmp_path):
     assert rep["llm"]["availability"]["burn_rate"] == pytest.approx(2.0)
 
 
+def _slo_cli(tmp_path, *extra):
+    import subprocess
+
+    scrape = tmp_path / "scrape.txt"
+    scrape.write_text(SCRAPE)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "slo_report.py"),
+         "--file", str(scrape), "--json", *extra],
+        capture_output=True, text=True, timeout=120)
+
+
+def test_slo_report_prev_missing_fails_safe(tmp_path):
+    """--prev pointing at a missing artifact degrades to the lifetime
+    window with a logged skip — never a crash (regression: an operator
+    mid-incident must still get a verdict)."""
+    proc = _slo_cli(tmp_path, "--prev", str(tmp_path / "nope.txt"))
+    assert proc.returncode == 1  # the lifetime-window verdict, not 2/crash
+    assert "Traceback" not in proc.stderr
+    assert "skipping delta window" in proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["llm"]["availability"]["burn_rate"] == pytest.approx(2.0)
+
+
+def test_slo_report_prev_corrupt_fails_safe(tmp_path):
+    corrupt = tmp_path / "corrupt.txt"
+    corrupt.write_text("%% not an exposition at all {{{\x00")
+    proc = _slo_cli(tmp_path, "--prev", str(corrupt))
+    assert proc.returncode == 1
+    assert "Traceback" not in proc.stderr
+    assert "skipping delta window" in proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["llm"]["availability"]["events"] == 1000  # lifetime window
+
+
+def test_slo_report_surfaces_flight_utilization():
+    """The live roofline gauges ride the report: "how close to the
+    hardware" reads off the same scrape as the SLO verdicts."""
+    slo = _tool("slo_report")
+    scrape = SCRAPE + textwrap.dedent("""\
+        tpustack_llm_mfu_ratio{device_kind="TPU v5e"} 0.07
+        tpustack_llm_hbm_util_ratio{device_kind="TPU v5e"} 0.62
+        tpustack_llm_wave_occupancy_slots 6.5
+        """)
+    util = slo.utilization_report(slo.parse_exposition(scrape))
+    assert util == {"llm_mfu": 0.07, "llm_hbm_util": 0.62,
+                    "llm_wave_occupancy_slots": 6.5}
+    # absent gauges (unknown device kind) are omitted, mirroring the
+    # gauges' own contract
+    assert slo.utilization_report(slo.parse_exposition(SCRAPE)) == {}
+
+
 # ------------------------------------------------------------------ probe
 def _fake_fetch(responses):
     """fetch stub: {(method, path-suffix): (status, body_bytes)}."""

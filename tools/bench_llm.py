@@ -643,6 +643,7 @@ def main() -> int:
 
     prompt = list(range(5, 5 + args.prompt_tokens))
     sample = SampleConfig(greedy=True)
+    flight_box = {}
     if args.batch > 1 and args.continuous:
         from tpustack.models.llm_continuous import ContinuousEngine
 
@@ -652,9 +653,15 @@ def main() -> int:
             # tokens_per_s here is END-TO-END (prefills included), which is
             # what a client fleet actually experiences.
             from tpustack.models.llm_continuous import SlotRequest
+            from tpustack.obs.flight import FlightRecorder
 
+            # per-run flight recorder: the run's per-wave occupancy/spec/
+            # utilization aggregates land in the artifact, so the perf
+            # trajectory records HOW the throughput was achieved
+            rec = flight_box["rec"] = FlightRecorder("bench", capacity=4096)
             eng = ContinuousEngine(gen, slots=args.batch,
-                                   chunk=min(args.chunk, args.new_tokens))
+                                   chunk=min(args.chunk, args.new_tokens),
+                                   flight=rec)
             q = [SlotRequest(ids=prompt, max_new=args.new_tokens,
                              sample=sample) for _ in range(args.batch)]
             stats = eng.run(lambda: q.pop(0) if q else None)
@@ -711,34 +718,27 @@ def main() -> int:
     # chip's HBM peak over the COMPLETE per-step traffic: weights + KV reads
     # (+ the 1-position KV write, negligible).  Prefill is MXU-bound:
     # ~2·P_matmul FLOPs/token (attention excluded, a few % at these ctx).
+    from tpustack.obs.flight import llm_wave_arith
     from tpustack.utils.peaks import device_peaks
 
     peak = device_peaks(jax.devices()[0])
+    # per-token FLOPs / per-pass bytes from the SHARED helper — the same
+    # arithmetic the servers' live tpustack_llm_{mfu,hbm_util}_ratio
+    # gauges divide, so bench and live attribution can never disagree
+    arith = llm_wave_arith(cfg, gen.params, gen.cache_dtype)
     decode_mbu = prefill_mfu = roofline_pct = prefill_roofline_pct = None
     if peak and not (args.batch > 1 and args.continuous):
         # continuous mode's rate is end-to-end (admissions folded in) —
         # dividing it by per-step bytes would understate the roofline; the
         # steady-state decode scan is program-identical to the static
         # batcher's (645 vs 646 tok/s measured), so the static run's
-        # roofline numbers are the decode-phase truth for both
-        def leaf_name(p):
-            return str(p[-1].key if hasattr(p[-1], "key") else p[-1])
-
-        flat = jax.tree_util.tree_leaves_with_path(gen.params)
-        # decode gathers ONE embedding row per step — the vocab table does
-        # not stream; count only the matmul/norm weights the step touches
-        weight_bytes = sum(
-            x.nbytes for p, x in flat
-            if not any("embed" in str(getattr(k, "key", k)) for k in p))
-        # KV reads: full cache every step (static shapes; masked attention);
-        # int8 cache = 1 byte/element + one f32 scale per vector
-        kv_elt = 1 if cfg.kv_quant == "int8" else jnp.dtype(dtype).itemsize
-        kv_bytes = (args.batch * cfg.n_layers * 2 * cfg.max_seq *
-                    cfg.n_kv_heads *
-                    (cfg.head_dim * kv_elt +
-                     (4 if cfg.kv_quant == "int8" else 0)))
-        matmul_flops_per_tok = 2 * sum(
-            x.size for p, x in flat if leaf_name(p) == "kernel")
+        # roofline numbers are the decode-phase truth for both.
+        # decode gathers ONE embedding row per step (the vocab table does
+        # not stream) and reads the full static-shape cache every step —
+        # both baked into llm_wave_arith's accounting
+        weight_bytes = arith["weight_stream_bytes"]
+        kv_bytes = args.batch * arith["kv_step_bytes_per_slot"]
+        matmul_flops_per_tok = arith["flops_per_token"]
         decode_rate = statistics.median(dec)  # aggregate tok/s
         steps_per_s = decode_rate / args.batch  # weights stream once per STEP
         decode_mbu = steps_per_s * weight_bytes / peak[1]
@@ -773,6 +773,26 @@ def main() -> int:
                f"({100 * prefill_mfu:.0f}% MFU)"
                if prefill_mfu is not None else ""))
 
+    # flight-recorder aggregates for the continuous run: the artifact
+    # records mean occupancy, spec acceptance and LIVE utilization (None
+    # on unknown device kinds — omitted, not faked), not just tok/s
+    flight_summary = None
+    if flight_box.get("rec") is not None:
+        from tpustack.obs.flight import device_peaks_info, llm_utilization
+
+        agg = flight_box["rec"].aggregates()
+        kind, live_peaks = device_peaks_info()
+        util = llm_utilization(agg, arith, live_peaks)
+        flight_summary = {
+            "waves": agg.get("waves"),
+            "mean_occupancy": agg.get("mean_occupancy"),
+            "spec_acceptance": agg.get("spec_acceptance"),
+            "tokens_per_weight_pass": agg.get("tokens_per_weight_pass"),
+            "live_mfu": round(util["mfu"], 6) if util else None,
+            "live_hbm_util": round(util["hbm_util"], 6) if util else None,
+            "device_kind": kind or None,
+        }
+
     batch_tag = f"_batch{args.batch}" if args.batch > 1 else ""
     kv_tag = f"_kv{args.kv_quant}" if args.kv_quant else ""
     mode_tag = ("_continuous_e2e" if args.batch > 1 and args.continuous
@@ -799,6 +819,7 @@ def main() -> int:
         "prefill_roofline_pct": (round(prefill_roofline_pct, 1)
                                  if prefill_roofline_pct is not None
                                  else None),
+        "flight": flight_summary,
     }))
     return 0
 

@@ -214,6 +214,31 @@ def bench_report(artifact: dict,
     }
 
 
+#: live roofline/occupancy gauges (tpustack.obs.flight) surfaced alongside
+#: the SLO verdicts — "how close to the hardware are we" off the SAME
+#: scrape, no bench rerun.  Gauges, so they read from the CURRENT scrape,
+#: never the --prev delta.
+_UTILIZATION_GAUGES = (
+    ("tpustack_llm_mfu_ratio", "llm_mfu"),
+    ("tpustack_llm_hbm_util_ratio", "llm_hbm_util"),
+    ("tpustack_sd_mfu_ratio", "sd_mfu"),
+    ("tpustack_llm_wave_occupancy_slots", "llm_wave_occupancy_slots"),
+    ("tpustack_llm_spec_efficiency_tokens", "llm_spec_efficiency"),
+)
+
+
+def utilization_report(samples: Dict[Sample, float]) -> Dict[str, float]:
+    """Flight-recorder utilization gauges present in the scrape.  Absent
+    gauges (unknown device kind, no traffic window) are simply omitted —
+    the gauges' own contract, mirrored here."""
+    out: Dict[str, float] = {}
+    for name, key in _UTILIZATION_GAUGES:
+        vals = [v for (n, _), v in samples.items() if n == name]
+        if vals:
+            out[key] = round(max(vals), 6)
+    return out
+
+
 def _read(source: str) -> str:
     if source.startswith(("http://", "https://")):
         import urllib.request
@@ -257,12 +282,33 @@ def main(argv: List[str] = None) -> int:
         return 0 if rep["ok"] else 1
 
     samples = parse_exposition(_read(args.file or args.url))
-    prev = parse_exposition(_read(args.prev)) if args.prev else None
+    prev = None
+    if args.prev:
+        # fail SAFE on a missing/corrupt previous artifact: the report
+        # degrades to the lifetime window (logged), it does not crash —
+        # an operator mid-incident must still get a verdict
+        try:
+            text = _read(args.prev)
+            prev = parse_exposition(text)
+            if text.strip() and not prev:
+                raise ValueError("no parseable samples (corrupt scrape?)")
+        except Exception as e:
+            print(f"slo_report: skipping delta window — cannot use "
+                  f"--prev {args.prev}: {e}", file=sys.stderr)
+            prev = None
     rep = report(delta(samples, prev))
+    util = utilization_report(samples)
     if args.as_json:
-        print(json.dumps(rep))
+        out = dict(rep)
+        if util:
+            out["_utilization"] = util
+        print(json.dumps(out))
     else:
         _print_human(rep)
+        if util:
+            print("utilization (flight-recorder gauges, current scrape):")
+            for k, v in util.items():
+                print(f"  {k:<28} {v}")
     ok = all(r["ok"] for entry in rep.values() for r in entry.values())
     return 0 if ok else 1
 

@@ -2,8 +2,9 @@
 """Summarise an XLA profiler capture into an op-time table.
 
 Observability beyond the reference's wall-clock-only ``X-Gen-Time`` header
-(SURVEY.md §5: "Tracing/profiling: none") — pairs with the SD server's
-``POST /profile`` endpoint, which writes xplane captures:
+(SURVEY.md §5: "Tracing/profiling: none") — pairs with the serving
+servers' ``POST /profile`` endpoints (llm/sd/graph, via
+``tpustack.obs.profile``), which write xplane captures:
 
     curl -X POST :8000/profile -d '{"steps": 4}'   # → {"trace_dir": ...}
     python tools/xprof_summary.py /tmp/sd15-trace/capture-0
@@ -11,7 +12,9 @@ Observability beyond the reference's wall-clock-only ``X-Gen-Time`` header
 Prints the top ops by device self-time so "where did my step time go" is a
 one-command answer (MXU convs vs attention vs layout/copy overhead).
 Requires the ``xprof`` package (in the serving image; also usable with any
-tensorboard profile dir).
+tensorboard profile dir).  Degrades cleanly without it: a one-line error
+(or a ``--json`` error object) and a nonzero exit, never a traceback —
+this tool runs in operator hands and CI scripts.
 """
 
 from __future__ import annotations
@@ -21,15 +24,23 @@ import glob
 import json
 import os
 import sys
+from typing import List, Optional
+
+
+def _fail(msg: str, as_json: bool, code: int = 2) -> int:
+    """One-line degradation contract: machine-readable under ``--json``
+    (stdout), human one-liner otherwise (stderr); always nonzero."""
+    if as_json:
+        print(json.dumps({"error": msg}))
+    else:
+        print(f"xprof_summary: {msg}", file=sys.stderr)
+    return code
 
 
 def find_xplanes(path: str) -> list:
     if os.path.isfile(path):
         return [path]
-    files = sorted(glob.glob(f"{path}/**/*.xplane.pb", recursive=True))
-    if not files:
-        raise SystemExit(f"no .xplane.pb under {path}")
-    return files
+    return sorted(glob.glob(f"{path}/**/*.xplane.pb", recursive=True))
 
 
 def op_table(files: list, tool: str = "framework_op_stats") -> list:
@@ -49,21 +60,41 @@ def op_table(files: list, tool: str = "framework_op_stats") -> list:
     return rows
 
 
-def main() -> int:
+def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("trace", help="trace dir (or a single .xplane.pb file)")
     p.add_argument("--top", type=int, default=20, help="rows to print")
     p.add_argument("--host", action="store_true",
                    help="include host-side ops (default: device only)")
-    args = p.parse_args()
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON object (rows or {error}) on stdout")
+    args = p.parse_args(argv)
 
-    rows = op_table(find_xplanes(args.trace))
+    if not os.path.exists(args.trace):
+        return _fail(f"no such trace path: {args.trace}", args.as_json)
+    files = find_xplanes(args.trace)
+    if not files:
+        return _fail(f"no .xplane.pb files under {args.trace} — capture "
+                     "one with POST /profile on any serving pod",
+                     args.as_json)
+    try:
+        rows = op_table(files)
+    except ImportError:
+        return _fail("the 'xprof' package is not installed — this tool "
+                     "needs it to parse xplane captures (it ships in the "
+                     "serving image; pip install xprof elsewhere)",
+                     args.as_json, code=3)
     if not args.host:
         rows = [r for r in rows if str(r.get("host_or_device", "")).lower()
                 == "device"]
     rows.sort(key=lambda r: -(r.get("total_self_time") or 0))
 
     total = sum(r.get("total_self_time") or 0 for r in rows)
+    if args.as_json:
+        print(json.dumps({"total_self_us": total,
+                          "op_types": len(rows),
+                          "rows": rows[: args.top]}))
+        return 0
     print(f"{'self µs':>12} {'%':>6} {'#':>6}  {'type':<28} operation")
     for r in rows[: args.top]:
         self_us = r.get("total_self_time") or 0

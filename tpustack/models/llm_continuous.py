@@ -226,7 +226,8 @@ class ContinuousEngine:
                  stop_tokens: Tuple[int, ...] = (), depth: int = 2,
                  on_progress: Optional[Callable[[str], None]] = None,
                  tracer=None, paged=None, spec=None, on_spec=None,
-                 compile_budgets: Optional[Dict[str, int]] = None):
+                 compile_budgets: Optional[Dict[str, int]] = None,
+                 flight=None, queue_depth: Optional[Callable[[], int]] = None):
         self.gen = gen
         self.B = slots
         self.chunk = chunk
@@ -288,6 +289,15 @@ class ContinuousEngine:
         # point (injected transient device error) aborts the run through
         # the server's existing engine-failure path.
         self._on_progress = on_progress
+        # flight recorder (tpustack.obs.flight.FlightRecorder): one
+        # structured host-side record per dispatch — occupancy, tokens,
+        # spec drafted/accepted, stride, kv-pool state, queue depth, wave
+        # wall time, slowest in-flight trace id.  All values the fetch
+        # boundary already holds; recording never syncs the device.  None
+        # keeps the engine record-free (bench/CLI paths).
+        self.flight = flight
+        self._queue_depth_fn = queue_depth
+        self._last_wave_t: Optional[float] = None
         self._to_park: List[int] = []  # retirements awaiting a fused park
         self._pending: List[_PendingWave] = []
         self._retired_tokens = 0
@@ -748,6 +758,13 @@ class ContinuousEngine:
         overlap this is the request's true time-to-first-token."""
         firsts = [int(t) for t in np.asarray(wave.firsts_dev)]
         t_first = time.time() - wave.t0
+        if self.flight is not None:
+            self.flight.record(
+                "prefill", rows=len(wave.rows),
+                prompt_tokens=sum(len(r.ids) for _, r, _ in wave.rows),
+                cached_tokens=sum(slots[i].cached
+                                  for i, _, _ in wave.rows),
+                prefill_s=round(t_first, 6))
         for req, ids in wave.block_inserts:
             # prefill has landed (the firsts fetch above synced on it): the
             # prompt's full blocks are valid, so the zero-copy cache insert
@@ -911,6 +928,7 @@ class ContinuousEngine:
         self._spec_drafted = self._spec_accepted = 0
         self._spec_dispatches = self._plain_steps = 0
         self._wave_ctr = 0
+        self._last_wave_t = None  # per-run: wave_s must not span idle gaps
         # (wall time, tokens consumed so far, waves fetched so far) at each
         # block fetch: the steady-state decode rate is the slope between
         # the first and last marks — what the bench reports alongside
@@ -951,6 +969,10 @@ class ContinuousEngine:
             # eviction instead of being captured as the error it is — nor,
             # under paging, the slots' pool references (the pool outlives
             # this run; leaked refs would shrink capacity forever)
+            if self.flight is not None:
+                # post-mortem first: the ring around the failure IS the
+                # artifact the fatal-engine-error runbook starts from
+                self.flight.dump("engine_error")
             for s in slots:
                 if s.span is not None:
                     s.span.end(status="error")
@@ -1047,6 +1069,55 @@ class ContinuousEngine:
             sanitize.check_kv_conservation(self.paged.pool,
                                            where="wave boundary")
 
+    def _flight_wave(self, slots, kind: str, tokens: int,
+                     weight_passes: int, stride: float,
+                     drafted: int = 0, accepted: int = 0,
+                     occupancy: Optional[int] = None) -> None:
+        """Append one flight record for a fetched wave (plain chunk or
+        speculative verify).  Host-side values only — the fetch that
+        produced ``tokens`` already synced, so this is a dict build and a
+        deque append, nothing more.  ``occupancy`` is the live count AT
+        FETCH (callers snapshot it before retiring finished rows)."""
+        if self.flight is None:
+            return
+        now = time.time()
+        rec = {
+            "wave": self._wave_ctr,
+            "occupancy": (occupancy if occupancy is not None else
+                          sum(1 for s in slots if s.req is not None)),
+            "slots": self.B,
+            "tokens": int(tokens),
+            "weight_passes": int(weight_passes),
+            "stride": round(float(stride), 3),
+            "drafted": int(drafted),
+            "accepted": int(accepted),
+            "wave_s": (round(now - self._last_wave_t, 6)
+                       if self._last_wave_t is not None else None),
+        }
+        self._last_wave_t = now
+        if self._queue_depth_fn is not None:
+            try:
+                rec["queue_depth"] = int(self._queue_depth_fn())
+            except Exception:  # tpulint: disable=TPL301 — racing the
+                pass  # server thread by design: a torn queue-depth read
+                # costs this record one advisory field, and logging per
+                # wave would spam the engine's hot loop
+        if self.paged is not None:
+            free, used, frag = self.paged.pool.flight_snapshot()
+            rec["kv_free"] = free
+            rec["kv_used"] = used
+            rec["kv_fragmentation"] = round(frag, 4)
+        slowest, age = None, 0.0
+        for s in slots:
+            if s.req is not None and now - s.t0 > age:
+                age = now - s.t0
+                ctx = s.req.span_ctx
+                slowest = getattr(ctx, "trace_id", None)
+        if age > 0.0:
+            rec["slowest_age_s"] = round(age, 3)
+            rec["slowest_trace_id"] = slowest
+        self.flight.record(kind, **rec)
+
     def _consume_block(self, state, slots, block, snapshot):
         """Host bookkeeping for one fetched plain chunk block (the consume
         half of the wave loop, shared by both run loops)."""
@@ -1060,6 +1131,7 @@ class ContinuousEngine:
                     len(s.out) for s in slots if s.req is not None),
                 self._wave_ctr))
         live = self._live(slots)
+        wave_tokens = 0
         for i, gid, offset in snapshot:
             s = slots[i]
             if s.req is None or s.gen_id != gid or s.done:
@@ -1078,6 +1150,7 @@ class ContinuousEngine:
                 if t in self.stop_tokens or len(s.out) >= s.budget:
                     s.done = True
                     break
+            wave_tokens += len(accepted)
             s.spec_idle += 1  # plain wave: the slot did not draft
             s.stride_ema = 0.75 * s.stride_ema + 0.25 * max(1, len(accepted))
             if accepted and s.span is not None:
@@ -1086,6 +1159,8 @@ class ContinuousEngine:
                 s.req.on_tokens(accepted)
             if s.done:
                 self._retire(state, slots, i, live)
+        self._flight_wave(slots, "wave", wave_tokens, self.chunk,
+                          stride=self.chunk, occupancy=live)
 
     def _run_loop(self, state, slots, chain, admit_free, dispatch_ok):
         while True:
@@ -1234,6 +1309,7 @@ class ContinuousEngine:
                 self._wave_ctr))
         alpha = spec.ema_alpha
         live = self._live(slots)
+        wave_tokens = wave_drafted = wave_accepted = 0
         for i, gid in rows:
             s = slots[i]
             if s.req is None or s.gen_id != gid or s.done:
@@ -1249,6 +1325,8 @@ class ContinuousEngine:
                 s.spec_idle = 0
                 self._spec_drafted += k_i
                 self._spec_accepted += m
+                wave_drafted += k_i
+                wave_accepted += m
                 if s.span is not None:
                     s.span.add_event("spec", drafted=k_i, accepted=m)
                 if self.on_spec is not None:
@@ -1265,6 +1343,7 @@ class ContinuousEngine:
                 if t in self.stop_tokens or len(s.out) >= s.budget:
                     s.done = True
                     break
+            wave_tokens += len(accepted)
             # keep the plain-chunk bookkeeping invariant (dispatched =
             # tokens beyond the admission-sampled first) — the spec loop
             # is fetch-synchronous, so dispatched == consumed
@@ -1277,6 +1356,11 @@ class ContinuousEngine:
                 s.req.on_tokens(accepted)
             if s.done:
                 self._retire(state, slots, i, live)
+        # one verify dispatch = ONE weight pass for all its 1..k+1 strides
+        self._flight_wave(slots, "verify", wave_tokens, 1,
+                          stride=wave_tokens / max(1, len(rows)),
+                          drafted=wave_drafted, accepted=wave_accepted,
+                          occupancy=live)
 
     def _run_loop_spec(self, state, slots, chain, admit_free, dispatch_ok):
         """Variable-stride wave loop (``spec`` configured): whenever the

@@ -11,6 +11,11 @@ The serving-stack observability layer (vLLM/TGI posture, zero new deps):
   subsystem (Span/Tracer, W3C ``traceparent``, bounded trace store
   behind ``GET /debug/traces``).
 - :mod:`tpustack.obs.device` — scrape-time HBM / compile-cache collectors.
+- :mod:`tpustack.obs.flight` — the engine flight recorder (per-dispatch
+  ring buffer behind ``GET /debug/flight``, post-mortem JSON dumps) and
+  live roofline attribution (MFU / HBM-utilization gauges).
+- :mod:`tpustack.obs.profile` — shared on-demand ``POST /profile``
+  xplane-capture mechanics for all three serving surfaces.
 - :mod:`tpustack.obs.http` — ``GET /metrics`` handler, aiohttp
   instrumentation middleware, stdlib sidecar for batch jobs.
 

@@ -136,6 +136,38 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "the verify degenerated to a plain decode step).",
                buckets=(0, 1, 2, 3, 4, 6, 8, 16), unit="tokens"),
 
+    # ---- engine flight recorder / live roofline (tpustack.obs.flight) ----
+    MetricSpec("tpustack_llm_mfu_ratio", "gauge",
+               "Live model-FLOP utilization of the serving engine over the "
+               "flight-recorder window: delivered tokens/s x matmul FLOPs/"
+               "token over the chip's bf16 peak.  Labelled by the matched "
+               "device kind and OMITTED (sample-less) when the kind is "
+               "unknown — never computed against the wrong wall "
+               "(peaks.py contract).", ("device_kind",), unit="ratio"),
+    MetricSpec("tpustack_llm_hbm_util_ratio", "gauge",
+               "Live HBM-bandwidth utilization of decode over the flight "
+               "window: weight passes/s x (weight stream + occupancy x "
+               "per-slot KV read) over the HBM peak — decode's binding "
+               "roofline, the \"how close to the hardware\" number the "
+               "scale-out layer reads off a scrape.  Omitted on unknown "
+               "device kinds.", ("device_kind",), unit="ratio"),
+    MetricSpec("tpustack_sd_mfu_ratio", "gauge",
+               "Live SD MFU over the flight window: summed pipeline FLOPs "
+               "(XLA cost analysis per batch signature) over device-busy "
+               "seconds against the bf16 peak — bench.py's MFU, computed "
+               "from live traffic.  Omitted on unknown device kinds.",
+               ("device_kind",), unit="ratio"),
+    MetricSpec("tpustack_llm_wave_occupancy_slots", "gauge",
+               "Mean live slots per engine wave over the flight window — "
+               "decode streams the weights once per step regardless, so "
+               "occupancy IS the decode-bandwidth amortisation factor.",
+               unit="slots"),
+    MetricSpec("tpustack_llm_spec_efficiency_tokens", "gauge",
+               "Mean tokens delivered per decode weight pass over the "
+               "flight window (plain decode = mean occupancy; speculation "
+               "raises it by accepted drafts).  0 when the window holds "
+               "no waves.", unit="tokens"),
+
     # ---- serving mesh (tensor/data-parallel GSPMD serving) ----
     MetricSpec("tpustack_mesh_axis_chips", "gauge",
                "Serving-mesh axis sizes (dp/fsdp/tp/sp ways) of the "

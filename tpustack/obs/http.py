@@ -17,6 +17,10 @@ Three pieces:
 - ``add_debug_trace_routes(app, tracer)`` — mounts ``GET /debug/traces``
   (recent + slowest + always-kept summaries) and
   ``GET /debug/traces/{trace_id}`` (full span tree) on a server app.
+- ``add_debug_flight_routes(app, recorder)`` — mounts ``GET
+  /debug/flight`` (the engine flight recorder's recent ring + windowed
+  aggregates); the sidecar serves the same path for every recorder
+  registered in the process.
 - ``start_metrics_sidecar(port, registry)`` — a stdlib ``http.server`` on a
   daemon thread, for processes that are NOT aiohttp apps (batch Jobs,
   trainers): set ``TPUSTACK_METRICS_PORT`` and the same registry becomes
@@ -45,7 +49,8 @@ from tpustack.obs.trace import bind_request_id
 #: caller explicitly sent a traceparent
 UNTRACED_ENDPOINTS = frozenset({
     "/metrics", "/health", "/healthz", "/readyz",
-    "/debug/traces", "/debug/traces/{trace_id}", "__unmatched__",
+    "/debug/traces", "/debug/traces/{trace_id}", "/debug/flight",
+    "__unmatched__",
     # poll loops (the wan client hits /history every few seconds for
     # minutes per prompt) — the prompt's real work is traced via its
     # "prompt" span, not the polls
@@ -176,6 +181,26 @@ def add_debug_trace_routes(app, tracer: Optional[obs_trace.Tracer] = None):
     app.router.add_get("/debug/traces/{trace_id}", get_trace)
 
 
+def add_debug_flight_routes(app, recorder) -> None:
+    """Mount ``GET /debug/flight`` on a server app: the flight recorder's
+    recent ring + windowed aggregates (``?window=<s>`` bounds the
+    aggregate window, ``?n=<records>`` the returned ring slice)."""
+    from aiohttp import web
+
+    async def flight_view(request: web.Request) -> web.Response:
+        def _num(name, cast):
+            try:
+                v = cast(request.query.get(name, ""))
+                return v if v > 0 else None
+            except (TypeError, ValueError):
+                return None
+
+        return web.json_response(recorder.snapshot(
+            window_s=_num("window", float), n=_num("n", int)))
+
+    app.router.add_get("/debug/flight", flight_view)
+
+
 def start_metrics_sidecar(port: int,
                           registry: Optional[Registry] = None,
                           host: str = "0.0.0.0",
@@ -205,6 +230,14 @@ def start_metrics_sidecar(port: int,
                 self.send_header("Content-Type", "application/json")
             elif path == "/debug/traces":
                 body = _json.dumps(tr.summaries()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+            elif path == "/debug/flight":
+                # every registered recorder in the process (batch/train
+                # jobs register theirs the same way servers do)
+                from tpustack.obs import flight as obs_flight
+
+                body = _json.dumps(obs_flight.snapshot_all()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
             elif path.startswith("/debug/traces/"):

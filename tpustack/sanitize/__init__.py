@@ -82,6 +82,10 @@ _state = {"enabled": None, "mode": None}
 _SEEN: List[str] = []
 _SEEN_MAX = 256
 
+#: check classes that already triggered a flight-recorder dump — the
+#: post-mortem writes once per class per process (onset is the useful ring)
+_DUMPED_CHECKS: set = set()
+
 
 def enabled() -> bool:
     e = _state["enabled"]
@@ -136,6 +140,7 @@ def violations_seen() -> List[str]:
 def _clear_seen() -> None:
     with _state_lock:
         _SEEN.clear()
+        _DUMPED_CHECKS.clear()
 
 
 def violation(check: str, message: str, *, stack: bool = False) -> None:
@@ -158,6 +163,21 @@ def violation(check: str, message: str, *, stack: bool = False) -> None:
 
         obs_catalog.build(None)[
             "tpustack_sanitizer_violations_total"].labels(check=check).inc()
+    except Exception:
+        pass
+    try:  # post-mortem: dump the engines' flight rings BEFORE raising —
+        # a violation's report names the invariant, the ring shows what
+        # the engine was doing when it broke (same best-effort contract).
+        # Throttled to the FIRST violation per check class: a recurring
+        # report-mode violation must not fill the disk with near-identical
+        # dumps (the first ring captures the onset, which is the useful one)
+        with _state_lock:
+            first = check not in _DUMPED_CHECKS
+            _DUMPED_CHECKS.add(check)
+        if first:
+            from tpustack.obs import flight as obs_flight
+
+            obs_flight.dump_all(f"sanitizer_{check}")
     except Exception:
         pass
     if mode() == "raise":

@@ -38,6 +38,7 @@ dispatch hangs; ``GET /readyz`` is the readiness probe endpoint.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import queue
@@ -57,7 +58,9 @@ from tpustack import sanitize
 from tpustack.obs import Trace
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
+from tpustack.obs import flight as obs_flight
 from tpustack.obs import http as obs_http
+from tpustack.obs import profile as obs_profile
 from tpustack.obs import trace as obs_trace
 from tpustack.serving.resilience import ResilienceManager
 from tpustack.utils import get_logger
@@ -290,10 +293,14 @@ class GraphExecutor:
     """Topologically executes a ComfyUI-style ``{id: {class_type, inputs}}``
     graph.  Node functions are methods ``node_<ClassType>``."""
 
-    def __init__(self, runtime: WanRuntime, registry=None, tracer=None):
+    def __init__(self, runtime: WanRuntime, registry=None, tracer=None,
+                 flight=None):
         self.rt = runtime
         self.metrics = obs_catalog.build(registry)
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
+        # flight recorder (tpustack.obs.flight): one record per resolved
+        # node during graph execution; None keeps resolution record-free
+        self.flight = flight
         self._counter_lock = threading.Lock()
         self._counter = self._scan_counter()  # guarded-by: _counter_lock
         sanitize.install_guards(self)
@@ -624,9 +631,12 @@ class GraphExecutor:
             # per-node execute span; note under the worker's sample hook
             # VAEDecode is plan-only here — its device time shows up as the
             # dispatch/finalize phases, not in this histogram
+            dt = time.perf_counter() - t0
             self.metrics["tpustack_graph_node_latency_seconds"].labels(
-                node_class=node["class_type"]).observe(
-                time.perf_counter() - t0)
+                node_class=node["class_type"]).observe(dt)
+            if self.flight is not None:
+                self.flight.record("node", class_type=node["class_type"],
+                                   node_id=nid, seconds=round(dt, 6))
             results[nid] = out
             if out and isinstance(out[0], list) and out[0] and isinstance(out[0][0], OutputFile):
                 by_kind: Dict[str, List[Dict]] = {}
@@ -679,8 +689,15 @@ class GraphServer:
         self.metrics = obs_catalog.build(registry)
         obs_device.install(registry)
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
+        # engine flight recorder: per-node records from graph resolution
+        # plus per-dispatch/finalize records from the worker, served on
+        # /debug/flight and dumped by the resilience post-mortem hooks
+        self.flight = obs_flight.register(obs_flight.FlightRecorder(
+            "graph", meta={"max_batch": int(os.environ.get("WAN_MAX_BATCH",
+                                                           "4"))}))
         self.executor = GraphExecutor(self.rt, registry=registry,
-                                      tracer=self.tracer)
+                                      tracer=self.tracer,
+                                      flight=self.flight)
         self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
         # event-loop handlers and the worker thread share every dict below;
         # all of them ride self._lock (tpulint TPL201 enforces the
@@ -717,6 +734,12 @@ class GraphServer:
             expected_service_s=60.0)  # video prompts run minutes, and the
         # cold-start seed must say so before the first publish is observed
         self._t_submit: Dict[str, float] = {}  # guarded-by: _lock
+        # serialises device dispatch against an in-progress /profile
+        # capture: the worker's _dispatch_one and the profile handler both
+        # hold it, so a prompt accepted AFTER the profile's busy-check
+        # blocks until the capture ends instead of racing into it
+        self._profile_lock = threading.RLock()  # RLock: the serial
+        # fallback path re-enters _dispatch_one per member
         sanitize.install_guards(self)
         self._worker = threading.Thread(target=self._work, daemon=True,
                                         name="wan-graph-worker")
@@ -895,6 +918,13 @@ class GraphServer:
 
 
     def _dispatch_one(self, key, members) -> None:
+        # mutually exclusive with an in-progress /profile capture: a
+        # prompt accepted after the profile's busy-check waits here
+        # instead of leaking foreign device work into the xplane
+        with self._profile_lock:
+            self._dispatch_one_inner(key, members)
+
+    def _dispatch_one_inner(self, key, members) -> None:
         width, height, frames_n, steps, cfg, sampler = key
         pipe = self.rt.pipeline()
         t0 = time.perf_counter()
@@ -958,10 +988,16 @@ class GraphServer:
             fr.array = vid[i]
         # host-side dispatch span (async: device compute continues after it;
         # the device wall time lands in the finalize span's fetch)
+        dispatch_s = time.perf_counter() - t0
         tr = Trace()
-        tr.add("dispatch", time.perf_counter() - t0)
+        tr.add("dispatch", dispatch_s)
         tr.observe_into(self.metrics["tpustack_request_phase_latency_seconds"],
                         server="graph")
+        self.flight.record(
+            "dispatch", batch=len(members), width=width, height=height,
+            frames=frames_n, steps=steps, sampler=sampler,
+            dispatch_s=round(dispatch_s, 6),
+            queue_depth=self._queue.qsize())
 
     def _finalize(self, pid, entry, outputs, finish, pspan=None):
         """Run deferred saves (fetch + encode + write) and publish."""
@@ -969,11 +1005,15 @@ class GraphServer:
         tr = Trace()
         fspan = (self.tracer.start_span("finalize", parent=pspan)
                  if pspan is not None else None)
+        t_fin = time.perf_counter()
         try:
             with tr.span("finalize"):
                 finish()
             if fspan is not None:
                 fspan.end()
+            self.flight.record("finalize", prompt_id=pid, status="success",
+                               finalize_s=round(
+                                   time.perf_counter() - t_fin, 6))
             tr.observe_into(
                 self.metrics["tpustack_request_phase_latency_seconds"],
                 server="graph")
@@ -992,6 +1032,10 @@ class GraphServer:
                     time.monotonic() - t_submit)
         except Exception as e:  # noqa: BLE001 — surfaced via /history
             log.exception("prompt %s failed", pid)
+            self.flight.record("finalize", prompt_id=pid, status="error",
+                               error=f"{type(e).__name__}: {e}",
+                               finalize_s=round(
+                                   time.perf_counter() - t_fin, 6))
             if fspan is not None:
                 fspan.end(status="error")
             if pspan is not None:
@@ -1110,6 +1154,70 @@ class GraphServer:
         status, payload = self.resilience.ready_payload()
         return web.json_response(payload, status=status)
 
+    async def profile(self, request: web.Request) -> web.Response:
+        """Capture an XLA/TPU profile (xplane) around one graph execution
+        — the SD server's ``POST /profile`` contract on the graph surface
+        (``tpustack.obs.profile``).  Body: ``{prompt?: <graph>}``; the
+        default graph is a symbolic text-encode (cheap smoke) — POST a
+        real KSampler graph to capture the denoise.  Refuses with 409
+        while the worker holds accepted prompts: a capture must contain
+        only the profiled run, and this server's device work is
+        serialised by the worker, not a lock."""
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except ValueError:
+            body = {}
+        if body is not None and not isinstance(body, dict):
+            return web.json_response({"detail": "body must be a JSON "
+                                      "object"}, status=422)
+        graph = (body or {}).get("prompt") or {
+            "1": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "profile capture"}}}
+        if not isinstance(graph, dict) or not graph:
+            return web.json_response({"detail": "prompt must be a node "
+                                      "graph"}, status=422)
+        for nid, node in graph.items():
+            ct = node.get("class_type") if isinstance(node, dict) else None
+            if not hasattr(self.executor, f"node_{ct}"):
+                return web.json_response(
+                    {"detail": f"unknown node class_type {ct!r} "
+                               f"(node {nid})"}, status=400)
+        def run():
+            self.resilience.beat()  # a cold pipeline build inside the
+            # capture must not trip the watchdog
+            outputs, finish = self.executor.execute(graph)
+            finish()
+
+        def capture_exclusive():
+            # hold the dispatch lock for the WHOLE capture and re-check
+            # busy under it: a /prompt accepted after the handler's check
+            # blocks at _dispatch_one instead of racing its device work
+            # into this xplane
+            with self._profile_lock:
+                if self._graph_busy():
+                    return None
+                return obs_profile.capture(obs_profile.base_dir("graph"),
+                                           run)
+
+        if self._graph_busy():
+            return web.json_response(
+                {"detail": "worker busy — retry when accepted prompts "
+                           "have published"}, status=409,
+                headers={"Retry-After":
+                         str(self.resilience.retry_after_s())})
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, capture_exclusive)
+        except GraphError as e:
+            return web.json_response({"detail": str(e)}, status=400)
+        if out is None:  # lost the race to an accepted prompt
+            return web.json_response(
+                {"detail": "worker busy — retry when accepted prompts "
+                           "have published"}, status=409,
+                headers={"Retry-After":
+                         str(self.resilience.retry_after_s())})
+        return web.json_response(out)
+
     def build_app(self) -> web.Application:
         app = web.Application(
             client_max_size=4 << 20,
@@ -1117,10 +1225,12 @@ class GraphServer:
                                              tracer=self.tracer),
                          self.resilience.middleware({"/prompt"})])
         obs_http.add_debug_trace_routes(app, self.tracer)
+        obs_http.add_debug_flight_routes(app, self.flight)
         app.router.add_get("/queue", self.queue_state)
         app.router.add_get("/object_info", self.object_info)
         app.router.add_get("/metrics",
                            obs_http.make_metrics_handler(self._registry))
+        app.router.add_post("/profile", self.profile)
         app.router.add_post("/prompt", self.submit)
         app.router.add_get("/history/{prompt_id}", self.history)
         app.router.add_get("/view", self.view)

@@ -192,6 +192,18 @@ class KVBlockPool:
             filled = int(self._filled.sum())
             return max(0.0, 1.0 - filled / (used * self.block))
 
+    def flight_snapshot(self) -> Tuple[int, int, float]:
+        """``(free, used, fragmentation)`` under ONE lock acquisition —
+        the per-wave flight-recorder read (three separate property reads
+        would take the allocator lock three times per wave, and could see
+        a half-applied alloc between them)."""
+        with self._lock:
+            used = self.n_used
+            filled = int(self._filled.sum())
+            frag = (max(0.0, 1.0 - filled / (used * self.block))
+                    if used else 0.0)
+            return self.n_free, used, frag
+
     def stats(self) -> Dict[str, object]:
         with self._lock:
             return {

@@ -96,7 +96,9 @@ from aiohttp import web
 from tpustack import sanitize
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
+from tpustack.obs import flight as obs_flight
 from tpustack.obs import http as obs_http
+from tpustack.obs import profile as obs_profile
 from tpustack.obs import trace as obs_trace
 from tpustack.serving.resilience import (DeadlineExceeded,
                                          InjectedDeviceError,
@@ -405,8 +407,68 @@ class LLMServer:
             "llm", registry, concurrency=self.max_batch,
             queue_depth=lambda: len(self._queue) + self._solo_waiting,
             expected_service_s=2.0)
+        # engine flight recorder (tpustack.obs.flight): one structured
+        # record per engine dispatch, served on /debug/flight and
+        # auto-dumped on watchdog fire / SIGTERM drain / fatal engine
+        # error / sanitizer violation.  The scrape-time collector below
+        # turns its windowed rates into the live roofline gauges.
+        self.flight = obs_flight.register(obs_flight.FlightRecorder(
+            "llm", meta={
+                "model": model_name,
+                "slots": self.max_batch,
+                "chunk": self.engine_chunk,
+                "paged_kv": self.paged is not None,
+                "spec_tokens": (self.spec_cfg.tokens
+                                if self.spec_cfg is not None else 0),
+            }))
+        # per-token FLOPs + per-pass HBM bytes from the served config —
+        # the same arithmetic bench_llm reports offline, so the live
+        # gauges and the bench can never disagree
+        self._flight_arith = obs_flight.llm_wave_arith(
+            self.gen.cfg, self.gen.params, self.gen.cache_dtype)
+        self._flight_chips = self._mesh_props()["devices"]
+        from tpustack.obs.metrics import REGISTRY
+
+        (registry if registry is not None else REGISTRY).add_collector(
+            self._flight_collector)
         self._export_mesh_gauges()
         sanitize.install_guards(self)
+
+    def _flight_collector(self, registry) -> None:
+        """Scrape-time roofline attribution: the flight window's delivered
+        tokens/s and weight passes/s against the chip's peaks.  Occupancy
+        and spec-efficiency gauges always; the MFU/HBM-utilization gauges
+        only when the device kind is known (omitted, never faked — the
+        peaks.py contract)."""
+        from tpustack.utils import knobs as _knobs
+
+        agg = self.flight.aggregates(
+            _knobs.get_float("TPUSTACK_FLIGHT_WINDOW_S"))
+        m = self.metrics
+        kind, peaks = obs_flight.device_peaks_info()
+        if not agg.get("waves"):
+            # idle window: the truthful utilization is ~0, not the last
+            # busy window's value frozen forever — clear instead of skip
+            # (the MFU gauges only once they exist: kind must be known)
+            m["tpustack_llm_wave_occupancy_slots"].set(0)
+            m["tpustack_llm_spec_efficiency_tokens"].set(0)
+            if peaks is not None and kind:
+                m["tpustack_llm_mfu_ratio"].labels(device_kind=kind).set(0)
+                m["tpustack_llm_hbm_util_ratio"].labels(
+                    device_kind=kind).set(0)
+            return
+        if agg.get("mean_occupancy") is not None:
+            m["tpustack_llm_wave_occupancy_slots"].set(agg["mean_occupancy"])
+        if agg.get("tokens_per_weight_pass"):
+            m["tpustack_llm_spec_efficiency_tokens"].set(
+                agg["tokens_per_weight_pass"])
+        util = obs_flight.llm_utilization(agg, self._flight_arith, peaks,
+                                          chips=self._flight_chips)
+        if util is not None and kind:
+            m["tpustack_llm_mfu_ratio"].labels(device_kind=kind).set(
+                util["mfu"])
+            m["tpustack_llm_hbm_util_ratio"].labels(device_kind=kind).set(
+                util["hbm_util"])
 
     # --------------------------------------------------- mesh accounting
     def _kv_per_chip_bytes(self) -> int:
@@ -913,7 +975,9 @@ class LLMServer:
                     stop_tokens=(self.tok.eos_id,),
                     on_progress=self.resilience.progress,
                     tracer=self.tracer, paged=self.paged,
-                    spec=self.spec_cfg, on_spec=self._note_spec)
+                    spec=self.spec_cfg, on_spec=self._note_spec,
+                    flight=self.flight,
+                    queue_depth=lambda: len(self._queue))
                 # work() runs on the executor thread WHILE _run_on_device
                 # holds self._lock — the guard is real, just lexically
                 # invisible to the AST walk
@@ -1475,6 +1539,48 @@ class LLMServer:
         self.metrics["tpustack_llm_requests_rejected_total"].labels(
             reason=reason).inc()
 
+    async def profile(self, request: web.Request) -> web.Response:
+        """Capture an XLA/TPU profile (xplane) around one small greedy
+        completion — the SD server's ``POST /profile`` contract on the
+        LLM surface (``tpustack.obs.profile``).  Body: ``{n_predict?,
+        prompt?}``; runs under the generation lock, so the capture never
+        interleaves with the continuous engine's dispatches.  View with
+        ``tools/xprof_summary.py``."""
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except ValueError:
+            body = {}
+        try:
+            fields = obs_profile.parse_int_fields(body, {"n_predict": 8})
+        except ValueError as e:
+            return web.json_response({"detail": str(e)}, status=422)
+        prompt = "profile capture"
+        if isinstance(body, dict) and isinstance(body.get("prompt"), str) \
+                and body["prompt"].strip():
+            prompt = body["prompt"]
+        ids = self.tok.encode(prompt)
+        n = max(1, min(fields["n_predict"], self.gen.cfg.max_seq - len(ids)))
+        if len(ids) >= self.gen.cfg.max_seq:
+            return web.json_response(
+                {"detail": f"prompt ({len(ids)}) exceeds ctx "
+                           f"{self.gen.cfg.max_seq}"}, status=400)
+        from tpustack.models.llm_generate import SampleConfig
+
+        def run():
+            self.resilience.beat()  # a long cold compile must not trip
+            # the watchdog mid-capture
+            self.gen.generate_fused(
+                ids, max_new_tokens=n, sample=SampleConfig(greedy=True),
+                stop_tokens=(self.tok.eos_id,), chunk=min(self.chunk, n))
+
+        base = obs_profile.base_dir("llm")
+        try:
+            out = await self._run_on_device(
+                lambda: obs_profile.capture(base, run))
+        except ValueError as e:
+            return web.json_response({"detail": str(e)}, status=400)
+        return web.json_response(out)
+
     async def completion(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
@@ -1611,12 +1717,14 @@ class LLMServer:
                          self.resilience.middleware(
                              {"/completion", "/v1/chat/completions"})])
         obs_http.add_debug_trace_routes(app, self.tracer)
+        obs_http.add_debug_flight_routes(app, self.flight)
         app.router.add_get("/health", self.health)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)
         app.router.add_get("/props", self.props)
         app.router.add_get("/metrics",
                            obs_http.make_metrics_handler(self._registry))
+        app.router.add_post("/profile", self.profile)
         app.router.add_post("/completion", self.completion)
         app.router.add_post("/tokenize", self.tokenize)
         app.router.add_post("/detokenize", self.detokenize)

@@ -315,11 +315,29 @@ class ResilienceManager:
                     "for in-flight requests", self.drain_timeout_s)
         self._drain_thread.start()
 
+    def _flight_dump(self, reason: str) -> None:
+        """Post-mortem hook: dump every registered flight recorder so the
+        engines' last waves survive the pod.  Best-effort — the dump must
+        never block or break the drain/watchdog path it rides."""
+        try:
+            from tpustack.obs import flight
+
+            paths = flight.dump_all(reason)
+            if paths:
+                log.warning("flight dumps (%s): %s", reason,
+                            ", ".join(paths))
+        except Exception:
+            log.debug("flight dump failed (reason=%s)", reason,
+                      exc_info=True)
+
     def _drain_loop(self) -> None:
         deadline = time.monotonic() + self.drain_timeout_s
         while time.monotonic() < deadline and self.busy():
             time.sleep(0.02)
         clean = not self.busy()
+        # in-flight work has finished (or timed out): the recorders now
+        # hold the engines' ACTUAL final waves — dump before exiting
+        self._flight_dump("drain")
         if clean and self.drain_linger_s > 0:
             # work is published but poll-based clients may not have fetched
             # it yet — keep the read surface (GET /history, /view) alive
@@ -369,6 +387,10 @@ class ResilienceManager:
                 log.error("watchdog: no wave progress for %.1fs with work "
                           "in flight — flipping liveness so kubernetes "
                           "restarts the pod", self.beat_age_s())
+                # what WAS the engine doing?  The ring's tail — the waves
+                # right before progress stopped — is the whole point of
+                # the flight recorder; capture it before the pod restarts
+                self._flight_dump("watchdog")
 
     # ---------------------------------------------------- admission control
     def queue_depth(self) -> int:

@@ -55,7 +55,9 @@ from pydantic import BaseModel, ValidationError
 from tpustack import sanitize
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
+from tpustack.obs import flight as obs_flight
 from tpustack.obs import http as obs_http
+from tpustack.obs import profile as obs_profile
 from tpustack.obs import trace as obs_trace
 from tpustack.serving.resilience import (DeadlineExceeded,
                                          InjectedDeviceError,
@@ -162,7 +164,56 @@ class SDServer:
         from tpustack.parallel.sharding import export_mesh_axis_gauges
 
         export_mesh_axis_gauges(self.metrics, "sd", self.mesh)
+        # engine flight recorder: one record per fused batch (window size,
+        # riders, denoise/encode split, pipeline FLOPs), on /debug/flight
+        # and auto-dumped by the resilience/sanitizer post-mortem hooks;
+        # the collector turns the window into the live SD MFU gauge
+        self.flight = obs_flight.register(obs_flight.FlightRecorder(
+            "sd", meta={"max_batch": self.max_batch,
+                        "dp": self._mesh_data_size() or 1}))
+        self._flops_cache: Dict[tuple, Optional[float]] = {}
+        from tpustack.obs.metrics import REGISTRY
+
+        (registry if registry is not None else REGISTRY).add_collector(
+            self._flight_collector)
         sanitize.install_guards(self)
+
+    def _signature_flops(self, steps: int, width: int, height: int,
+                         batch_size: int) -> Optional[float]:
+        """Pipeline FLOPs for one compiled batch signature (XLA cost
+        analysis — the number bench.py's MFU divides).  Cached per
+        signature; None (and the MFU gauge omitted) when the pipeline
+        cannot cost itself (stub pipes, cost analysis unavailable)."""
+        key = (steps, width, height, batch_size)
+        if key not in self._flops_cache:
+            try:
+                self._flops_cache[key] = float(self.pipe.pipeline_flops(
+                    steps=steps, width=width, height=height,
+                    batch_size=batch_size))
+            except Exception:
+                log.debug("pipeline FLOPs unavailable for signature %s — "
+                          "sd MFU gauge will be omitted", key,
+                          exc_info=True)
+                self._flops_cache[key] = None
+        return self._flops_cache[key]
+
+    def _flight_collector(self, registry) -> None:
+        """Scrape-time live-MFU attribution: summed batch FLOPs over
+        device-busy seconds in the flight window against the bf16 peak —
+        omitted (never faked) when the device kind is unknown."""
+        from tpustack.utils import knobs as _knobs
+
+        agg = self.flight.aggregates(
+            _knobs.get_float("TPUSTACK_FLIGHT_WINDOW_S"))
+        kind, peaks = obs_flight.device_peaks_info()
+        if peaks is None or not kind:
+            return  # unknown device kind: the gauge stays omitted
+        util = obs_flight.sd_utilization(agg, peaks,
+                                         chips=self._mesh_data_size() or 1)
+        # an idle (or uncosted) window is ~0 utilization — clear the gauge
+        # rather than freezing the last busy window's value forever
+        self.metrics["tpustack_sd_mfu_ratio"].labels(device_kind=kind).set(
+            util["mfu"] if util is not None else 0)
 
     @staticmethod
     def _pipeline_from_env():
@@ -454,6 +505,13 @@ class SDServer:
         # request's batch_build/denoise spans carry the SHARED batch timing
         # (explicit wall clocks — this task is not any rider's context)
         denoise_s = time.perf_counter() - t_denoise
+        # flight record: one per fused dispatch — the SD engine's wave
+        self.flight.record(
+            "batch", batch=len(batch), pad=pad, steps=steps,
+            width=width, height=height,
+            build_s=round(build_s, 6), denoise_vae_s=round(denoise_s, 6),
+            flops=self._signature_flops(steps, width, height,
+                                        len(batch) + pad))
         for r in batch:
             if r.span_ctx is None:
                 continue
@@ -479,28 +537,20 @@ class SDServer:
         Observability beyond the reference's wall-clock-only `X-Gen-Time`
         (SURVEY.md §5 "Tracing/profiling: none... JAX profiler/xplane is
         optional extra").  ``POST /profile {steps?, width?, height?}`` →
-        {trace_dir, files, gen_time_s}; view with xprof/tensorboard."""
-        import glob
-        import tempfile
-
-        import jax
-
+        {trace_dir, files, gen_time_s}; view with xprof/tensorboard or
+        ``tools/xprof_summary.py``.  The capture mechanics live in
+        ``tpustack.obs.profile``, shared with llm_server/graph_server;
+        this handler keeps the SD-specific drain-snapshot dance."""
         try:
             body = await request.json() if request.can_read_body else {}
         except ValueError:
             body = {}
-        if not isinstance(body, dict):
-            return web.json_response({"detail": "body must be a JSON object"},
-                                     status=422)
-        def _int(name: str, default: int) -> int:
-            v = body.get(name)
-            return default if v is None else int(v)
-
         try:
-            steps, width, height = _int("steps", 4), _int("width", 512), _int("height", 512)
-        except (TypeError, ValueError) as e:
-            return web.json_response({"detail": f"bad parameter: {e}"}, status=422)
-        base = os.environ.get("SD15_TRACE_DIR", "/tmp/sd15-trace")
+            f = obs_profile.parse_int_fields(
+                body, {"steps": 4, "width": 512, "height": 512})
+        except ValueError as e:
+            return web.json_response({"detail": str(e)}, status=422)
+        base = obs_profile.base_dir("sd", os.environ.get("SD15_TRACE_DIR"))
         async with self._lock:
             # quiesce: dispatches are blocked by the lock, but a previous
             # batch may still be computing/transferring — wait it out so
@@ -510,28 +560,18 @@ class SDServer:
             for arr in list(self._inflight):
                 await asyncio.get_running_loop().run_in_executor(
                     None, lambda a=arr: _jax.block_until_ready(a))
-            # fresh subdir per capture so the response lists exactly this
-            # run's xplane files, never residue from earlier captures —
-            # mkdtemp stays unique even across server restarts onto the
-            # same persistent volume
-            os.makedirs(base, exist_ok=True)
-            trace_dir = tempfile.mkdtemp(prefix="capture-", dir=base)
-            t0 = time.time()
 
             def run():
-                with jax.profiler.trace(trace_dir):
-                    self.pipe.generate("profile capture", steps=steps,
-                                       width=width, height=height, seed=0)
+                self.pipe.generate("profile capture", steps=f["steps"],
+                                   width=f["width"], height=f["height"],
+                                   seed=0)
 
             try:
-                await asyncio.get_running_loop().run_in_executor(None, run)
+                out = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: obs_profile.capture(base, run))
             except ValueError as e:
                 return web.json_response({"detail": str(e)}, status=400)
-            latency = time.time() - t0
-        files = sorted(glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True))
-        return web.json_response(
-            {"trace_dir": trace_dir, "files": files,
-             "gen_time_s": round(latency, 2)})
+        return web.json_response(out)
 
     # ---------------------------------------------------------------- app
     def build_app(self) -> web.Application:
@@ -541,6 +581,7 @@ class SDServer:
                                              tracer=self.tracer),
                          self.resilience.middleware({"/generate"})])
         obs_http.add_debug_trace_routes(app, self.tracer)
+        obs_http.add_debug_flight_routes(app, self.flight)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)
         app.router.add_get("/", self.index)

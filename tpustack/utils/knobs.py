@@ -201,6 +201,19 @@ _declare("TPUSTACK_TRACE_BUFFER", int, 128,
 _declare("TPUSTACK_TRACE_SLOW_S", float, 5.0,
          "Traces at or above this duration are always kept (survive the "
          "ring buffer's churn).")
+_declare("TPUSTACK_FLIGHT_RECORDS", int, 512,
+         "Flight-recorder ring capacity: per-dispatch engine records "
+         "retained for /debug/flight and post-mortem dumps.")
+_declare("TPUSTACK_FLIGHT_DUMP_DIR", str, "/tmp/tpustack-flight",
+         "Directory for flight-recorder JSON dumps (watchdog fire, SIGTERM "
+         "drain, fatal engine error, sanitizer violation); empty disables "
+         "dumping.")
+_declare("TPUSTACK_FLIGHT_WINDOW_S", float, 60.0,
+         "Aggregation window for the live roofline/occupancy gauges "
+         "computed from the flight recorder at scrape time.")
+_declare("TPUSTACK_PROFILE_DIR", str, "/tmp/tpustack-profile",
+         "Base directory for on-demand POST /profile xplane captures "
+         "(the SD server's legacy SD15_TRACE_DIR overrides it there).")
 
 # ---------------------------------------------------------------- sanitizers
 _declare("TPUSTACK_SANITIZE", bool, False,
