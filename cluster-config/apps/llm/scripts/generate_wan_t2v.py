@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import subprocess
 import sys
@@ -100,6 +101,12 @@ def build_graph(*, prompt, negative, seed, width, height, frames, steps, cfg,
 RETRY_STATUSES = (429, 503)
 MAX_RETRY_SLEEP_S = 120.0
 
+#: tenant id stamped (as ``X-Tenant-Id``) on EVERY request this client
+#: sends — submits, polls, downloads, retries — so the server's tenant
+#: cost ledger attributes the whole run; set once in main() from
+#: ``--tenant`` (default ``$USER``)
+TENANT = None
+
 
 def retry_delay_s(attempt, retry_after, backoff_s=0.5, jitter=0.25,
                   rng=random):
@@ -130,6 +137,8 @@ def get_json(base_url, path, payload=None, timeout=30, retries=0,
     url = urllib.parse.urljoin(base_url, path)
     data = json.dumps(payload).encode() if payload is not None else None
     base_headers = {"Content-Type": "application/json"} if data else {}
+    if TENANT:
+        base_headers["X-Tenant-Id"] = TENANT
     base_headers.update(headers or {})
     for attempt in range(retries + 1):
         req = urllib.request.Request(url, data=data, headers=base_headers)
@@ -283,7 +292,9 @@ def download(base_url, file_info, dest_dir: Path, retries=4) -> Path:
     dest = dest_dir / file_info["filename"]
     for attempt in range(retries + 1):
         try:
-            with urllib.request.urlopen(url, timeout=120) as resp:
+            req = urllib.request.Request(
+                url, headers={"X-Tenant-Id": TENANT} if TENANT else {})
+            with urllib.request.urlopen(req, timeout=120) as resp:
                 dest.write_bytes(resp.read())
             return dest
         except urllib.error.URLError:
@@ -353,7 +364,15 @@ def main(argv=None):
     ap.add_argument("--retries", type=int, default=4,
                     help="Retries per request on 429/503/connection errors, "
                          "honouring Retry-After (default: 4).")
+    ap.add_argument("--tenant",
+                    default=os.environ.get("USER") or "anonymous",
+                    help="Tenant id sent as X-Tenant-Id on every request "
+                         "(incl. retries) for the server's per-tenant "
+                         "cost accounting (default: $USER).")
     args = ap.parse_args(argv)
+
+    global TENANT
+    TENANT = args.tenant
 
     want_webm = args.mode == "video" and args.format in ("webm", "both")
     want_webp = args.mode == "video" and args.format in ("webp", "both")

@@ -31,6 +31,7 @@ spent its time (docs/OBSERVABILITY.md "Tracing").
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import sys
 import threading
@@ -100,16 +101,23 @@ def _thread_session() -> requests.Session:
 
 def _post_with_retries(url: str, payload: dict, name: str,
                        retries: int = 5,
-                       trace_id: str | None = None) -> requests.Response:
+                       trace_id: str | None = None,
+                       tenant: str | None = None) -> requests.Response:
     """POST with shed/drain-aware retries: 429/503 honour ``Retry-After``
     (exponential backoff + jitter otherwise) and connection errors retry
-    the same way — a rolling update's drain window looks like both."""
+    the same way — a rolling update's drain window looks like both.
+    Every attempt (retries included) carries ``X-Tenant-Id`` so the
+    server's tenant ledger attributes the whole retry story to one
+    tenant."""
     last_exc: Exception | None = None
     for attempt in range(retries + 1):
         header, trace_id = make_traceparent(trace_id)
+        headers = {"traceparent": header}
+        if tenant:
+            headers["X-Tenant-Id"] = tenant
         try:
             resp = _thread_session().post(url, json=payload, timeout=600,
-                                          headers={"traceparent": header})
+                                          headers=headers)
         except requests.exceptions.ConnectionError as e:
             last_exc = e
             if attempt == retries:
@@ -131,12 +139,12 @@ def _post_with_retries(url: str, payload: dict, name: str,
 
 
 def _one_request(url: str, payload: dict, target: Path, name: str,
-                 retries: int = 5) -> bool:
+                 retries: int = 5, tenant: str | None = None) -> bool:
     counter = _progress_counter()
     trace_id = uuid.uuid4().hex  # fixed up front so failures print it too
     try:
         resp = _post_with_retries(url, payload, name, retries=retries,
-                                  trace_id=trace_id)
+                                  trace_id=trace_id, tenant=tenant)
         target.write_bytes(resp.content)
         gen_time = resp.headers.get("X-Gen-Time", "?")
         print(f"    {name} done in {gen_time} (trace {trace_id})")
@@ -157,7 +165,8 @@ def _one_request(url: str, payload: dict, target: Path, name: str,
 def generate(prompt: str, steps: int, url: str, out_dir: Path, prefix: str,
              count: int, delay: float, width: int | None = None,
              height: int | None = None, concurrency: int = 1,
-             resume: bool = True, retries: int = 5) -> int:
+             resume: bool = True, retries: int = 5,
+             tenant: str | None = None) -> int:
     out_dir.mkdir(parents=True, exist_ok=True)
     ok = 0
     t_start = time.time()
@@ -188,7 +197,7 @@ def generate(prompt: str, steps: int, url: str, out_dir: Path, prefix: str,
                 continue
             print(f"[*] Generating {name} -> {target}")
             futs.append(pool.submit(_one_request, url, dict(payload),
-                                    target, name, retries))
+                                    target, name, retries, tenant))
             if concurrency == 1:
                 futs[-1].result()  # sequential: finish before the next send
             if delay > 0 and idx != count:
@@ -232,6 +241,11 @@ def main(argv: list[str]) -> int:
     parser.add_argument("--retries", type=int, default=5,
                         help="retries per image on 429/503/connection "
                              "errors, honouring Retry-After (default: 5)")
+    parser.add_argument("--tenant",
+                        default=os.environ.get("USER") or "anonymous",
+                        help="tenant id sent as X-Tenant-Id on every "
+                             "request (incl. retries) for the server's "
+                             "per-tenant cost accounting (default: $USER)")
     parser.add_argument("--no-resume", action="store_true",
                         help="regenerate outputs that already exist instead "
                              "of skipping them (resume is the default so a "
@@ -253,7 +267,7 @@ def main(argv: list[str]) -> int:
     ok = generate(args.prompt, args.steps, args.url, out_dir, args.prefix,
                   args.count, args.delay, args.width, args.height,
                   concurrency=args.concurrency, resume=not args.no_resume,
-                  retries=args.retries)
+                  retries=args.retries, tenant=args.tenant)
     print(f"All done. Images saved under {out_dir.resolve()}")
     return 0 if ok == args.count else 1
 

@@ -1,0 +1,526 @@
+"""Tenant-attributed cost accounting (tpustack.obs.accounting).
+
+The acceptance bars this file carries:
+
+- **Conservation** — over a mixed-tenant engine run, per-tenant
+  chip-seconds sum to the engine's busy wall time as the flight
+  recorder's wave records measure it (within 1%; in fact exactly,
+  because the ledger charges FROM the records), and per-tenant token
+  totals equal the run's exact token counts.  Attribution is accounting,
+  not estimation.
+- **Cardinality bound** — a 1000-distinct-tenant flood yields at most
+  ``TPUSTACK_TENANT_CARDINALITY`` + 1 tenant label values (the ``other``
+  overflow bucket absorbs the tail) on EVERY tenant-labelled metric.
+- The HTTP surface: tenant extraction (header > body field > default),
+  ``/debug/tenants`` on the server app and the stdlib sidecar, goodput
+  outcomes, queue/KV-block charging.
+"""
+
+import asyncio
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpustack.obs import Registry
+from tpustack.obs import accounting
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ------------------------------------------------------------ unit: ledger
+def test_sanitize_and_resolve_tenant():
+    assert accounting.sanitize_tenant("  alice ") == "alice"
+    assert accounting.sanitize_tenant("b@d id!") == "b_d_id_"
+    assert accounting.sanitize_tenant("x" * 200) == "x" * 64
+    assert accounting.sanitize_tenant("") is None
+    assert accounting.sanitize_tenant(7) is None
+    # a client claiming the overflow bucket's name is renamed — 'other'
+    # must only ever mean "the cardinality cap's tail"
+    assert accounting.sanitize_tenant("other") == "other_"
+    assert accounting.resolve_tenant("hdr", {"tenant": "body"}) == "hdr"
+    assert accounting.resolve_tenant(None, {"tenant": "body"}) == "body"
+    assert accounting.resolve_tenant(None, {}) == "anonymous"
+    assert accounting.resolve_tenant(None, None) == "anonymous"
+
+
+def test_outcome_from_status():
+    assert accounting.outcome_from_status(200) == "ok"
+    assert accounting.outcome_from_status(302) == "ok"
+    assert accounting.outcome_from_status(429) == "shed"
+    assert accounting.outcome_from_status(503) == "shed"
+    assert accounting.outcome_from_status(504) == "deadline"
+    assert accounting.outcome_from_status(400) == "client_error"
+    assert accounting.outcome_from_status(500) == "error"
+
+
+def test_ledger_charges_and_snapshot():
+    led = accounting.TenantLedger(Registry(), cardinality=8)
+    led.charge_tokens("llm", "a", prompt=10, generated=5)
+    led.charge_chip_seconds("llm", "a", 0.5)
+    led.charge_kv_block_seconds("a", 2.0)
+    led.charge_queue_seconds("llm", "a", 0.25)
+    led.note_outcome("llm", "a", "ok")
+    led.note_outcome("llm", "a", "shed")
+    led.note_outcome("llm", "a", "client_error")  # not in the ratio
+    snap = led.snapshot()["tenants"]["a"]
+    assert snap["prompt_tokens"] == 10 and snap["generated_tokens"] == 5
+    assert snap["chip_seconds"] == pytest.approx(0.5)
+    assert snap["kv_block_seconds"] == pytest.approx(2.0)
+    assert snap["queue_seconds"] == pytest.approx(0.25)
+    assert snap["outcomes"] == {"ok": 1, "shed": 1, "client_error": 1}
+    assert snap["goodput_ratio"] == pytest.approx(0.5)  # ok / (ok+shed)
+
+
+def test_charge_flight_wave_splits_by_slots():
+    led = accounting.TenantLedger(Registry(), cardinality=8)
+    led.charge_flight_wave("llm", {"wave_s": 0.8,
+                                   "tenants": {"a": 3, "b": 1}})
+    snap = led.snapshot()["tenants"]
+    assert snap["a"]["chip_seconds"] == pytest.approx(0.6)
+    assert snap["b"]["chip_seconds"] == pytest.approx(0.2)
+    # a record without wave_s (the run's first wave) or without tenants
+    # (bench paths) charges nothing
+    led.charge_flight_wave("llm", {"wave_s": None, "tenants": {"a": 1}})
+    led.charge_flight_wave("llm", {"wave_s": 1.0})
+    assert (sum(t["chip_seconds"] for t in led.snapshot()["tenants"]
+                .values()) == pytest.approx(0.8))
+
+
+def _tenant_label_values(reg: Registry):
+    """metric family name → set of tenant label values in the rendered
+    exposition (what a scraper's TSDB would see)."""
+    out = {}
+    for line in reg.render().splitlines():
+        if line.startswith("#") or "tenant=" not in line:
+            continue
+        name = line.split("{", 1)[0]
+        m = re.search(r'tenant="([^"]*)"', line)
+        out.setdefault(name, set()).add(m.group(1))
+    return out
+
+
+def test_cardinality_bound_under_tenant_flood():
+    """ACCEPTANCE: 1000 distinct tenants → ≤ cardinality+1 label values
+    on every tenant-labelled metric, with 'other' absorbing the tail."""
+    reg = Registry()
+    led = accounting.TenantLedger(reg, cardinality=16)
+    for i in range(1000):
+        t = f"tenant-{i:04d}"
+        led.charge_tokens("llm", t, prompt=1, generated=1)
+        led.charge_chip_seconds("llm", t, 0.001)
+        led.charge_kv_block_seconds(t, 0.001)
+        led.charge_queue_seconds("llm", t, 0.001)
+        led.note_outcome("llm", t, "ok")
+    families = _tenant_label_values(reg)
+    # every tenant-labelled family the catalog declares is present
+    assert {n.split("_bucket")[0] for n in families} >= {
+        "tpustack_tenant_prompt_tokens_total",
+        "tpustack_tenant_generated_tokens_total",
+        "tpustack_tenant_chip_seconds_total",
+        "tpustack_tenant_kv_block_seconds_total",
+        "tpustack_tenant_queue_seconds_total",
+        "tpustack_tenant_requests_total",
+        "tpustack_tenant_goodput_ratio",
+    }
+    for name, values in families.items():
+        assert len(values) <= 17, (name, len(values))
+        assert "other" in values, name
+    snap = led.snapshot()
+    assert snap["tracked_tenants"] <= 17
+    assert snap["overflowed_tenants"] == 1000 - 16
+    # the overflow bucket holds the tail's spend, not /dev/null
+    assert snap["tenants"]["other"]["prompt_tokens"] == 1000 - 16
+
+
+def test_ledger_thread_safety_conserves_totals():
+    led = accounting.TenantLedger(Registry(), cardinality=4)
+
+    def worker(tenant):
+        for _ in range(500):
+            led.charge_tokens("llm", tenant, prompt=1, generated=2)
+            led.charge_chip_seconds("llm", tenant, 0.001)
+            led.note_outcome("llm", tenant, "ok")
+
+    threads = [threading.Thread(target=worker, args=(f"t{i}",))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = led.snapshot()["tenants"]
+    assert sum(t["prompt_tokens"] for t in snap.values()) == 3000
+    assert sum(t["generated_tokens"] for t in snap.values()) == 6000
+    assert sum(sum(t["outcomes"].values()) for t in snap.values()) == 3000
+    assert sum(t["chip_seconds"] for t in snap.values()) == pytest.approx(
+        3.0, rel=1e-6)
+
+
+# ------------------------------------------------- kv_pool block-seconds
+def test_kv_pool_block_seconds_accounting():
+    from tpustack.serving.kv_pool import KVBlockPool
+
+    pool = KVBlockPool(9, 4)
+    ids = pool.alloc_tokens(10)  # 3 blocks
+    time.sleep(0.05)
+    assert pool.stats()["block_seconds_total"] == 0.0  # still held
+    pool.decref(ids)
+    total = pool.block_seconds_total
+    assert total >= 3 * 0.05 * 0.5  # 3 blocks x ≥~50ms (lenient timer)
+    assert pool.stats()["block_seconds_total"] == pytest.approx(total,
+                                                               abs=1e-3)
+    # a shared block bills its full alloc→release lifetime once
+    ids2 = pool.alloc_tokens(4)
+    pool.incref(ids2)
+    pool.decref(ids2)
+    before = pool.block_seconds_total
+    assert before == pytest.approx(total)  # still referenced → unaccounted
+    time.sleep(0.02)
+    pool.decref(ids2)
+    assert pool.block_seconds_total > before
+
+
+# ------------------------------------------------ engine: conservation
+@pytest.fixture(scope="module")
+def tiny_gen():
+    import jax.numpy as jnp
+
+    from tpustack.models.llama import LlamaConfig
+    from tpustack.models.llm_generate import Generator
+
+    return Generator(LlamaConfig.tiny(max_seq=96), dtype=jnp.float32,
+                     seed=3)
+
+
+def test_engine_chip_seconds_conservation(tiny_gen):
+    """ACCEPTANCE (conservation): per-tenant chip-seconds sum to the
+    engine's busy wall time as the flight-record waves measure it —
+    exactly, because the ledger charges from the same records."""
+    from tpustack.models.llm_continuous import ContinuousEngine, SlotRequest
+    from tpustack.models.llm_generate import SampleConfig
+    from tpustack.obs import flight as obs_flight
+
+    led = accounting.TenantLedger(Registry(), cardinality=8)
+    rec = obs_flight.FlightRecorder("conservation", capacity=512)
+    engine = ContinuousEngine(tiny_gen, slots=4, chunk=4, flight=rec,
+                              ledger=led, spec=None)
+    # STAGGERED budgets: requests retire in different waves, so final
+    # waves carry a mix of tenants — the shape that catches the
+    # snapshot-after-retire misattribution bug (a request's last wave
+    # must still bill its tenant)
+    reqs = [SlotRequest(ids=[5 + i] * (6 + i), max_new=8 + 7 * i,
+                        sample=SampleConfig(greedy=True),
+                        tenant=("interactive" if i % 3 else "batch"))
+            for i in range(7)]
+    it = iter(reqs)
+    engine.run(lambda: next(it, None))
+
+    all_waves = [r for r in rec.recent()
+                 if r["kind"] in ("wave", "verify")]
+    # every wave that served live slots carries its tenant split — the
+    # run's LAST wave (occupancy 1, the longest request finishing)
+    # included
+    for r in all_waves:
+        if r["occupancy"]:
+            assert r.get("tenants"), r
+            assert sum(r["tenants"].values()) == r["occupancy"]
+    assert all_waves[-1]["occupancy"] >= 1
+    waves = [r for r in all_waves if r.get("wave_s") and r.get("tenants")]
+    assert len(waves) >= 3, "run too short to measure waves"
+    busy = sum(r["wave_s"] for r in waves)
+    snap = led.snapshot()["tenants"]
+    attributed = sum(t["chip_seconds"] for t in snap.values())
+    assert attributed == pytest.approx(busy, rel=0.01)
+    assert set(snap) == {"interactive", "batch"}
+    assert all(t["chip_seconds"] > 0 for t in snap.values())
+
+
+# --------------------------------------------------- HTTP: llm end-to-end
+@pytest.fixture(scope="module")
+def llm_server(tiny_gen):
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    reg = Registry()
+    server = LLMServer(generator=tiny_gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test", max_batch=4, registry=reg)
+    return server, reg
+
+
+def test_llm_http_tenant_attribution_and_token_conservation(llm_server):
+    """Header > body-field > default extraction; exact per-tenant token
+    totals (= the responses' own counts); goodput outcomes; KV-block and
+    queue seconds accrue; /debug/tenants serves the ledger."""
+    server, reg = llm_server
+
+    async def scenario():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            bodies = {}
+            r = await client.post(
+                "/completion",
+                json={"prompt": "hello there", "n_predict": 24,
+                      "temperature": 0},
+                headers={"X-Tenant-Id": "alice"})
+            assert r.status == 200
+            bodies["alice"] = await r.json()
+            # the header wins over a conflicting body field
+            r = await client.post(
+                "/completion",
+                json={"prompt": "second prompt", "n_predict": 24,
+                      "temperature": 0, "tenant": "mallory"},
+                headers={"X-Tenant-Id": "alice"})
+            assert r.status == 200
+            bodies["alice2"] = await r.json()
+            r = await client.post(
+                "/completion",
+                json={"prompt": "third one", "n_predict": 24,
+                      "temperature": 0, "tenant": "bob"})
+            assert r.status == 200
+            bodies["bob"] = await r.json()
+            r = await client.post(
+                "/completion",
+                json={"prompt": "no tenant", "n_predict": 8,
+                      "temperature": 0})
+            assert r.status == 200
+            bodies["anonymous"] = await r.json()
+            # a 400 counts as the tenant's client_error, not against
+            # goodput
+            r = await client.post("/completion", json={"prompt": ""},
+                                  headers={"X-Tenant-Id": "alice"})
+            assert r.status == 400
+            rt = await client.get("/debug/tenants")
+            assert rt.status == 200
+            return bodies, await rt.json()
+        finally:
+            await client.close()
+
+    bodies, snap = _run(scenario())
+    tenants = snap["tenants"]
+    assert "mallory" not in tenants  # header beat the body field
+    alice, bob = tenants["alice"], tenants["bob"]
+    anon = tenants["anonymous"]
+    # EXACT token conservation against the responses' own counts
+    assert alice["prompt_tokens"] == (
+        bodies["alice"]["tokens_evaluated"]
+        + bodies["alice2"]["tokens_evaluated"])
+    assert alice["generated_tokens"] == (
+        bodies["alice"]["tokens_predicted"]
+        + bodies["alice2"]["tokens_predicted"])
+    assert bob["prompt_tokens"] == bodies["bob"]["tokens_evaluated"]
+    assert bob["generated_tokens"] == bodies["bob"]["tokens_predicted"]
+    assert anon["generated_tokens"] == bodies["anonymous"][
+        "tokens_predicted"]
+    # outcomes: 2 ok + 1 client_error for alice → goodput stays 1.0
+    assert alice["outcomes"]["ok"] == 2
+    assert alice["outcomes"]["client_error"] == 1
+    assert alice["goodput_ratio"] == 1.0
+    # queue + KV residency accrued for everyone who decoded
+    for t in (alice, bob, anon):
+        assert t["queue_seconds"] > 0
+        assert t["kv_block_seconds"] > 0
+    # chip-seconds conservation against the server's flight recorder
+    waves = [r for r in server.flight.recent()
+             if r["kind"] in ("wave", "verify") and r.get("wave_s")
+             and r.get("tenants")]
+    busy = sum(r["wave_s"] for r in waves)
+    attributed = sum(t["chip_seconds"] for t in tenants.values())
+    assert attributed == pytest.approx(busy, rel=0.01)
+    # the root span carries the tenant attribute (middleware stamping)
+    m = reg.get_sample_value("tpustack_tenant_requests_total",
+                             {"server": "llm", "tenant": "alice",
+                              "outcome": "ok"})
+    assert m == 2
+
+
+def test_llm_shed_counts_against_tenant_goodput(tiny_gen, monkeypatch):
+    """A backpressure 429 lands as the tenant's shed outcome and drops
+    its goodput below 1."""
+    monkeypatch.setenv("TPUSTACK_MAX_QUEUE_DEPTH", "1")
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    reg = Registry()
+    server = LLMServer(generator=tiny_gen, tokenizer=ByteTokenizer(512),
+                       model_name="tiny-test", max_batch=2, registry=reg)
+
+    async def scenario():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            rs = await asyncio.gather(*[
+                client.post("/completion",
+                            json={"prompt": f"p{i} xxxx", "n_predict": 24,
+                                  "temperature": 0},
+                            headers={"X-Tenant-Id": "flood"})
+                for i in range(8)])
+            return [r.status for r in rs]
+        finally:
+            await client.close()
+
+    statuses = _run(scenario())
+    assert 429 in statuses  # the flood was shed
+    snap = server.ledger.snapshot()["tenants"]["flood"]
+    assert snap["outcomes"].get("shed", 0) == statuses.count(429)
+    assert snap["goodput_ratio"] < 1.0
+
+
+# -------------------------------------- middleware outcome accounting
+def test_middleware_outcome_modes_and_override():
+    """'refusals' mode (graph): non-ok statuses count at the middleware
+    (a shed request never reaches the worker), ok does not (the worker
+    publishes the real verdict).  A handler whose 200 can't carry the
+    verdict (SSE deadline) overrides via request['tenant_outcome']."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpustack.obs import http as obs_http
+
+    reg = Registry()
+    led = accounting.TenantLedger(reg, cardinality=8)
+
+    async def ok(request):
+        return web.json_response({})
+
+    async def shed(request):
+        raise web.HTTPTooManyRequests()
+
+    async def sse_deadline(request):
+        request["tenant_outcome"] = "deadline"
+        return web.json_response({})  # HTTP 200, real outcome overridden
+
+    app = web.Application(middlewares=[obs_http.instrument(
+        "graph", reg, ledger=led,
+        work_endpoints={"/ok", "/shed", "/sse"},
+        outcome_accounting="refusals")])
+    app.router.add_post("/ok", ok)
+    app.router.add_post("/shed", shed)
+    app.router.add_post("/sse", sse_deadline)
+
+    async def scenario():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            hdr = {"X-Tenant-Id": "t"}
+            assert (await client.post("/ok", headers=hdr)).status == 200
+            assert (await client.post("/shed", headers=hdr)).status == 429
+            assert (await client.post("/sse", headers=hdr)).status == 200
+        finally:
+            await client.close()
+
+    _run(scenario())
+    out = led.snapshot()["tenants"]["t"]["outcomes"]
+    # ok NOT counted (worker's job in refusals mode); shed and the
+    # overridden deadline are
+    assert out == {"shed": 1, "deadline": 1}
+
+
+# ------------------------------------------------------- sidecar + threads
+def test_sidecar_concurrent_scrape_safety():
+    """Hammer the stdlib sidecar's /metrics, /debug/flight and
+    /debug/tenants from threads while an engine-shaped feeder records and
+    charges — no exception, no torn read (every response parses)."""
+    from tpustack.obs import flight as obs_flight
+    from tpustack.obs.http import start_metrics_sidecar
+
+    rec = obs_flight.register(obs_flight.FlightRecorder("scrape-hammer",
+                                                        capacity=64))
+    server = start_metrics_sidecar(0, Registry())
+    port = server.server_address[1]
+    stop = threading.Event()
+    errors = []
+
+    def feeder():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            rec.record("wave", tokens=2, occupancy=2, weight_passes=4,
+                       wave_s=0.001, tenants={"a": 1, "b": 1})
+            accounting.LEDGER.charge_flight_wave("llm", {
+                "wave_s": 0.001, "tenants": {"a": 1, "b": 1}})
+            accounting.LEDGER.note_outcome("llm", f"hammer-{i % 40}", "ok")
+
+    def scraper(path, parse):
+        try:
+            for _ in range(30):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}",
+                        timeout=10) as resp:
+                    assert resp.status == 200
+                    parse(resp.read().decode())
+        except Exception as e:  # surfaced below — the test's whole point
+            errors.append((path, repr(e)))
+
+    feed = threading.Thread(target=feeder, daemon=True)
+    feed.start()
+    threads = [
+        threading.Thread(target=scraper, args=(p, f), daemon=True)
+        for p, f in (("/metrics", str),
+                     ("/debug/flight", json.loads),
+                     ("/debug/tenants", json.loads))
+        for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert errors == []
+    finally:
+        stop.set()
+        server.shutdown()
+    snap = accounting.LEDGER.snapshot()
+    assert snap["tenants"]["a"]["chip_seconds"] > 0
+
+
+# ------------------------------------------------------ slo_report surface
+def test_slo_report_surfaces_tenant_section(tmp_path, capsys):
+    import tools.slo_report as slo
+
+    scrape = "\n".join([
+        'tpustack_http_requests_total{server="llm",endpoint="/completion",'
+        'status="200"} 10',
+        'tpustack_http_request_latency_seconds_bucket{server="llm",'
+        'endpoint="/completion",le="30"} 10',
+        'tpustack_http_request_latency_seconds_count{server="llm",'
+        'endpoint="/completion"} 10',
+        'tpustack_tenant_requests_total{server="llm",tenant="a",'
+        'outcome="ok"} 8',
+        'tpustack_tenant_requests_total{server="llm",tenant="a",'
+        'outcome="shed"} 2',
+        'tpustack_tenant_chip_seconds_total{server="llm",tenant="a"} 4.5',
+        'tpustack_tenant_kv_block_seconds_total{tenant="a"} 12.0',
+        'tpustack_tenant_queue_seconds_total{server="llm",tenant="a"} 1.5',
+        'tpustack_tenant_prompt_tokens_total{server="llm",tenant="a"} 100',
+        'tpustack_tenant_generated_tokens_total{server="llm",tenant="a"} '
+        '50',
+    ]) + "\n"
+    f = tmp_path / "scrape.txt"
+    f.write_text(scrape)
+    rc = slo.main(["--file", str(f), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    tenants = out["_tenants"]
+    assert tenants["a"]["goodput_ratio"] == pytest.approx(0.8)
+    assert tenants["a"]["chip_seconds"] == pytest.approx(4.5)
+    assert tenants["a"]["kv_block_seconds"] == pytest.approx(12.0)
+    assert tenants["a"]["prompt_tokens"] == 100
+    assert tenants["a"]["requests"] == {"ok": 8, "shed": 2}
+    # the window semantics follow the SLI counters: --prev deltas
+    prev = tmp_path / "prev.txt"
+    prev.write_text(scrape.replace(
+        'outcome="ok"} 8', 'outcome="ok"} 4').replace(
+        'tenant="a"} 100', 'tenant="a"} 60'))
+    rc = slo.main(["--file", str(f), "--prev", str(prev), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["_tenants"]["a"]["requests"]["ok"] == 4
+    assert out["_tenants"]["a"]["prompt_tokens"] == 40

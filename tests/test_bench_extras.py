@@ -36,6 +36,12 @@ def test_llm_extras_schema(monkeypatch):
                               "tokens_per_weight_pass": 2.1,
                               "live_mfu": None, "live_hbm_util": None,
                               "device_kind": None},
+                   # the replay extra's artifact keys ride the same keep
+                   # list into the driver artifact
+                   "schedule_sha": "abc123", "offered_rps": 5.0,
+                   "goodput_rps": 4.5, "goodput_ratio": 0.9,
+                   "shed": 2, "deadline": 1, "errors": 3,
+                   "tenants": {"interactive": {"offered": 10}},
                    "ignored_key": "must not leak into the artifact"}
         return subprocess.CompletedProcess(cmd, 0,
                                            stdout=json.dumps(payload) + "\n",
@@ -44,7 +50,7 @@ def test_llm_extras_schema(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     out = bench._llm_extras(lambda *a: None)
     assert set(out) == {"continuous_e2e", "prefill_8k", "shared_prefix",
-                        "paged", "speculative", "tp"}
+                        "paged", "speculative", "tp", "replay"}
     for sub in out.values():
         assert sub["value"] == 1.0
         assert sub["steady_decode_tokens_per_sec"] == 2.0
@@ -52,15 +58,22 @@ def test_llm_extras_schema(monkeypatch):
     # the flight aggregates ride the continuous cell into the artifact
     assert out["continuous_e2e"]["flight"]["mean_occupancy"] == 7.5
     assert out["continuous_e2e"]["flight"]["spec_acceptance"] == 0.6
-    # the six bench_llm invocations: batch-8 continuous + the 8k prefill
+    # the replay cell keeps the open-loop goodput/percentile keys
+    assert out["replay"]["goodput_ratio"] == 0.9
+    assert out["replay"]["schedule_sha"] == "abc123"
+    assert out["replay"]["errors"] == 3
+    assert out["replay"]["tenants"]["interactive"]["offered"] == 10
+    # the seven tool invocations: batch-8 continuous + the 8k prefill
     # + the shared-prefix (prefix KV cache) + the paged-KV sweep + the
-    # speculative-decoding sweep + the tensor-parallel sweep workloads
+    # speculative-decoding sweep + the tensor-parallel sweep + the
+    # open-loop trace replay
     assert any("--continuous" in c for c in calls)
     assert any("8192" in c for c in calls)
     assert any("--shared-prefix" in c for c in calls)
     assert any("--paged" in c for c in calls)
     assert any("--speculative" in c for c in calls)
     assert any("--tp" in c for c in calls)
+    assert any("--self-host" in c for c in calls)
 
 
 def test_wan_extras_schema(monkeypatch):
@@ -91,7 +104,7 @@ def test_extras_degrade_on_tool_failure(monkeypatch):
     out = bench._llm_extras(lambda *a: None)
     assert "error" in out["continuous_e2e"] and "error" in out["prefill_8k"]
     assert "error" in out["shared_prefix"] and "error" in out["paged"]
-    assert "error" in out["speculative"]
+    assert "error" in out["speculative"] and "error" in out["replay"]
     wan = bench._wan_extras(lambda *a: None)
     assert "error" in wan
 

@@ -1,0 +1,186 @@
+"""tools/replay.py — the open-loop trace-replay harness.
+
+Covers the seeded-schedule contract (same seed = byte-identical offered
+load), the arrival/length statistics the knobs promise, the artifact
+reducers, and THE acceptance bar: ``--tiny`` on CPU produces a seeded,
+reproducible artifact with per-tenant p50/p99 TTFT/e2e, goodput ratio,
+and shed/deadline counts for ≥2 tenants with different rates, with the
+server-side tenant ledger agreeing on who was served.
+"""
+
+import importlib.util
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_replay():
+    spec = importlib.util.spec_from_file_location(
+        "replay_mod", os.path.join(REPO, "tools", "replay.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def replay():
+    return load_replay()
+
+
+# ------------------------------------------------------------- schedule
+def test_parse_tenants(replay):
+    assert replay.parse_tenants("a:2,b:0.5") == {"a": 2.0, "b": 0.5}
+    with pytest.raises(ValueError):
+        replay.parse_tenants("nameonly")
+    with pytest.raises(ValueError):
+        replay.parse_tenants("")
+
+
+def test_schedule_is_seed_deterministic(replay):
+    kw = dict(tenants={"a": 5.0, "b": 1.0}, duration=10.0, burstiness=1.0,
+              prompt_chars=100.0, prompt_sigma=0.5, new_tokens=32.0,
+              output_sigma=0.5, prefix_pool=3)
+    s1 = replay.build_schedule(7, **kw)
+    s2 = replay.build_schedule(7, **kw)
+    assert s1 == s2
+    assert replay.schedule_sha(s1) == replay.schedule_sha(s2)
+    s3 = replay.build_schedule(8, **kw)
+    assert replay.schedule_sha(s1) != replay.schedule_sha(s3)
+
+
+def test_schedule_per_tenant_rngs_are_independent(replay):
+    """Adding a tenant must not reshuffle another's arrivals — each
+    tenant's stream is seeded from (seed, tenant)."""
+    kw = dict(duration=10.0, burstiness=1.0, prompt_chars=50.0,
+              prompt_sigma=0.5, new_tokens=16.0, output_sigma=0.5,
+              prefix_pool=2)
+    solo = [r for r in replay.build_schedule(1, tenants={"a": 3.0}, **kw)]
+    both = [r for r in replay.build_schedule(
+        1, tenants={"a": 3.0, "b": 2.0}, **kw) if r["tenant"] == "a"]
+    assert solo == both
+
+
+def test_schedule_rates_and_burstiness(replay):
+    kw = dict(tenants={"hot": 20.0}, duration=60.0, prompt_chars=50.0,
+              prompt_sigma=0.5, new_tokens=16.0, output_sigma=0.5,
+              prefix_pool=2)
+    poisson = replay.build_schedule(3, burstiness=1.0, **kw)
+    # ~20 rps x 60 s = ~1200 arrivals; Poisson sd ≈ 35
+    assert 1000 < len(poisson) < 1400
+    bursty = replay.build_schedule(3, burstiness=8.0, **kw)
+    # the MEAN rate is burstiness-invariant...
+    assert len(bursty) == pytest.approx(len(poisson), rel=0.2)
+
+    def cv2(schedule):
+        ts = [r["at"] for r in schedule]
+        gaps = [b - a for a, b in zip(ts, ts[1:])]
+        mean = statistics.fmean(gaps)
+        return statistics.pvariance(gaps) / (mean * mean)
+
+    # ...but the inter-arrival variability is not: the bursty schedule's
+    # CV^2 is far above the Poisson one's (~1)
+    assert cv2(bursty) > 2.5 * cv2(poisson)
+
+
+def test_schedule_prefix_pool_reuses_prefixes(replay):
+    sched = replay.build_schedule(
+        5, tenants={"chat": 10.0}, duration=20.0, burstiness=1.0,
+        prompt_chars=100.0, prompt_sigma=0.3, new_tokens=8.0,
+        output_sigma=0.3, prefix_pool=2)
+    prefixes = {r["prompt"].split(" q")[0] for r in sched}
+    assert len(prefixes) == 2  # every prompt drawn from the 2-deep pool
+    # ...but the suffixes differ, so requests are not identical
+    assert len({r["prompt"] for r in sched}) > 2
+
+
+# ------------------------------------------------------------- reduction
+def test_reduce_results_per_tenant(replay):
+    requests = ([{"at": 0, "tenant": "a"}] * 4
+                + [{"at": 0, "tenant": "b"}] * 2)
+    results = [
+        {"tenant": "a", "status": 200, "e2e_s": 1.0, "ttft_s": 0.2,
+         "tpot_ms": 10.0, "tokens": 5},
+        {"tenant": "a", "status": 200, "e2e_s": 3.0, "ttft_s": 0.4,
+         "tpot_ms": 30.0, "tokens": 7},
+        {"tenant": "a", "status": 429, "e2e_s": 0.01, "ttft_s": None,
+         "tpot_ms": None, "tokens": 0},
+        {"tenant": "a", "status": 504, "e2e_s": 5.0, "ttft_s": None,
+         "tpot_ms": None, "tokens": 0},
+        {"tenant": "b", "status": 200, "e2e_s": 2.0, "ttft_s": 0.3,
+         "tpot_ms": 20.0, "tokens": 4},
+        {"tenant": "b", "status": 500, "e2e_s": 0.1, "ttft_s": None,
+         "tpot_ms": None, "tokens": 0},
+    ]
+    out = replay.reduce_results(requests, results, duration=10.0,
+                                wall_s=10.0)
+    a, b = out["tenants"]["a"], out["tenants"]["b"]
+    assert a["offered"] == 4 and b["offered"] == 2
+    assert a["ok"] == 2 and a["shed"] == 1 and a["deadline"] == 1
+    assert a["goodput_ratio"] == pytest.approx(0.5)
+    assert a["e2e_s"]["p50"] == pytest.approx(2.0)
+    assert a["ttft_s"]["p99"] == pytest.approx(0.398, abs=0.01)
+    assert b["error"] == 1 and b["goodput_ratio"] == pytest.approx(0.5)
+    assert out["offered"] == 6
+    assert out["goodput_ratio"] == pytest.approx(3 / 6)
+    assert out["shed"] == 1 and out["deadline"] == 1 and out["errors"] == 1
+
+
+# ----------------------------------------------------------- --tiny smoke
+def test_replay_tiny_smoke(tmp_path):
+    """ACCEPTANCE: the CPU smoke produces a seeded, reproducible
+    artifact with per-tenant p50/p99 TTFT/e2e, goodput, and
+    shed/deadline counts for ≥2 tenants with different rates."""
+    out = tmp_path / "replay.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "replay.py"),
+         "--tiny", "--seed", "0", "--out", str(out)],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # the one-line stdout contract (bench.py's _run_tool reads the last
+    # line) and the --out artifact agree
+    artifact = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert artifact == json.loads(out.read_text())
+    assert artifact["seed"] == 0
+    assert len(artifact["schedule_sha"]) == 16
+    tenants = artifact["tenants"]
+    assert len(tenants) >= 2
+    rates = {artifact["config"]["tenants"][t] for t in tenants}
+    assert len(rates) >= 2  # genuinely different offered rates
+    for t, d in tenants.items():
+        for k in ("offered", "ok", "shed", "deadline", "error",
+                  "goodput_ratio"):
+            assert k in d, (t, k)
+        assert set(d["ttft_s"]) == {"p50", "p99"}
+        assert set(d["e2e_s"]) == {"p50", "p99"}
+        # the smoke is sized so both tenants actually complete work —
+        # the percentiles must be real numbers, not null
+        assert d["ok"] > 0
+        assert d["e2e_s"]["p50"] > 0
+    # the self-hosted server's ledger saw the same tenants (attribution
+    # round trip: client artifact <-> server /debug/tenants)
+    server_side = artifact["server_tenants"]["tenants"]
+    assert set(tenants) <= set(server_side)
+    for t, d in tenants.items():
+        assert server_side[t]["outcomes"].get("ok", 0) == d["ok"]
+        assert server_side[t]["generated_tokens"] == d["tokens"]
+
+
+def test_replay_tiny_schedule_matches_tool_defaults():
+    """The smoke's offered load is a pure function of the seed: building
+    the tiny schedule twice from fresh module loads yields the same
+    digest (what test_replay_tiny_smoke's artifact pins)."""
+    shas = []
+    for _ in range(2):
+        mod = load_replay()
+        sched = mod.build_schedule(
+            0, {"interactive": 3.0, "batch": 1.0}, 2.0, 1.0, 24.0, 0.6,
+            4.0, 0.6, 4, max_new_cap=8)
+        shas.append(mod.schedule_sha(sched))
+    assert shas[0] == shas[1]

@@ -397,6 +397,39 @@ def test_tpl601_manifest_checker_green():
     assert lint_repo(select=["TPL601"]) == []
 
 
+# --------------------------------------- TPL502 unbounded-tenant-label
+def test_tpl502_fires_on_direct_tenant_label(tmp_path):
+    found = _lint(tmp_path, """
+        def charge(metrics, tenant):
+            metrics["tpustack_tenant_chip_seconds_total"].labels(
+                server="llm", tenant=tenant).inc(1.0)
+    """, select=["TPL502"])
+    assert _codes(found) == ["TPL502"]
+    assert "TenantLedger" in found[0].message
+
+
+def test_tpl502_quiet_on_other_labels_and_in_ledger(tmp_path):
+    # non-tenant labels are not this rule's business
+    assert _lint(tmp_path, """
+        def count(metrics):
+            metrics["tpustack_http_requests_total"].labels(
+                server="llm", endpoint="/x", status="200").inc()
+    """, select=["TPL502"]) == []
+    # the accounting module itself is the sanctioned writer
+    led = tmp_path / "tpustack" / "obs"
+    led.mkdir(parents=True)
+    f = led / "accounting.py"
+    f.write_text("def w(m, t):\n    m.labels(tenant=t).inc()\n")
+    assert lint_files([str(f)], root=tmp_path, select=["TPL502"],
+                      unscoped=True) == []
+
+
+def test_tpl502_repo_is_clean():
+    """The repo's only tenant-label writer is the ledger (the invariant
+    that keeps the tenant cardinality bound unbypassable)."""
+    assert lint_repo(select=["TPL502"]) == []
+
+
 # ----------------------------------------------------------- suppressions
 def test_line_suppression(tmp_path):
     src = """

@@ -239,6 +239,56 @@ def utilization_report(samples: Dict[Sample, float]) -> Dict[str, float]:
     return out
 
 
+#: goodput outcome denominators (mirror tpustack.obs.accounting:
+#: client_error is counted but excluded from the ratio)
+_GOODPUT_OUTCOMES = ("ok", "shed", "deadline", "error")
+
+#: tenant-labelled counters folded into the per-tenant table (all
+#: window-delta'd like the SLI counters, so --prev gives "who spent what
+#: in the window", exactly what the QoS layer needs)
+_TENANT_COUNTERS = (
+    ("tpustack_tenant_prompt_tokens_total", "prompt_tokens"),
+    ("tpustack_tenant_generated_tokens_total", "generated_tokens"),
+    ("tpustack_tenant_chip_seconds_total", "chip_seconds"),
+    ("tpustack_tenant_kv_block_seconds_total", "kv_block_seconds"),
+    ("tpustack_tenant_queue_seconds_total", "queue_seconds"),
+)
+
+
+def tenant_report(samples: Dict[Sample, float]) -> Dict[str, dict]:
+    """Per-tenant cost + goodput over the report window, from the
+    ``tpustack_tenant_*`` counters (tpustack.obs.accounting; the tenant
+    label is cardinality-bounded, so this table is too — the ``other``
+    row aggregates the tail).  Empty dict when the scrape carries no
+    tenant metrics (pre-accounting pods)."""
+    out: Dict[str, dict] = {}
+
+    def row(tenant: str) -> dict:
+        return out.setdefault(tenant, {
+            "requests": {}, "goodput_ratio": None,
+            **{key: 0.0 for _, key in _TENANT_COUNTERS}})
+
+    for (name, labels), v in samples.items():
+        d = dict(labels)
+        tenant = d.get("tenant")
+        if tenant is None:
+            continue
+        if name == "tpustack_tenant_requests_total":
+            r = row(tenant)["requests"]
+            outcome = d.get("outcome", "unknown")
+            r[outcome] = r.get(outcome, 0) + int(v)
+            continue
+        for counter, key in _TENANT_COUNTERS:
+            if name == counter:
+                row(tenant)[key] = round(row(tenant)[key] + v, 6)
+    for tenant, entry in out.items():
+        denom = sum(entry["requests"].get(k, 0) for k in _GOODPUT_OUTCOMES)
+        if denom:
+            entry["goodput_ratio"] = round(
+                entry["requests"].get("ok", 0) / denom, 6)
+    return out
+
+
 def _read(source: str) -> str:
     if source.startswith(("http://", "https://")):
         import urllib.request
@@ -296,12 +346,16 @@ def main(argv: List[str] = None) -> int:
             print(f"slo_report: skipping delta window — cannot use "
                   f"--prev {args.prev}: {e}", file=sys.stderr)
             prev = None
-    rep = report(delta(samples, prev))
+    windowed = delta(samples, prev)
+    rep = report(windowed)
     util = utilization_report(samples)
+    tenants = tenant_report(windowed)
     if args.as_json:
         out = dict(rep)
         if util:
             out["_utilization"] = util
+        if tenants:
+            out["_tenants"] = tenants
         print(json.dumps(out))
     else:
         _print_human(rep)
@@ -309,6 +363,18 @@ def main(argv: List[str] = None) -> int:
             print("utilization (flight-recorder gauges, current scrape):")
             for k, v in util.items():
                 print(f"  {k:<28} {v}")
+        if tenants:
+            print("tenants (cost accounting, report window):")
+            for t, e in sorted(tenants.items()):
+                gp = (f"{e['goodput_ratio']:.2%}"
+                      if e["goodput_ratio"] is not None else "—")
+                print(f"  {t:<20} goodput={gp} "
+                      f"chip={e['chip_seconds']:.2f}s "
+                      f"kv={e['kv_block_seconds']:.1f}blk·s "
+                      f"queue={e['queue_seconds']:.2f}s "
+                      f"tok={int(e['prompt_tokens'])}+"
+                      f"{int(e['generated_tokens'])} "
+                      f"requests={e['requests']}")
     ok = all(r["ok"] for entry in rep.values() for r in entry.values())
     return 0 if ok else 1
 

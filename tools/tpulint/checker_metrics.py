@@ -25,13 +25,15 @@ as the TPL501 checker alongside the AST rules.
 
 from __future__ import annotations
 
+import ast
 import os
 import re
 import sys
 from pathlib import Path
-from typing import List
+from typing import Iterator, List
 
-from tools.tpulint.core import REPO, Finding, repo_rule
+from tools.tpulint.core import (REPO, FileContext, Finding, file_rule,
+                                repo_rule)
 
 _NAME_RE = re.compile(r"^tpustack(_[a-z0-9]+)+$")
 _LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
@@ -153,6 +155,38 @@ def lint(doc_path: str = DOC_PATH) -> List[str]:
         if spec.type != "histogram" and spec.buckets is not None:
             errors.append(f"{where} buckets on a non-histogram")
     return errors
+
+
+#: the one module allowed to write tenant-labelled series: the bounded
+#: accounting registry (first-K tenants + the 'other' overflow bucket)
+_TENANT_LEDGER_MODULE = "tpustack/obs/accounting.py"
+
+
+@file_rule("TPL502", "unbounded-tenant-label",
+           "tenant-labelled metrics must be written through the bounded "
+           "accounting ledger (tpustack.obs.accounting)")
+def unbounded_tenant_label(ctx: FileContext) -> Iterator[Finding]:
+    """A ``.labels(tenant=...)`` call anywhere outside
+    ``tpustack/obs/accounting.py`` bypasses the TenantLedger's
+    cardinality bound — a raw client-supplied tenant id would mint one
+    time series per distinct value, and a hostile client mints one per
+    request.  The ledger caps distinct label values at
+    ``TPUSTACK_TENANT_CARDINALITY`` (overflow → ``other``), so every
+    tenant-labelled write must go through its charge methods."""
+    if ctx.rel.endswith(_TENANT_LEDGER_MODULE):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels"):
+            continue
+        if any(kw.arg == "tenant" for kw in node.keywords):
+            yield Finding(
+                "TPL502", ctx.rel, node.lineno,
+                "direct labels(tenant=...) call — write tenant-labelled "
+                "metrics through tpustack.obs.accounting.TenantLedger "
+                "(bounded cardinality: top-K tenants + 'other' overflow)")
 
 
 @repo_rule("TPL501", "metric-catalog",

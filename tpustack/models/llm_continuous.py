@@ -159,6 +159,16 @@ class SlotRequest:
     kv_blocks: Optional[List[int]] = None
     on_prefill_blocks: Optional[Callable[[List[int]], None]] = None
     speculative: bool = True
+    # tenant cost accounting (tpustack.obs.accounting): the request's
+    # tenant id, resolved once by the HTTP middleware and carried here
+    # explicitly (engine threads don't inherit the contextvar — same
+    # contract as span_ctx), and the wall-clock the request's paged KV
+    # blocks were allocated at (the server's admission-is-allocation
+    # point; None = the engine's own admission time) — the alloc→release
+    # window the KV-block-seconds charge covers.  Both None on bench/CLI
+    # paths: no ledger, no accounting.
+    tenant: Optional[str] = None
+    t_kv_alloc: Optional[float] = None
 
 
 class _Slot:
@@ -227,7 +237,8 @@ class ContinuousEngine:
                  on_progress: Optional[Callable[[str], None]] = None,
                  tracer=None, paged=None, spec=None, on_spec=None,
                  compile_budgets: Optional[Dict[str, int]] = None,
-                 flight=None, queue_depth: Optional[Callable[[], int]] = None):
+                 flight=None, queue_depth: Optional[Callable[[], int]] = None,
+                 ledger=None):
         self.gen = gen
         self.B = slots
         self.chunk = chunk
@@ -296,6 +307,14 @@ class ContinuousEngine:
         # boundary already holds; recording never syncs the device.  None
         # keeps the engine record-free (bench/CLI paths).
         self.flight = flight
+        # tenant ledger (tpustack.obs.accounting.TenantLedger): chip-
+        # seconds are charged FROM each wave's flight record (wave wall
+        # time split across the occupied slots' tenants — the record and
+        # the ledger hold the same numbers, so /debug/flight and
+        # /debug/tenants can never disagree) and KV-block-seconds at
+        # retire (blocks held x alloc→release wall).  None keeps the
+        # engine accounting-free (bench/CLI paths).
+        self.ledger = ledger
         self._queue_depth_fn = queue_depth
         self._last_wave_t: Optional[float] = None
         self._to_park: List[int] = []  # retirements awaiting a fused park
@@ -862,6 +881,19 @@ class ContinuousEngine:
             s.span.end()
             s.span = None
         if self.paged is not None and s.blocks:
+            if self.ledger is not None and req is not None \
+                    and req.tenant is not None:
+                # KV-block-seconds, alloc→release: blocks held x wall
+                # since the request's allocation (the server's admission
+                # point when it pre-allocated, this engine's otherwise).
+                # Charged per REFERENCE — a shared prefix block bills
+                # each tenant for the window it held its own ref, which
+                # is the residency each actually caused.
+                held_s = time.time() - (req.t_kv_alloc
+                                        if req.t_kv_alloc is not None
+                                        else s.t0)
+                self.ledger.charge_kv_block_seconds(
+                    req.tenant, len(s.blocks) * max(0.0, held_s))
             # one decref per held reference (shared prefix + fresh alike);
             # blocks the prefix cache also references survive — everything
             # else returns to the free list before on_done fires, so a
@@ -1069,15 +1101,30 @@ class ContinuousEngine:
             sanitize.check_kv_conservation(self.paged.pool,
                                            where="wave boundary")
 
+    @staticmethod
+    def _tenant_occupancy(slots) -> Dict[str, int]:
+        """{tenant: live slots} — the chip-seconds split key.  Callers
+        snapshot it AT FETCH, before retiring finished rows, or a
+        request's final wave would drop out of (or be misattributed in)
+        its own record."""
+        tenants: Dict[str, int] = {}
+        for s in slots:
+            if s.req is not None and s.req.tenant is not None:
+                tenants[s.req.tenant] = tenants.get(s.req.tenant, 0) + 1
+        return tenants
+
     def _flight_wave(self, slots, kind: str, tokens: int,
                      weight_passes: int, stride: float,
                      drafted: int = 0, accepted: int = 0,
-                     occupancy: Optional[int] = None) -> None:
+                     occupancy: Optional[int] = None,
+                     tenants: Optional[Dict[str, int]] = None) -> None:
         """Append one flight record for a fetched wave (plain chunk or
         speculative verify).  Host-side values only — the fetch that
         produced ``tokens`` already synced, so this is a dict build and a
-        deque append, nothing more.  ``occupancy`` is the live count AT
-        FETCH (callers snapshot it before retiring finished rows)."""
+        deque append, nothing more.  ``occupancy`` and ``tenants`` are
+        the live count / tenant split AT FETCH (callers snapshot both
+        before retiring finished rows, so a request's last wave still
+        carries — and bills — its tenant)."""
         if self.flight is None:
             return
         now = time.time()
@@ -1107,6 +1154,14 @@ class ContinuousEngine:
             rec["kv_free"] = free
             rec["kv_used"] = used
             rec["kv_fragmentation"] = round(frag, 4)
+        # per-wave tenant occupancy ({tenant: slots served}): the split
+        # key for the chip-seconds attribution — recorded IN the flight
+        # record and charged FROM it, so /debug/flight and the tenant
+        # ledger are the same numbers by construction
+        if tenants is None:
+            tenants = self._tenant_occupancy(slots)
+        if tenants:
+            rec["tenants"] = tenants
         slowest, age = None, 0.0
         for s in slots:
             if s.req is not None and now - s.t0 > age:
@@ -1117,6 +1172,8 @@ class ContinuousEngine:
             rec["slowest_age_s"] = round(age, 3)
             rec["slowest_trace_id"] = slowest
         self.flight.record(kind, **rec)
+        if self.ledger is not None:
+            self.ledger.charge_flight_wave("llm", rec)
 
     def _consume_block(self, state, slots, block, snapshot):
         """Host bookkeeping for one fetched plain chunk block (the consume
@@ -1131,6 +1188,7 @@ class ContinuousEngine:
                     len(s.out) for s in slots if s.req is not None),
                 self._wave_ctr))
         live = self._live(slots)
+        tenants = self._tenant_occupancy(slots)  # pre-retire, like live
         wave_tokens = 0
         for i, gid, offset in snapshot:
             s = slots[i]
@@ -1160,7 +1218,8 @@ class ContinuousEngine:
             if s.done:
                 self._retire(state, slots, i, live)
         self._flight_wave(slots, "wave", wave_tokens, self.chunk,
-                          stride=self.chunk, occupancy=live)
+                          stride=self.chunk, occupancy=live,
+                          tenants=tenants)
 
     def _run_loop(self, state, slots, chain, admit_free, dispatch_ok):
         while True:
@@ -1309,6 +1368,7 @@ class ContinuousEngine:
                 self._wave_ctr))
         alpha = spec.ema_alpha
         live = self._live(slots)
+        tenants = self._tenant_occupancy(slots)  # pre-retire, like live
         wave_tokens = wave_drafted = wave_accepted = 0
         for i, gid in rows:
             s = slots[i]
@@ -1360,7 +1420,7 @@ class ContinuousEngine:
         self._flight_wave(slots, "verify", wave_tokens, 1,
                           stride=wave_tokens / max(1, len(rows)),
                           drafted=wave_drafted, accepted=wave_accepted,
-                          occupancy=live)
+                          occupancy=live, tenants=tenants)
 
     def _run_loop_spec(self, state, slots, chain, admit_free, dispatch_ok):
         """Variable-stride wave loop (``spec`` configured): whenever the

@@ -16,6 +16,9 @@ The serving-stack observability layer (vLLM/TGI posture, zero new deps):
   live roofline attribution (MFU / HBM-utilization gauges).
 - :mod:`tpustack.obs.profile` — shared on-demand ``POST /profile``
   xplane-capture mechanics for all three serving surfaces.
+- :mod:`tpustack.obs.accounting` — tenant-attributed cost accounting
+  (tokens / chip-seconds / KV-block-seconds / queue-seconds / goodput
+  per tenant, bounded label cardinality, ``GET /debug/tenants``).
 - :mod:`tpustack.obs.http` — ``GET /metrics`` handler, aiohttp
   instrumentation middleware, stdlib sidecar for batch jobs.
 

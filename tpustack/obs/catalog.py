@@ -168,6 +168,49 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "raises it by accepted drafts).  0 when the window holds "
                "no waves.", unit="tokens"),
 
+    # ---- tenant cost accounting (tpustack.obs.accounting; the tenant
+    # label is BOUNDED: first TPUSTACK_TENANT_CARDINALITY distinct
+    # tenants + an 'other' overflow bucket.  Written ONLY through the
+    # TenantLedger — tpulint TPL502 flags any other labels(tenant=...)
+    # call site) ----
+    MetricSpec("tpustack_tenant_prompt_tokens_total", "counter",
+               "Prompt tokens prefilled, charged to the requesting tenant "
+               "(X-Tenant-Id header / body tenant field).",
+               ("server", "tenant"), unit="total"),
+    MetricSpec("tpustack_tenant_generated_tokens_total", "counter",
+               "Tokens generated for the tenant's completed requests.",
+               ("server", "tenant"), unit="total"),
+    MetricSpec("tpustack_tenant_chip_seconds_total", "counter",
+               "Device wall seconds attributed to the tenant: each engine "
+               "wave's wall time (the flight recorder's wave_s — live "
+               "attribution and /debug/flight share the record) split "
+               "across the slots it served; sd charges each fused batch's "
+               "denoise+VAE seconds split across its riders; graph charges "
+               "the finalize fetch per prompt (dispatch is async — its "
+               "device wall lands in the fetch).  Per-tenant sums equal "
+               "the engine's busy wall time — accounting, not estimation.",
+               ("server", "tenant"), unit="total"),
+    MetricSpec("tpustack_tenant_kv_block_seconds_total", "counter",
+               "Paged-KV residency bill: pool blocks held x seconds held "
+               "(allocation at admission to release at retire), per "
+               "tenant.  The HBM a slow-rolling request occupies while "
+               "others are shed.", ("tenant",), unit="total"),
+    MetricSpec("tpustack_tenant_queue_seconds_total", "counter",
+               "Admission-queue wall seconds the tenant's requests spent "
+               "waiting (llm slot queue, sd batch window, graph worker "
+               "queue).", ("server", "tenant"), unit="total"),
+    MetricSpec("tpustack_tenant_requests_total", "counter",
+               "Requests finished per tenant, by outcome (ok = completed "
+               "in-deadline | shed = 429/503 backpressure or drain | "
+               "deadline = 504 | error = 5xx | client_error = other 4xx, "
+               "excluded from goodput).", ("server", "tenant", "outcome"),
+               unit="total"),
+    MetricSpec("tpustack_tenant_goodput_ratio", "gauge",
+               "Lifetime goodput per tenant: ok / (ok + shed + deadline + "
+               "error).  The number the QoS layer (quotas, priorities, "
+               "SLO-aware shedding — ROADMAP item 5) will be judged by.",
+               ("server", "tenant"), unit="ratio"),
+
     # ---- serving mesh (tensor/data-parallel GSPMD serving) ----
     MetricSpec("tpustack_mesh_axis_chips", "gauge",
                "Serving-mesh axis sizes (dp/fsdp/tp/sp ways) of the "

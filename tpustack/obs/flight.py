@@ -159,6 +159,21 @@ class FlightRecorder:
                 "spec_accepted": accepted,
                 "spec_acceptance": accepted / drafted if drafted else None,
             })
+            # per-tenant slot-occupancy rollup: summing each wave's
+            # tenants map weighted by its wall time gives the same
+            # chip-second split the tenant ledger charges (the records
+            # ARE the ledger's source) — /debug/flight can answer "who
+            # was on the chip this window" without the ledger
+            tenant_s: Dict[str, float] = {}
+            for r in waves:
+                if r.get("tenants") and r.get("wave_s"):
+                    occ = sum(r["tenants"].values())
+                    for tenant, n in r["tenants"].items():
+                        tenant_s[tenant] = (tenant_s.get(tenant, 0.0)
+                                            + r["wave_s"] * n / occ)
+            if tenant_s:
+                out["tenant_chip_seconds"] = {
+                    t: round(v, 6) for t, v in sorted(tenant_s.items())}
             lastw = waves[-1]
             for k in ("queue_depth", "kv_free", "kv_used",
                       "kv_fragmentation"):

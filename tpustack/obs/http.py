@@ -21,6 +21,10 @@ Three pieces:
   /debug/flight`` (the engine flight recorder's recent ring + windowed
   aggregates); the sidecar serves the same path for every recorder
   registered in the process.
+- ``add_debug_tenant_routes(app, ledger)`` — mounts ``GET /debug/tenants``
+  (the tenant cost ledger's exact per-tenant accounts,
+  ``tpustack.obs.accounting``); the sidecar serves the process-wide
+  ledger on the same path.
 - ``start_metrics_sidecar(port, registry)`` — a stdlib ``http.server`` on a
   daemon thread, for processes that are NOT aiohttp apps (batch Jobs,
   trainers): set ``TPUSTACK_METRICS_PORT`` and the same registry becomes
@@ -39,6 +43,7 @@ import threading
 import time
 from typing import Optional
 
+from tpustack.obs import accounting as obs_accounting
 from tpustack.obs import catalog
 from tpustack.obs import trace as obs_trace
 from tpustack.obs.metrics import CONTENT_TYPE, REGISTRY, Registry
@@ -50,6 +55,7 @@ from tpustack.obs.trace import bind_request_id
 UNTRACED_ENDPOINTS = frozenset({
     "/metrics", "/health", "/healthz", "/readyz",
     "/debug/traces", "/debug/traces/{trace_id}", "/debug/flight",
+    "/debug/tenants",
     "__unmatched__",
     # poll loops (the wan client hits /history every few seconds for
     # minutes per prompt) — the prompt's real work is traced via its
@@ -82,8 +88,62 @@ def _endpoint_label(request) -> str:
     return canonical or "__unmatched__"
 
 
+#: JSON bodies larger than this are not parsed for a ``tenant`` field in
+#: the middleware — the handler reads them anyway (aiohttp caches the
+#: payload), this only bounds the middleware's own json.loads work
+_TENANT_BODY_MAX = 1 << 20
+
+
+async def _extract_tenant(request, read_body: bool) -> str:
+    """Tenant id for one request: ``X-Tenant-Id`` header first, else (on
+    work endpoints only) a JSON body's ``tenant`` field, else the
+    configured default.  Extraction happens ONCE, in the middleware; the
+    rest of the stack carries the resolved value (contextvar in handler
+    context, explicit fields across thread boundaries).
+
+    The body peek is limited to ``read_body`` (work) endpoints because
+    ``request.read()`` caches the payload for the handler's own
+    ``request.json()`` but flips ``request.can_read_body`` — handlers
+    that branch on it (the /profile surfaces) must see their requests
+    untouched."""
+    header = request.headers.get("X-Tenant-Id")
+    body = None
+    if (header is None and read_body and request.method == "POST"
+            and request.can_read_body
+            and request.content_type == "application/json"):
+        try:
+            raw = await request.read()
+            if len(raw) <= _TENANT_BODY_MAX:
+                import json as _json
+
+                parsed = _json.loads(raw)
+                # cache the parse for the handler (request_json below):
+                # the body bytes are cached by aiohttp but the PARSE is
+                # not, and work-endpoint handlers would otherwise pay
+                # json.loads twice per request
+                request["json_body"] = parsed
+                body = parsed if isinstance(parsed, dict) else None
+        except Exception:
+            body = None  # the handler surfaces the malformed body as 400
+    return obs_accounting.resolve_tenant(header, body)
+
+
+async def request_json(request):
+    """The request's parsed JSON body, reusing the tenant-extraction
+    middleware's parse when it already happened (work endpoints without
+    an ``X-Tenant-Id`` header).  Invalid JSON raises exactly like
+    ``request.json()`` — the middleware caches only successful parses."""
+    cached = request.get("json_body")
+    if cached is not None:
+        return cached
+    return await request.json()
+
+
 def instrument(server_name: str, registry: Optional[Registry] = None,
-               tracer: Optional[obs_trace.Tracer] = None):
+               tracer: Optional[obs_trace.Tracer] = None,
+               ledger: Optional[obs_accounting.TenantLedger] = None,
+               work_endpoints: Optional[frozenset] = None,
+               outcome_accounting: str = "full"):
     """aiohttp middleware: request-id + root span + counters + latency.
 
     Latency covers the handler including streaming bodies (SSE completions
@@ -95,11 +155,31 @@ def instrument(server_name: str, registry: Optional[Registry] = None,
     engine) and is exposed to handlers via the ``current_span`` contextvar
     and ``request["trace_span"]``; engine work on executor threads parents
     under it through explicitly passed :class:`SpanContext` handles.
+
+    Tenant attribution (``tpustack.obs.accounting``): the tenant id is
+    extracted ONCE here (header everywhere; body ``tenant`` field on
+    ``work_endpoints``; else the default), bound to the
+    ``current_tenant`` contextvar and ``request["tenant"]``, stamped as
+    a ``tenant`` attribute on the root span, and — for
+    ``work_endpoints`` only (the set the resilience middleware also
+    guards; probes and scrapes must not dilute goodput) — counted into
+    the per-tenant outcome/goodput accounting when the response status
+    is known.  A handler whose HTTP status cannot carry the real verdict
+    (an SSE stream that already flushed 200 headers before the deadline
+    fired) overrides via ``request["tenant_outcome"]``.
+    ``outcome_accounting="refusals"`` counts only non-``ok`` outcomes
+    here: accept-and-poll servers (graph) 200 instantly and count
+    ok/error/deadline at the worker's publish/refuse points — but a
+    request SHED by the resilience middleware (429/503) or rejected
+    (4xx) never reaches the worker, so those still land here.
     """
     from aiohttp import web
 
     m = catalog.build(registry)
     tracer = tracer if tracer is not None else obs_trace.TRACER
+    ledger = (ledger if ledger is not None
+              else obs_accounting.for_registry(registry))
+    work_endpoints = frozenset(work_endpoints or ())
     if tracer is not obs_trace.TRACER or registry is None:
         # wire capture counting only when tracer and registry pair up:
         # a private-registry app falling back to the PROCESS tracer must
@@ -114,6 +194,10 @@ def instrument(server_name: str, registry: Optional[Registry] = None,
         rid = bind_request_id(request.headers.get("X-Request-Id"))
         request["request_id"] = rid
         endpoint = _endpoint_label(request)
+        tenant = await _extract_tenant(request,
+                                       read_body=endpoint in work_endpoints)
+        request["tenant"] = tenant
+        tenant_token = obs_accounting.current_tenant.set(tenant)
         remote = obs_trace.parse_traceparent(
             request.headers.get("traceparent"))
         span = token = None
@@ -121,7 +205,8 @@ def instrument(server_name: str, registry: Optional[Registry] = None,
             span = tracer.start_span(
                 f"{request.method} {endpoint}", parent=remote,
                 attrs={"server": server_name, "http.method": request.method,
-                       "http.endpoint": endpoint, "request_id": rid})
+                       "http.endpoint": endpoint, "request_id": rid,
+                       "tenant": tenant})
             token = obs_trace.current_span.set(span)
             request["trace_span"] = span
         in_flight.labels(server=server_name).inc()
@@ -148,6 +233,12 @@ def instrument(server_name: str, registry: Optional[Registry] = None,
                                   status=str(status)).inc()
             latency.labels(server=server_name, endpoint=endpoint).observe(
                 time.perf_counter() - t0)
+            if endpoint in work_endpoints:
+                outcome = (request.get("tenant_outcome")
+                           or obs_accounting.outcome_from_status(status))
+                if outcome_accounting == "full" or outcome != "ok":
+                    ledger.note_outcome(server_name, tenant, outcome)
+            obs_accounting.current_tenant.reset(tenant_token)
             if span is not None:
                 obs_trace.current_span.reset(token)
                 span.set_attribute("http.status", status)
@@ -179,6 +270,20 @@ def add_debug_trace_routes(app, tracer: Optional[obs_trace.Tracer] = None):
 
     app.router.add_get("/debug/traces", list_traces)
     app.router.add_get("/debug/traces/{trace_id}", get_trace)
+
+
+def add_debug_tenant_routes(app, ledger=None) -> None:
+    """Mount ``GET /debug/tenants``: the tenant ledger's exact per-tenant
+    cost accounts (tokens, chip/KV-block/queue seconds, outcomes,
+    goodput) — what a scrape's bounded ``tenant`` label summarises."""
+    from aiohttp import web
+
+    led = ledger if ledger is not None else obs_accounting.LEDGER
+
+    async def tenants_view(request: web.Request) -> web.Response:
+        return web.json_response(led.snapshot())
+
+    app.router.add_get("/debug/tenants", tenants_view)
 
 
 def add_debug_flight_routes(app, recorder) -> None:
@@ -238,6 +343,13 @@ def start_metrics_sidecar(port: int,
                 from tpustack.obs import flight as obs_flight
 
                 body = _json.dumps(obs_flight.snapshot_all()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+            elif path == "/debug/tenants":
+                # the process-wide tenant ledger (batch/train jobs charge
+                # into the same one their /metrics sidecar exposes)
+                body = _json.dumps(
+                    obs_accounting.LEDGER.snapshot()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
             elif path.startswith("/debug/traces/"):

@@ -69,6 +69,9 @@ GUARDED: Dict[Tuple[str, str], Tuple[GuardedSpec, ...]] = {
            note="numpy array, as _ref"),
         _s("allocated_blocks_total", "_lock", writes_only=True),
         _s("freed_blocks_total", "_lock", writes_only=True),
+        _s("_alloc_t", "_lock", writes_only=True,
+           note="numpy array, as _ref"),
+        _s("block_seconds_total", "_lock", writes_only=True),
     ),
     ("tpustack.serving.kv_pool", "PagedPrefixCache"): (
         _s("_root", "_lock", writes_only=True),

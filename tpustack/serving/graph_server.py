@@ -56,6 +56,7 @@ from aiohttp import web
 
 from tpustack import sanitize
 from tpustack.obs import Trace
+from tpustack.obs import accounting as obs_accounting
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import flight as obs_flight
@@ -665,6 +666,10 @@ class HistoryEntry:
     status_str: str = "pending"
     messages: List[str] = field(default_factory=list)
     outputs: Dict[str, Any] = field(default_factory=dict)
+    # tenant cost accounting: set once at submit (before the entry is
+    # shared), read by the worker at plan/finalize — the graph analog of
+    # SlotRequest.tenant
+    tenant: Optional[str] = None
 
     def as_json(self) -> Dict[str, Any]:
         return {"status": {"completed": self.completed,
@@ -689,6 +694,9 @@ class GraphServer:
         self.metrics = obs_catalog.build(registry)
         obs_device.install(registry)
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
+        # tenant cost ledger: process-wide on the default registry, private
+        # per injected test Registry (the tracer's isolation contract)
+        self.ledger = obs_accounting.for_registry(registry)
         # engine flight recorder: per-node records from graph resolution
         # plus per-dispatch/finalize records from the worker, served on
         # /debug/flight and dumped by the resilience post-mortem hooks
@@ -807,12 +815,21 @@ class GraphServer:
                     # deadline (tpulint TPL201 found the original unlocked
                     # pops here)
                     deadline = self._deadline_at.pop(pid, None)
+                    t_submit = self._t_submit.get(pid)
+                if t_submit is not None:
+                    # queue-seconds: submit → worker pickup (charged
+                    # outside the lock — the ledger has its own)
+                    self.ledger.charge_queue_seconds(
+                        "graph", entry.tenant,
+                        time.monotonic() - t_submit)
                 if deadline is not None and time.monotonic() > deadline:
                     # expired while queued: refuse to start it (its device
                     # work would be wasted), publish the verdict in history
                     self.resilience.note_deadline("queued")
                     self.metrics["tpustack_graph_prompts_total"].labels(
                         status="error").inc()
+                    self.ledger.note_outcome("graph", entry.tenant,
+                                             "deadline")
                     if pspan is not None:
                         pspan.add_event("deadline_exceeded", phase="queued")
                         pspan.end(status="error")
@@ -841,6 +858,7 @@ class GraphServer:
                     log.exception("prompt %s failed", pid)
                     self.metrics["tpustack_graph_prompts_total"].labels(
                         status="error").inc()
+                    self.ledger.note_outcome("graph", entry.tenant, "error")
                     if pspan is not None:
                         pspan.set_attribute("error",
                                             f"{type(e).__name__}: {e}")
@@ -1011,9 +1029,15 @@ class GraphServer:
                 finish()
             if fspan is not None:
                 fspan.end()
+            finalize_s = time.perf_counter() - t_fin
             self.flight.record("finalize", prompt_id=pid, status="success",
-                               finalize_s=round(
-                                   time.perf_counter() - t_fin, 6))
+                               finalize_s=round(finalize_s, 6))
+            # tenant attribution: the prompt's device wall time lands in
+            # this finalize fetch (dispatch was async) — charge it, and
+            # the goodput outcome, to the submitting tenant
+            self.ledger.charge_chip_seconds("graph", entry.tenant,
+                                            finalize_s)
+            self.ledger.note_outcome("graph", entry.tenant, "ok")
             tr.observe_into(
                 self.metrics["tpustack_request_phase_latency_seconds"],
                 server="graph")
@@ -1036,6 +1060,7 @@ class GraphServer:
                                error=f"{type(e).__name__}: {e}",
                                finalize_s=round(
                                    time.perf_counter() - t_fin, 6))
+            self.ledger.note_outcome("graph", entry.tenant, "error")
             if fspan is not None:
                 fspan.end(status="error")
             if pspan is not None:
@@ -1071,7 +1096,7 @@ class GraphServer:
 
     async def submit(self, request: web.Request) -> web.Response:
         try:
-            body = await request.json()
+            body = await obs_http.request_json(request)
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid JSON"}, status=400)
         graph = body.get("prompt")
@@ -1098,7 +1123,8 @@ class GraphServer:
                                      status=400)
         pid = str(uuid.uuid4())
         entry = HistoryEntry(prompt_id=pid,
-                             client_id=str(body.get("client_id", "")))
+                             client_id=str(body.get("client_id", "")),
+                             tenant=obs_accounting.current_tenant.get())
         parent = obs_trace.current_span.get()
         with self._lock:
             self._history[pid] = entry
@@ -1219,13 +1245,24 @@ class GraphServer:
         return web.json_response(out)
 
     def build_app(self) -> web.Application:
+        # outcome_accounting="refusals": /prompt is accept-and-poll (it
+        # 200s in ~1ms regardless of how the prompt later fares), so
+        # per-tenant ok/error/deadline outcomes are counted at the
+        # worker's publish/refuse points — but shed (429/503) and
+        # rejected (4xx) requests never reach the worker, so the
+        # middleware still counts the non-ok statuses
+        work = {"/prompt"}
         app = web.Application(
             client_max_size=4 << 20,
             middlewares=[obs_http.instrument("graph", self._registry,
-                                             tracer=self.tracer),
-                         self.resilience.middleware({"/prompt"})])
+                                             tracer=self.tracer,
+                                             ledger=self.ledger,
+                                             work_endpoints=work,
+                                             outcome_accounting="refusals"),
+                         self.resilience.middleware(work)])
         obs_http.add_debug_trace_routes(app, self.tracer)
         obs_http.add_debug_flight_routes(app, self.flight)
+        obs_http.add_debug_tenant_routes(app, self.ledger)
         app.router.add_get("/queue", self.queue_state)
         app.router.add_get("/object_info", self.object_info)
         app.router.add_get("/metrics",

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -109,9 +110,18 @@ class KVBlockPool:
         self._free: deque = deque(range(1, n_blocks))  # guarded-by: _lock (writes)
         self._ref = np.zeros(n_blocks, np.int64)  # guarded-by: _lock (writes)
         self._filled = np.zeros(n_blocks, np.int64)  # guarded-by: _lock (writes)
+        # per-block allocation wall clock (time.time at alloc_tokens) —
+        # the alloc→release residency window the block-seconds accounting
+        # (tenant cost attribution, tpustack.obs.accounting) bills; the
+        # pool-level total below is the ground truth those per-tenant
+        # charges are a partition of
+        self._alloc_t = np.zeros(n_blocks, np.float64)  # guarded-by: _lock (writes)
         # monotonic counters for stats()
         self.allocated_blocks_total = 0  # guarded-by: _lock (writes)
         self.freed_blocks_total = 0  # guarded-by: _lock (writes)
+        # cumulative block-seconds of every block's full alloc→free
+        # lifetime (accumulated when a block returns to the free list)
+        self.block_seconds_total = 0.0  # guarded-by: _lock (writes)
         sanitize.install_guards(self)
 
     # ------------------------------------------------------------ capacity
@@ -147,9 +157,11 @@ class KVBlockPool:
                     f"{len(self._free)} free of {self.capacity_blocks}")
             ids = [self._free.popleft() for _ in range(need)]
             remaining = n_tokens
+            now = time.time()
             for bid in ids:
                 self._ref[bid] = 1
                 self._filled[bid] = min(self.block, remaining)
+                self._alloc_t[bid] = now
                 remaining -= min(self.block, remaining)
             self.allocated_blocks_total += need
             return ids
@@ -165,6 +177,7 @@ class KVBlockPool:
         """Drop one reference per id; blocks reaching 0 return to the free
         list.  Returns how many were actually freed."""
         freed = 0
+        now = time.time()
         with self._lock:
             for bid in ids:
                 if self._ref[bid] <= 0:
@@ -172,6 +185,10 @@ class KVBlockPool:
                 self._ref[bid] -= 1
                 if self._ref[bid] == 0:
                     self._filled[bid] = 0
+                    if self._alloc_t[bid]:
+                        self.block_seconds_total += max(
+                            0.0, now - self._alloc_t[bid])
+                        self._alloc_t[bid] = 0.0
                     self._free.append(bid)
                     freed += 1
             self.freed_blocks_total += freed
@@ -216,6 +233,7 @@ class KVBlockPool:
                 "fragmentation": round(self.fragmentation(), 4),
                 "allocated_blocks_total": self.allocated_blocks_total,
                 "freed_blocks_total": self.freed_blocks_total,
+                "block_seconds_total": round(self.block_seconds_total, 3),
             }
 
 

@@ -94,6 +94,7 @@ from typing import Optional
 from aiohttp import web
 
 from tpustack import sanitize
+from tpustack.obs import accounting as obs_accounting
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import flight as obs_flight
@@ -231,11 +232,13 @@ class _PendingCompletion:
     __slots__ = ("ids", "n_predict", "sample", "future", "cancel",
                  "stream_put", "seed", "prefix", "kv_extract", "on_prefill_kv",
                  "phase", "span_ctx", "queue_span", "kv_blocks",
-                 "on_prefill_blocks", "speculative")
+                 "on_prefill_blocks", "speculative", "tenant", "t_enqueue",
+                 "t_kv_alloc")
 
     def __init__(self, ids, n_predict, sample, future, stream_put=None,
                  seed=None, prefix=None, kv_extract=None, on_prefill_kv=None,
-                 kv_blocks=None, on_prefill_blocks=None, speculative=True):
+                 kv_blocks=None, on_prefill_blocks=None, speculative=True,
+                 t_kv_alloc=None):
         self.ids = ids
         self.n_predict = n_predict
         self.sample = sample
@@ -268,6 +271,14 @@ class _PendingCompletion:
         # to a slot
         self.span_ctx = None
         self.queue_span = None
+        # tenant cost accounting: the tenant id (resolved by the obs
+        # middleware, captured at enqueue like span_ctx — engine threads
+        # don't see the contextvar), enqueue wall clock (queue-seconds
+        # charge when feed() pops the request), and the paged-admission
+        # allocation wall clock (KV-block-seconds run from here)
+        self.tenant = None
+        self.t_enqueue = 0.0
+        self.t_kv_alloc = t_kv_alloc
 
 
 class LLMServer:
@@ -317,6 +328,10 @@ class LLMServer:
         # distributed tracing: same isolation contract as the registry —
         # tests pass a fresh Tracer, production shares the process default
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
+        # tenant cost ledger (tpustack.obs.accounting): the process-wide
+        # one on the default registry, a private one when a test injects
+        # its own Registry — the same isolation contract as the tracer
+        self.ledger = obs_accounting.for_registry(registry)
         if generator is None:
             generator, tokenizer, model_name = _build_generator()
         self.gen = generator
@@ -771,6 +786,12 @@ class LLMServer:
             ids += list(r.prefix[1])
         r.kv_blocks, r.prefix = None, None
         if ids:
+            if r.tenant is not None and r.t_kv_alloc:
+                # the request died queued but its blocks were resident
+                # the whole time — the residency bill is real either way
+                self.ledger.charge_kv_block_seconds(
+                    r.tenant,
+                    len(ids) * max(0.0, time.time() - r.t_kv_alloc))
             self.paged.pool.decref(ids)
             self._paged_gauges()
 
@@ -869,6 +890,8 @@ class LLMServer:
             req.span_ctx = parent.context
             req.queue_span = self.tracer.start_span("queue_wait",
                                                     parent=parent)
+        req.tenant = obs_accounting.current_tenant.get()
+        req.t_enqueue = time.time()
         if self._wake is None:
             self._wake = asyncio.Event()
         if self._batch_task is None or self._batch_task.done():
@@ -888,7 +911,10 @@ class LLMServer:
             prefix, kv_blocks, on_insert = self._paged_admit(
                 ids, n_predict, cache_prompt)
             return {"prefix": prefix, "kv_blocks": kv_blocks,
-                    "on_prefill_blocks": on_insert}
+                    "on_prefill_blocks": on_insert,
+                    # admission IS allocation: KV-block-seconds run from
+                    # this wall clock, queued time included
+                    "t_kv_alloc": time.time()}
         p, e, cb = self._prefix_lookup(ids, cache_prompt)
         return {"prefix": p, "kv_extract": e, "on_prefill_kv": cb}
 
@@ -950,7 +976,8 @@ class LLMServer:
                            on_prefill_kv=r.on_prefill_kv,
                            span_ctx=r.span_ctx, kv_blocks=r.kv_blocks,
                            on_prefill_blocks=r.on_prefill_blocks,
-                           speculative=r.speculative)
+                           speculative=r.speculative, tenant=r.tenant,
+                           t_kv_alloc=r.t_kv_alloc)
 
     async def _batch_loop(self):
         """Run the continuous engine whenever requests are queued: the
@@ -976,7 +1003,7 @@ class LLMServer:
                     on_progress=self.resilience.progress,
                     tracer=self.tracer, paged=self.paged,
                     spec=self.spec_cfg, on_spec=self._note_spec,
-                    flight=self.flight,
+                    flight=self.flight, ledger=self.ledger,
                     queue_depth=lambda: len(self._queue))
                 # work() runs on the executor thread WHILE _run_on_device
                 # holds self._lock — the guard is real, just lexically
@@ -994,6 +1021,10 @@ class LLMServer:
                         r = self._queue.popleft()
                         self.metrics["tpustack_llm_queue_depth"].set(
                             len(self._queue))
+                        if r.t_enqueue:  # queue-seconds to the tenant,
+                            # cancelled and admitted alike — both waited
+                            self.ledger.charge_queue_seconds(
+                                "llm", r.tenant, time.time() - r.t_enqueue)
                         if r.cancel.is_set():
                             if r.queue_span is not None:
                                 r.queue_span.set_attribute("cancelled", True)
@@ -1141,6 +1172,14 @@ class LLMServer:
         m["tpustack_llm_generated_tokens_total"].inc(
             stats.get("generated_tokens", 0))
         m["tpustack_llm_prompt_length_tokens"].observe(n_prompt)
+        # tenant token accounting: _observe_done runs in the handler's
+        # context (solo, batched, and streamed paths alike), so the
+        # middleware's contextvar is live here — ONE charge point per
+        # completed request
+        self.ledger.charge_tokens(
+            "llm", obs_accounting.current_tenant.get(),
+            prompt=stats.get("prompt_tokens", 0),
+            generated=stats.get("generated_tokens", 0))
         prefill = stats.get("prefill_s", 0.0)
         decode = stats.get("decode_s", 0.0)
         detok = stats.get("detokenize_s", 0.0)
@@ -1422,6 +1461,9 @@ class LLMServer:
                 out_ids, stats = await locked_task
             except (ValueError, InjectedDeviceError) as e:
                 # stream already started: surface the error as a final event
+                # (the 200 headers flushed long ago — tell the tenant
+                # outcome accounting what actually happened)
+                request["tenant_outcome"] = "error"
                 if fmt == "openai":
                     await send(chat_chunk({}, finish="error") | {
                         "error": {"message": str(e)}})
@@ -1433,6 +1475,10 @@ class LLMServer:
             # the cancel event frees the engine slot at the next chunk
             cancel.set()
             self.resilience.note_deadline(e.phase)
+            # the SSE response stays HTTP 200 (headers long flushed) —
+            # override so the tenant goodput accounting records the
+            # deadline instead of a phantom success
+            request["tenant_outcome"] = "deadline"
             msg = str(e)
             if fmt == "openai":
                 await send(chat_chunk({}, finish="error") | {
@@ -1583,7 +1629,7 @@ class LLMServer:
 
     async def completion(self, request: web.Request) -> web.Response:
         try:
-            body = await request.json()
+            body = await obs_http.request_json(request)
         except json.JSONDecodeError:
             self._reject("invalid_json")
             return web.json_response({"error": "invalid json"}, status=400)
@@ -1649,7 +1695,7 @@ class LLMServer:
 
     async def chat_completions(self, request: web.Request) -> web.Response:
         try:
-            body = await request.json()
+            body = await obs_http.request_json(request)
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
         messages = body.get("messages", [])
@@ -1711,13 +1757,16 @@ class LLMServer:
         })
 
     def build_app(self) -> web.Application:
+        work = {"/completion", "/v1/chat/completions"}
         app = web.Application(
             middlewares=[obs_http.instrument("llm", self._registry,
-                                             tracer=self.tracer),
-                         self.resilience.middleware(
-                             {"/completion", "/v1/chat/completions"})])
+                                             tracer=self.tracer,
+                                             ledger=self.ledger,
+                                             work_endpoints=work),
+                         self.resilience.middleware(work)])
         obs_http.add_debug_trace_routes(app, self.tracer)
         obs_http.add_debug_flight_routes(app, self.flight)
+        obs_http.add_debug_tenant_routes(app, self.ledger)
         app.router.add_get("/health", self.health)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)

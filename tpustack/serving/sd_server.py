@@ -53,6 +53,7 @@ from aiohttp import web
 from pydantic import BaseModel, ValidationError
 
 from tpustack import sanitize
+from tpustack.obs import accounting as obs_accounting
 from tpustack.obs import catalog as obs_catalog
 from tpustack.obs import device as obs_device
 from tpustack.obs import flight as obs_flight
@@ -96,6 +97,10 @@ class _PendingReq:
     # shared batch timings against its own parent) + admission wall clock
     span_ctx: Optional[object] = None
     t_enqueue_unix: float = 0.0
+    # tenant cost accounting: resolved by the obs middleware, carried
+    # explicitly — the batch task serves many riders, each charged its
+    # share of the fused dispatch
+    tenant: Optional[str] = None
 
 
 class SDServer:
@@ -105,6 +110,9 @@ class SDServer:
         self.metrics = obs_catalog.build(registry)
         obs_device.install(registry)
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
+        # tenant cost ledger: process-wide on the default registry, private
+        # per injected test Registry (the tracer's isolation contract)
+        self.ledger = obs_accounting.for_registry(registry)
         if pipeline is None:
             pipeline = self._pipeline_from_env()
         self.pipe = pipeline
@@ -293,7 +301,7 @@ class SDServer:
 
     async def generate(self, request: web.Request) -> web.Response:
         try:
-            req = GenReq.model_validate(await request.json())
+            req = GenReq.model_validate(await obs_http.request_json(request))
         except (ValidationError, ValueError) as e:
             return web.json_response({"detail": str(e)}, status=422)
         if not req.prompt or not req.prompt.strip():
@@ -323,7 +331,8 @@ class SDServer:
                               asyncio.get_running_loop().create_future(),
                               t_enqueue=time.perf_counter(),
                               span_ctx=parent.context if parent else None,
-                              t_enqueue_unix=time.time())
+                              t_enqueue_unix=time.time(),
+                              tenant=obs_accounting.current_tenant.get())
         try:
             img = await asyncio.wait_for(self._enqueue(key, pending),
                                          deadline_s)
@@ -452,7 +461,9 @@ class SDServer:
             self.metrics["tpustack_sd_padded_slots_total"].inc(pad)
         for r in batch:  # admission → dispatch: the window + lock wait
             if r.t_enqueue:
-                tr.add("queue_wait", time.perf_counter() - r.t_enqueue)
+                wait_s = time.perf_counter() - r.t_enqueue
+                tr.add("queue_wait", wait_s)
+                self.ledger.charge_queue_seconds("sd", r.tenant, wait_s)
         if len(batch) > 1 or pad:
             log.info("Micro-batch: %d requests (+%d pad) in one program (dp=%s)",
                      len(batch), pad, self._mesh_data_size() or 1)
@@ -505,13 +516,25 @@ class SDServer:
         # request's batch_build/denoise spans carry the SHARED batch timing
         # (explicit wall clocks — this task is not any rider's context)
         denoise_s = time.perf_counter() - t_denoise
-        # flight record: one per fused dispatch — the SD engine's wave
-        self.flight.record(
-            "batch", batch=len(batch), pad=pad, steps=steps,
+        # flight record: one per fused dispatch — the SD engine's wave.
+        # The riders' tenant split rides the record and the chip-seconds
+        # charge reads it back, so /debug/flight and /debug/tenants hold
+        # the same numbers (the llm engine's charge_flight_wave contract)
+        tenants: Dict[str, int] = {}
+        for r in batch:
+            if r.tenant is not None:
+                tenants[r.tenant] = tenants.get(r.tenant, 0) + 1
+        rec = dict(
+            batch=len(batch), pad=pad, steps=steps,
             width=width, height=height,
             build_s=round(build_s, 6), denoise_vae_s=round(denoise_s, 6),
             flops=self._signature_flops(steps, width, height,
                                         len(batch) + pad))
+        if tenants:
+            rec["tenants"] = tenants
+        self.flight.record("batch", **rec)
+        self.ledger.charge_flight_wave("sd", rec,
+                                       seconds_key="denoise_vae_s")
         for r in batch:
             if r.span_ctx is None:
                 continue
@@ -575,13 +598,17 @@ class SDServer:
 
     # ---------------------------------------------------------------- app
     def build_app(self) -> web.Application:
+        work = {"/generate"}
         app = web.Application(
             client_max_size=1 << 20,
             middlewares=[obs_http.instrument("sd", self._registry,
-                                             tracer=self.tracer),
-                         self.resilience.middleware({"/generate"})])
+                                             tracer=self.tracer,
+                                             ledger=self.ledger,
+                                             work_endpoints=work),
+                         self.resilience.middleware(work)])
         obs_http.add_debug_trace_routes(app, self.tracer)
         obs_http.add_debug_flight_routes(app, self.flight)
+        obs_http.add_debug_tenant_routes(app, self.ledger)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)
         app.router.add_get("/", self.index)

@@ -214,6 +214,16 @@ _declare("TPUSTACK_FLIGHT_WINDOW_S", float, 60.0,
 _declare("TPUSTACK_PROFILE_DIR", str, "/tmp/tpustack-profile",
          "Base directory for on-demand POST /profile xplane captures "
          "(the SD server's legacy SD15_TRACE_DIR overrides it there).")
+_declare("TPUSTACK_TENANT_CARDINALITY", int, 32,
+         "Max distinct tenant label values on tenant-labelled metrics; "
+         "tenants beyond the first N collapse into the 'other' overflow "
+         "bucket (bounds scrape cardinality under hostile tenant ids).")
+_declare("TPUSTACK_TENANT_DEFAULT", str, "anonymous",
+         "Tenant charged for requests that carry no X-Tenant-Id header "
+         "and no body 'tenant' field.")
+_declare("TPUSTACK_REPLAY_URL", str, "",
+         "Default target URL for tools/replay.py (the in-cluster replay "
+         "Job sets it); empty = the tool's --url default.")
 
 # ---------------------------------------------------------------- sanitizers
 _declare("TPUSTACK_SANITIZE", bool, False,
