@@ -10,6 +10,10 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.bench_schema import (LLM_EXTRA_KEEP, META_KEYS,  # noqa: E402
+                                WAN_KEEP, check_meta, prune)
 
 
 def load_bench():
@@ -42,6 +46,12 @@ def test_llm_extras_schema(monkeypatch):
                    "goodput_rps": 4.5, "goodput_ratio": 0.9,
                    "shed": 2, "deadline": 1, "errors": 3,
                    "tenants": {"interactive": {"offered": 10}},
+                   # provenance + exact-counter signature (PR 13): every
+                   # tool artifact carries them and the driver keeps them
+                   "meta": {"schema_version": 1, "git_sha": "cafe",
+                            "device_kind": "cpu", "backend": "cpu",
+                            "ts": 1.0, "knobs": {}},
+                   "signature": {"engine.generated_tokens": 64},
                    "ignored_key": "must not leak into the artifact"}
         return subprocess.CompletedProcess(cmd, 0,
                                            stdout=json.dumps(payload) + "\n",
@@ -58,6 +68,12 @@ def test_llm_extras_schema(monkeypatch):
     # the flight aggregates ride the continuous cell into the artifact
     assert out["continuous_e2e"]["flight"]["mean_occupancy"] == 7.5
     assert out["continuous_e2e"]["flight"]["spec_acceptance"] == 0.6
+    # the shared meta block and the perf signature ride EVERY cell (the
+    # keep-list is tools/bench_schema.LLM_EXTRA_KEEP — one module, shared
+    # with bench.py, so this test and the driver cannot drift)
+    for sub in out.values():
+        assert check_meta(sub["meta"]) == []
+        assert sub["signature"] == {"engine.generated_tokens": 64}
     # the replay cell keeps the open-loop goodput/percentile keys
     assert out["replay"]["goodput_ratio"] == 0.9
     assert out["replay"]["schedule_sha"] == "abc123"
@@ -81,7 +97,11 @@ def test_wan_extras_schema(monkeypatch):
 
     def fake_run(cmd, capture_output, text, timeout):
         payload = {"metric": "w", "value": 600.0, "unit": "videos/hour/chip",
-                   "seconds_per_video": 6.0, "mfu": 0.65, "extra": "drop me"}
+                   "seconds_per_video": 6.0, "mfu": 0.65,
+                   "meta": {"schema_version": 1, "git_sha": None,
+                            "device_kind": "cpu", "backend": "cpu",
+                            "ts": 2.0, "knobs": {}},
+                   "extra": "drop me"}
         return subprocess.CompletedProcess(cmd, 0,
                                            stdout=json.dumps(payload) + "\n",
                                            stderr="")
@@ -89,6 +109,7 @@ def test_wan_extras_schema(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     out = bench._wan_extras(lambda *a: None)
     assert out["mfu"] == 0.65 and out["seconds_per_video"] == 6.0
+    assert check_meta(out["meta"]) == []
     assert "extra" not in out
 
 
@@ -125,3 +146,22 @@ def test_run_tool_nonzero_exit_is_error_record(monkeypatch):
     assert out["error"] == "exit code 3"
     assert "device fell over" in out["stderr_tail"]
     assert "metric" not in out and "value" not in out
+
+
+def test_meta_contract_matches_producer():
+    """tools/bench_schema.META_KEYS IS the shape perfsig.artifact_meta
+    produces — the schema test and the one sanctioned producer cannot
+    drift (and every bench tool stamps through that producer)."""
+    from tpustack.obs import perfsig
+
+    meta = perfsig.artifact_meta(0.0)
+    assert set(meta) == set(META_KEYS)
+    assert check_meta(meta) == []
+
+
+def test_prune_is_keeplist_projection():
+    rec = {k: i for i, k in enumerate(LLM_EXTRA_KEEP[:3])}
+    rec["stray"] = "x"
+    assert prune(rec, LLM_EXTRA_KEEP) == {k: rec[k]
+                                          for k in LLM_EXTRA_KEEP[:3]}
+    assert prune({}, WAN_KEEP) == {}

@@ -48,6 +48,7 @@ def main() -> int:
                    help="miniature text tower instead of the int8 umt5-xxl "
                         "shape (isolates the DiT+VAE number)")
     args = p.parse_args()
+    t_bench = time.time()
 
     import jax
 
@@ -122,6 +123,8 @@ def main() -> int:
         except Exception as e:
             log(f"[bench_wan] cost analysis unavailable: {e!r}")
 
+    from tpustack.obs import perfsig
+
     result = {
         "metric": f"wan21_1.3b_{args.width}x{args.height}x{args.frames}f_"
                   f"{args.steps}step_videos_per_hour_per_chip",
@@ -129,6 +132,7 @@ def main() -> int:
         "unit": "videos/hour/chip",
         "seconds_per_video": round(sec, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "meta": perfsig.artifact_meta(t_bench),
     }
     if not args.small and not args.no_content_check:
         # bench.py-style gating: the Wan number only counts if the chip

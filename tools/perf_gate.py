@@ -1,0 +1,348 @@
+#!/usr/bin/env python3
+"""Noise-aware bench regression gate: fresh runs vs committed baselines.
+
+Five bench rounds of wins are protected by nothing unless CI can say
+"this tree is slower / does more work than the committed tree".  This
+tool runs the bench scenarios, compares each fresh artifact against its
+committed baseline under ``bench/baselines/``, prints a readable delta
+table, and exits nonzero on regression.  Two comparison classes, two
+disciplines:
+
+- **signature counters** (``tpustack.obs.perfsig``): machine-exact —
+  weight passes, recompile counts per entry point, prefix-cache
+  computed-vs-skipped tokens, block alloc totals, spec drafted/accepted.
+  Compared with ``==``; any mismatch (or a counter appearing/vanishing)
+  fails the gate.  These are bit-reproducible on CPU, so ``--tiny`` CI
+  gates perf with no timers involved.
+
+- **wall-clock metrics** (tok/s, TTFT): noisy by nature — compared with a
+  direction-aware relative tolerance (``--tolerance``, default 35%;
+  improvements never fail) over the best of ``--repeats`` runs
+  (min-of-N for latency, max-of-N for throughput: noise only ever makes
+  you look slower, so the best observation is the honest one).  In
+  ``--tiny`` mode (and whenever the fresh device kind differs from the
+  baseline's) wall-clock rows are reported but NOT gating unless
+  ``--strict-wallclock`` — a CI runner's clock proves nothing about a
+  v5e, and a different machine's clock proves nothing at all.
+
+``--update-baselines`` is the sanctioned ratchet: rewrite the baselines
+from this tree's runs (commit the diff — the git sha in each baseline's
+``meta`` records where the bar was set).  See docs/PERF.md "Perf
+trajectory & regression gate" for the policy.
+
+Scenario subprocesses run with ``TPUSTACK_SANITIZE=0`` (signatures are
+measured on the uninstrumented engine, whatever environment the gate
+itself runs in); ``--env K=V`` forwards extra environment to them —
+the fault-injection hook the gate's own tests use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.bench_schema import get_path as _get_path  # noqa: E402
+from tpustack.obs import perfsig  # noqa: E402
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One gated bench invocation: the tool + args that produce a one-line
+    JSON artifact carrying a ``signature``, and the artifact paths whose
+    wall-clock values the baseline records (dotted path → direction,
+    ``higher``/``lower`` = which way is better)."""
+
+    name: str
+    tool: str  # repo-relative script path
+    args: Sequence[str]
+    wallclock: Dict[str, str] = dataclasses.field(default_factory=dict)
+    timeout: int = 600
+
+
+#: the CPU CI set: the existing bench_llm/bench.py tiny paths, exactly as
+#: the tier-1 smokes shell them (deterministic shapes, seeded prompts)
+TINY_SCENARIOS = (
+    Scenario("llm_continuous_tiny", "tools/bench_llm.py",
+             ("--tiny", "--batch", "2", "--continuous", "--repeats", "1",
+              "--prompt-tokens", "16", "--new-tokens", "16"),
+             {"value": "higher"}),
+    Scenario("llm_prefix_tiny", "tools/bench_llm.py",
+             ("--tiny", "--shared-prefix", "--requests", "4"),
+             {"cache_on.ttft_p50_ms": "lower",
+              "cache_off.ttft_p50_ms": "lower"}),
+    Scenario("llm_paged_tiny", "tools/bench_llm.py",
+             ("--tiny", "--paged", "--requests", "4"), {}),
+    Scenario("llm_spec_tiny", "tools/bench_llm.py",
+             ("--tiny", "--speculative"), {"value": "higher"}),
+    Scenario("sd_small", "bench.py",
+             ("--small", "--no-content-check", "--no-extras",
+              "--repeats", "2"),
+             {"value": "higher"}),
+)
+
+
+def run_scenario(sc: Scenario, repeats: int, extra_env: Dict[str, str],
+                 log=print) -> Dict:
+    """Run one scenario ``repeats`` times; return the fresh record:
+    run-1's signature/meta (signatures must agree across repeats — a
+    disagreement is flagged as instability) and best-of-N wall-clock."""
+    env = dict(os.environ)
+    env["TPUSTACK_SANITIZE"] = "0"  # signatures on the uninstrumented engine
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env)
+    artifacts = []
+    for i in range(max(1, repeats)):
+        cmd = [sys.executable, os.path.join(REPO, *sc.tool.split("/"))]
+        cmd += list(sc.args)
+        t0 = time.time()
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=sc.timeout, env=env, cwd=REPO)
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip()[-800:]
+            raise RuntimeError(
+                f"{sc.name} run {i + 1} exited {proc.returncode}; "
+                f"stderr tail:\n{tail}")
+        line = proc.stdout.strip().splitlines()[-1]
+        artifacts.append(json.loads(line))
+        log(f"[perf_gate] {sc.name} run {i + 1}/{repeats}: "
+            f"{artifacts[-1].get('value')} {artifacts[-1].get('unit')} "
+            f"({time.time() - t0:.0f}s)")
+    sigs = [a.get("signature", {}) for a in artifacts]
+    stable = all(s == sigs[0] for s in sigs[1:])
+    wallclock = {}
+    for path, direction in sc.wallclock.items():
+        vals = [v for v in (_get_path(a, path) for a in artifacts)
+                if isinstance(v, (int, float))]
+        if vals:
+            wallclock[path] = {
+                "value": (max(vals) if direction == "higher" else min(vals)),
+                "direction": direction,
+            }
+    return {
+        "scenario": sc.name,
+        "meta": artifacts[0].get("meta", {}),
+        "signature": sigs[0],
+        "signature_stable": stable,
+        "wallclock": wallclock,
+        "artifact": artifacts[0],
+    }
+
+
+def compare(baseline: Dict, fresh: Dict, tolerance: float,
+            gate_wallclock: bool) -> List[Dict]:
+    """Delta rows for one scenario.  Exact rows come from
+    ``perfsig.diff_signatures`` (mismatch/missing/new — all gating);
+    wall-clock rows carry a signed relative delta and gate only when
+    ``gate_wallclock`` and the move is past ``tolerance`` in the BAD
+    direction (improvements are reported, never failed)."""
+    rows: List[Dict] = []
+    for d in perfsig.diff_signatures(baseline.get("signature", {}),
+                                     fresh.get("signature", {})):
+        rows.append({"kind": "exact", "key": d["key"],
+                     "baseline": d["baseline"], "fresh": d["fresh"],
+                     "status": d["status"], "gating": True})
+    base_wc = baseline.get("wallclock", {})
+    fresh_wc = fresh.get("wallclock", {})
+    for path in sorted(set(base_wc) | set(fresh_wc)):
+        b = base_wc.get(path)
+        f = fresh_wc.get(path)
+        if b is None or f is None:
+            rows.append({"kind": "wallclock", "key": path,
+                         "baseline": (b or {}).get("value"),
+                         "fresh": (f or {}).get("value"),
+                         "status": "missing" if f is None else "new",
+                         "gating": gate_wallclock})
+            continue
+        bv, fv = float(b["value"]), float(f["value"])
+        direction = b.get("direction", "higher")
+        delta = (fv - bv) / bv if bv else 0.0
+        worse = -delta if direction == "higher" else delta
+        if worse > tolerance:
+            status = "regressed" if gate_wallclock else "regressed_info"
+        elif worse < -tolerance:
+            status = "improved"
+        else:
+            status = "ok"
+        rows.append({"kind": "wallclock", "key": path, "baseline": bv,
+                     "fresh": fv, "delta_pct": round(100 * delta, 1),
+                     "direction": direction, "status": status,
+                     "gating": gate_wallclock and status == "regressed"})
+    return rows
+
+
+_GATING_STATUSES = ("mismatch", "missing", "new", "regressed")
+
+
+def print_table(scenario: str, rows: List[Dict], log=print) -> None:
+    if not rows:
+        log(f"[perf_gate] {scenario}: signature exact, wall-clock within "
+            "tolerance")
+        return
+    log(f"[perf_gate] {scenario}:")
+    width = max(len(r["key"]) for r in rows)
+    for r in rows:
+        delta = (f"  {r['delta_pct']:+.1f}%"
+                 if r.get("delta_pct") is not None else "")
+        flag = "" if not (r["status"] in _GATING_STATUSES and r["gating"]) \
+            else "  <-- REGRESSION"
+        log(f"  {r['key']:<{width}}  {r['status']:<14} "
+            f"baseline={r['baseline']}  fresh={r['fresh']}{delta}{flag}")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="bench perf-regression gate (see docs/PERF.md)")
+    p.add_argument("--tiny", action="store_true",
+                   help="run the CPU CI scenario set (the bench_llm/"
+                        "bench.py tiny paths) against bench/baselines/tiny")
+    p.add_argument("--scenarios", default="",
+                   help="comma list narrowing the scenario set by name")
+    p.add_argument("--baselines", default="",
+                   help="baseline dir (default: TPUSTACK_BENCH_BASELINES "
+                        "or <repo>/bench/baselines, + /tiny under --tiny)")
+    p.add_argument("--update-baselines", action="store_true",
+                   help="rewrite the baselines from this tree's runs (the "
+                        "sanctioned ratchet — commit the diff)")
+    p.add_argument("--repeats", type=int, default=2,
+                   help="runs per scenario; wall-clock compares best-of-N "
+                        "(signatures must agree across all N)")
+    p.add_argument("--tolerance", type=float, default=0.35,
+                   help="relative wall-clock tolerance (direction-aware; "
+                        "improvements never fail)")
+    p.add_argument("--strict-wallclock", action="store_true",
+                   help="gate on wall-clock even in --tiny / on a device "
+                        "kind differing from the baseline's")
+    p.add_argument("--no-wallclock", action="store_true",
+                   help="skip wall-clock comparison entirely (signature-"
+                        "only gate)")
+    p.add_argument("--env", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="extra environment for the scenario subprocesses "
+                        "(repeatable)")
+    p.add_argument("--out", default="",
+                   help="write the full delta report as JSON (the CI "
+                        "failure artifact)")
+    args = p.parse_args(argv)
+
+    log = lambda *a: print(*a, flush=True)
+    if not args.tiny:
+        # hardware tiers land with the first hardware baseline commit; the
+        # scenario table is the extension point (docs/PERF.md)
+        log("[perf_gate] only --tiny scenarios are defined so far; "
+            "pass --tiny")
+        return 2
+    scenarios = list(TINY_SCENARIOS)
+    if args.scenarios:
+        want = {s.strip() for s in args.scenarios.split(",") if s.strip()}
+        unknown = want - {s.name for s in scenarios}
+        if unknown:
+            log(f"[perf_gate] unknown scenario(s): {sorted(unknown)} "
+                f"(have: {[s.name for s in scenarios]})")
+            return 2
+        scenarios = [s for s in scenarios if s.name in want]
+
+    base_dir = args.baselines or os.path.join(perfsig.baseline_dir(REPO),
+                                              "tiny")
+    extra_env = {}
+    for kv in args.env:
+        if "=" not in kv:
+            log(f"[perf_gate] --env wants KEY=VALUE, got {kv!r}")
+            return 2
+        k, _, v = kv.partition("=")
+        extra_env[k] = v
+
+    report = {"baselines": base_dir, "tolerance": args.tolerance,
+              "scenarios": {}, "failed": False}
+    failed = False
+    for sc in scenarios:
+        try:
+            fresh = run_scenario(sc, args.repeats, extra_env, log=log)
+        except Exception as e:
+            # a dead scenario is a gate failure, not a gate crash: record
+            # it, keep judging the others, and still write the --out
+            # report the CI failure artifact ships
+            log(f"[perf_gate] {sc.name}: scenario run FAILED: {e}")
+            report["scenarios"][sc.name] = {"error": str(e)}
+            failed = True
+            continue
+        if not fresh["signature_stable"]:
+            log(f"[perf_gate] {sc.name}: WARNING signature differed "
+                "across repeats — counters are expected bit-stable; "
+                "investigate before trusting this gate run")
+            failed = True
+        if args.update_baselines:
+            os.makedirs(base_dir, exist_ok=True)
+            path = os.path.join(base_dir, f"{sc.name}.json")
+            rec = {k: fresh[k] for k in
+                   ("scenario", "meta", "signature", "wallclock")}
+            with open(path, "w") as f:
+                json.dump(rec, f, indent=1, sort_keys=True)
+                f.write("\n")
+            log(f"[perf_gate] {sc.name}: baseline written to {path}")
+            report["scenarios"][sc.name] = {"updated": True,
+                                            "signature": fresh["signature"]}
+            continue
+        bpath = os.path.join(base_dir, f"{sc.name}.json")
+        if not os.path.exists(bpath):
+            log(f"[perf_gate] {sc.name}: NO BASELINE at {bpath} — run "
+                "tools/perf_gate.py --tiny --update-baselines and commit")
+            report["scenarios"][sc.name] = {"error": "no baseline"}
+            failed = True
+            continue
+        with open(bpath) as f:
+            baseline = json.load(f)
+        if (baseline.get("meta", {}).get("schema_version")
+                != perfsig.SCHEMA_VERSION):
+            log(f"[perf_gate] {sc.name}: baseline schema_version "
+                f"{baseline.get('meta', {}).get('schema_version')} != "
+                f"{perfsig.SCHEMA_VERSION} — re-ratchet with "
+                "--update-baselines")
+            report["scenarios"][sc.name] = {"error": "schema drift"}
+            failed = True
+            continue
+        # wall-clock gates only where the clock is comparable: same device
+        # kind as the baseline, and not the tiny/CI tier (whose runners'
+        # clocks prove nothing about serving hardware) unless forced
+        kind_match = (fresh["meta"].get("device_kind")
+                      == baseline.get("meta", {}).get("device_kind"))
+        gate_wc = (not args.no_wallclock
+                   and (args.strict_wallclock or (not args.tiny
+                                                  and kind_match)))
+        rows = compare(baseline, fresh, args.tolerance, gate_wc)
+        print_table(sc.name, rows, log=log)
+        bad = [r for r in rows
+               if r["status"] in _GATING_STATUSES and r["gating"]]
+        if bad:
+            failed = True
+            log(f"[perf_gate] {sc.name}: {len(bad)} regression row(s): "
+                + ", ".join(r["key"] for r in bad))
+        report["scenarios"][sc.name] = {
+            "rows": rows, "regressions": [r["key"] for r in bad],
+            "signature": fresh["signature"]}
+    report["failed"] = failed
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        log(f"[perf_gate] delta report written to {args.out}")
+    if args.update_baselines:
+        return 1 if failed else 0
+    log("[perf_gate] " + ("FAILED — a committed perf bar moved; fix the "
+                          "regression or ratchet deliberately with "
+                          "--update-baselines"
+                          if failed else
+                          f"clean: {len(scenarios)} scenario(s) at or "
+                          "above their committed baselines"))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -341,6 +341,28 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "is the only signal — any nonzero value is a real "
                "correctness bug caught live, not noise.",
                ("check",), unit="total"),
+    MetricSpec("tpustack_recompiles_total", "counter",
+               "XLA traces observed per watched serving entry point "
+               "(CompileWatch cache growth, exported at wave-boundary "
+               "checks).  The cold compiles land once at the first check; "
+               "any later increment is MID-TRAFFIC retracing — a multi-"
+               "second stall per occurrence that looks like a hung "
+               "dispatch from outside.  Populated while the sanitizer is "
+               "enabled (report mode in production suffices).",
+               ("entry_point",), unit="total"),
+
+    # ---- perf baselines (tpustack.obs.perfsig; bench/baselines/) ----
+    MetricSpec("tpustack_bench_baseline_info", "gauge",
+               "One series (value 1) per committed perf baseline loaded "
+               "at startup, labelled with the scenario name and the git "
+               "sha the baseline was last ratcheted at "
+               "(tools/perf_gate.py --update-baselines) — the perf bar "
+               "this live server is being held to.",
+               ("scenario", "git_sha"), unit="info"),
+    MetricSpec("tpustack_bench_baseline_entries", "gauge",
+               "Committed perf baselines loaded from the bench/baselines "
+               "store (0 = no baseline store shipped with this deploy).",
+               unit="entries"),
 
     # ---- black-box prober (tools/probe.py, the prober CronJob sidecar) ----
     MetricSpec("tpustack_probe_attempts_total", "counter",

@@ -55,11 +55,21 @@ class CompileWatch:
         # name -> (jit_fn, budget, baseline size)
         self._watched: Dict[str, Tuple[object, int, int]] = {}
         self._reported: set = set()
+        # growth already exported to tpustack_recompiles_total per entry
+        # point — check() increments by the delta, so the counter tracks
+        # every observed retrace, not just budget violations
+        self._exported: Dict[str, int] = {}
 
-    def watch(self, name: str, jit_fn, budget: int = 1) -> None:
+    def watch(self, name: str, jit_fn, budget: int = 1,
+              force: bool = False) -> None:
+        """Baseline ``jit_fn``'s trace cache.  ``force`` watches even with
+        the sanitizer disabled — the bench signature path
+        (``tpustack.obs.perfsig``) measures recompiles as DATA, while the
+        serving engines keep the enabled() gate so the =0 hot path stays
+        uninstrumented."""
         from tpustack import sanitize
 
-        if not sanitize.enabled() or jit_fn is None:
+        if (not force and not sanitize.enabled()) or jit_fn is None:
             return
         base = cache_size(jit_fn)
         if base is None:
@@ -93,6 +103,7 @@ class CompileWatch:
             if size is None:
                 continue
             grown = size - base
+            self._export(name, grown)
             if grown > budget and name not in self._reported:
                 self._reported.add(name)
                 sanitize.violation(
@@ -104,6 +115,26 @@ class CompileWatch:
                     "dtype flip?).  Inspect static_argnums and the "
                     "argument shapes; raise the budget only for a real "
                     "new configuration")
+
+    def _export(self, name: str, grown: int) -> None:
+        """Count every observed trace into
+        ``tpustack_recompiles_total{entry_point}`` (growth since the last
+        check) — the cold compiles land once at the first wave boundary,
+        then any increment is a mid-traffic retrace, visible on /metrics
+        without waiting for the budget to trip.  Best-effort: metrics must
+        never take the checker down."""
+        with self._lock:
+            delta = grown - self._exported.get(name, 0)
+            if delta <= 0:
+                return
+            self._exported[name] = grown
+        try:
+            from tpustack.obs import catalog as obs_catalog
+
+            obs_catalog.build(None)["tpustack_recompiles_total"].labels(
+                entry_point=name).inc(delta)
+        except Exception:
+            pass
 
     def stats(self) -> Dict[str, Dict[str, int]]:
         with self._lock:

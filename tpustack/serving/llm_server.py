@@ -447,6 +447,12 @@ class LLMServer:
         (registry if registry is not None else REGISTRY).add_collector(
             self._flight_collector)
         self._export_mesh_gauges()
+        # committed perf baselines (bench/baselines) as info gauges: a
+        # scrape shows which bench bar this server build is held to
+        # (tools/perf_gate.py; tpustack.obs.perfsig)
+        from tpustack.obs import perfsig
+
+        perfsig.export_baseline_gauges(registry)
         sanitize.install_guards(self)
 
     def _flight_collector(self, registry) -> None:

@@ -109,6 +109,11 @@ class SDServer:
         self._registry = registry
         self.metrics = obs_catalog.build(registry)
         obs_device.install(registry)
+        # committed perf baselines as info gauges (which bench bar this
+        # server build is held to — tools/perf_gate.py, obs.perfsig)
+        from tpustack.obs import perfsig
+
+        perfsig.export_baseline_gauges(registry)
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
         # tenant cost ledger: process-wide on the default registry, private
         # per injected test Registry (the tracer's isolation contract)

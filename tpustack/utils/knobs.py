@@ -224,6 +224,10 @@ _declare("TPUSTACK_TENANT_DEFAULT", str, "anonymous",
 _declare("TPUSTACK_REPLAY_URL", str, "",
          "Default target URL for tools/replay.py (the in-cluster replay "
          "Job sets it); empty = the tool's --url default.")
+_declare("TPUSTACK_BENCH_BASELINES", str, "",
+         "Committed perf-baseline store read by tools/perf_gate.py and "
+         "exported as tpustack_bench_baseline_* gauges at server start; "
+         "empty = <repo>/bench/baselines.")
 
 # ---------------------------------------------------------------- sanitizers
 _declare("TPUSTACK_SANITIZE", bool, False,
