@@ -109,13 +109,24 @@ TENANT = None
 
 
 def retry_delay_s(attempt, retry_after, backoff_s=0.5, jitter=0.25,
-                  rng=random):
+                  rng=random, exact=False):
     """Server ``Retry-After`` when present, else exponential backoff —
-    jittered so restarted batch Jobs don't herd onto a draining server."""
+    jittered so restarted batch Jobs don't herd onto a draining server.
+
+    ``exact`` (a QoS quota shed, ``X-Shed-Reason: quota``): the
+    Retry-After is THIS tenant's own token-bucket refill ETA, not a
+    fleet-wide load hint — sleeping less guarantees a re-shed and
+    proportional jitter would oversleep a long refill, so honour it
+    exactly plus a small additive de-synchronising jitter."""
     try:
         base = float(retry_after) if retry_after is not None else None
     except ValueError:
         base = None
+    if exact and base is not None:
+        # NOT capped at MAX_RETRY_SLEEP_S: a tenant deep in quota debt
+        # may be told "come back in 300s", and sleeping any less burns a
+        # bounded retry attempt on a guaranteed re-shed
+        return base + rng.uniform(0, 0.25)
     if base is None:
         base = backoff_s * (2 ** attempt)
     base = min(base, MAX_RETRY_SLEEP_S)
@@ -148,9 +159,12 @@ def get_json(base_url, path, payload=None, timeout=30, retries=0,
         except urllib.error.HTTPError as e:
             if e.code not in RETRY_STATUSES or attempt == retries:
                 raise
-            delay = retry_delay_s(attempt, e.headers.get("Retry-After"))
+            delay = retry_delay_s(
+                attempt, e.headers.get("Retry-After"),
+                exact=e.headers.get("X-Shed-Reason") == "quota")
             print(f"  server said {e.code} "
-                  f"(Retry-After={e.headers.get('Retry-After', '-')}); "
+                  f"(Retry-After={e.headers.get('Retry-After', '-')}, "
+                  f"reason={e.headers.get('X-Shed-Reason', '-')}); "
                   f"retrying in {delay:.1f}s")
             time.sleep(delay)
         except urllib.error.URLError:

@@ -52,15 +52,26 @@ MAX_RETRY_SLEEP_S = 120.0
 
 def retry_delay_s(attempt: int, retry_after: str | None,
                   backoff_s: float = 0.5, jitter: float = 0.25,
-                  rng=random) -> float:
+                  rng=random, exact: bool = False) -> float:
     """Delay before retry ``attempt`` (0-based): the server's ``Retry-After``
     when it sent one, else exponential backoff — both with proportional
     jitter so a restarted batch Job doesn't thundering-herd a draining
-    server."""
+    server.
+
+    ``exact`` (a QoS quota shed, ``X-Shed-Reason: quota``): the
+    Retry-After is THIS tenant's own token-bucket refill ETA, not a
+    fleet-wide load hint — sleeping less guarantees a re-shed and
+    proportional jitter would oversleep a long refill, so honour it
+    exactly plus a small additive de-synchronising jitter."""
     try:
         base = float(retry_after) if retry_after is not None else None
     except ValueError:
         base = None
+    if exact and base is not None:
+        # NOT capped at MAX_RETRY_SLEEP_S: a tenant deep in quota debt
+        # may be told "come back in 300s", and sleeping any less burns a
+        # bounded retry attempt on a guaranteed re-shed
+        return base + rng.uniform(0, 0.25)
     if base is None:
         base = backoff_s * (2 ** attempt)
     base = min(base, MAX_RETRY_SLEEP_S)
@@ -127,9 +138,12 @@ def _post_with_retries(url: str, payload: dict, name: str,
             time.sleep(delay)
             continue
         if resp.status_code in RETRY_STATUSES and attempt < retries:
-            delay = retry_delay_s(attempt, resp.headers.get("Retry-After"))
+            delay = retry_delay_s(
+                attempt, resp.headers.get("Retry-After"),
+                exact=resp.headers.get("X-Shed-Reason") == "quota")
             print(f"    {name}: server said {resp.status_code} "
-                  f"(Retry-After={resp.headers.get('Retry-After', '-')}), "
+                  f"(Retry-After={resp.headers.get('Retry-After', '-')}, "
+                  f"reason={resp.headers.get('X-Shed-Reason', '-')}), "
                   f"retrying in {delay:.1f}s")
             time.sleep(delay)
             continue

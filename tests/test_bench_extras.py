@@ -46,6 +46,10 @@ def test_llm_extras_schema(monkeypatch):
                    "goodput_rps": 4.5, "goodput_ratio": 0.9,
                    "shed": 2, "deadline": 1, "errors": 3,
                    "tenants": {"interactive": {"offered": 10}},
+                   # QoS split: per-priority outcome table + the server's
+                   # qos counters ride the replay cell too
+                   "priorities": {"batch": {"shed": 2}},
+                   "server_qos": {"counters": {"shed": {"batch": 2}}},
                    # provenance + exact-counter signature (PR 13): every
                    # tool artifact carries them and the driver keeps them
                    "meta": {"schema_version": 1, "git_sha": "cafe",
@@ -79,6 +83,12 @@ def test_llm_extras_schema(monkeypatch):
     assert out["replay"]["schedule_sha"] == "abc123"
     assert out["replay"]["errors"] == 3
     assert out["replay"]["tenants"]["interactive"]["offered"] == 10
+    # the per-priority split + server qos counters ride the replay cell
+    assert out["replay"]["priorities"]["batch"]["shed"] == 2
+    assert out["replay"]["server_qos"]["counters"]["shed"]["batch"] == 2
+    # the bench replay scenario is mixed-priority (one tenant per class)
+    assert any(":interactive" in " ".join(c) and ":batch" in " ".join(c)
+               for c in calls)
     # the seven tool invocations: batch-8 continuous + the 8k prefill
     # + the shared-prefix (prefix KV cache) + the paged-KV sweep + the
     # speculative-decoding sweep + the tensor-parallel sweep + the

@@ -35,11 +35,23 @@ def replay():
 
 # ------------------------------------------------------------- schedule
 def test_parse_tenants(replay):
-    assert replay.parse_tenants("a:2,b:0.5") == {"a": 2.0, "b": 0.5}
+    assert replay.parse_tenants("a:2,b:0.5") == {
+        "a": {"rate": 2.0, "priority": None},
+        "b": {"rate": 0.5, "priority": None}}
+    # the optional third field is the QoS priority class
+    assert replay.parse_tenants("hot:4:interactive,bulk:9:batch") == {
+        "hot": {"rate": 4.0, "priority": "interactive"},
+        "bulk": {"rate": 9.0, "priority": "batch"}}
     with pytest.raises(ValueError):
         replay.parse_tenants("nameonly")
     with pytest.raises(ValueError):
         replay.parse_tenants("")
+    with pytest.raises(ValueError):
+        replay.parse_tenants("a:2:urgent")  # not a known priority class
+    with pytest.raises(ValueError, match="bad --tenants"):
+        replay.parse_tenants("a:")  # empty rate: usage error, not float('')
+    with pytest.raises(ValueError, match="not a number"):
+        replay.parse_tenants("a:fast")
 
 
 def test_schedule_is_seed_deterministic(replay):
@@ -129,6 +141,38 @@ def test_reduce_results_per_tenant(replay):
     assert out["offered"] == 6
     assert out["goodput_ratio"] == pytest.approx(3 / 6)
     assert out["shed"] == 1 and out["deadline"] == 1 and out["errors"] == 1
+    # no priorities in the schedule → the split is empty, never invented
+    assert out["priorities"] == {}
+
+
+def test_reduce_results_per_priority(replay):
+    """The QoS acceptance view: results split by priority class with the
+    same counts/percentile fields as the tenant table."""
+    requests = ([{"at": 0, "tenant": "hot", "priority": "interactive"}] * 3
+                + [{"at": 0, "tenant": "bulk", "priority": "batch"}] * 3)
+    results = [
+        {"tenant": "hot", "priority": "interactive", "status": 200,
+         "e2e_s": 1.0, "ttft_s": 0.1, "tpot_ms": 5.0, "tokens": 4},
+        {"tenant": "hot", "priority": "interactive", "status": 200,
+         "e2e_s": 2.0, "ttft_s": 0.2, "tpot_ms": 6.0, "tokens": 4},
+        {"tenant": "bulk", "priority": "batch", "status": 429,
+         "e2e_s": 0.01, "ttft_s": None, "tpot_ms": None, "tokens": 0},
+        {"tenant": "bulk", "priority": "batch", "status": 200,
+         "e2e_s": 4.0, "ttft_s": 0.5, "tpot_ms": 9.0, "tokens": 2},
+    ]
+    out = replay.reduce_results(requests, results, duration=10.0,
+                                wall_s=10.0)
+    pr = out["priorities"]
+    assert set(pr) == {"interactive", "batch"}
+    assert pr["interactive"]["offered"] == 3
+    assert pr["interactive"]["ok"] == 2 and pr["interactive"]["shed"] == 0
+    assert pr["batch"]["shed"] == 1 and pr["batch"]["ok"] == 1
+    assert pr["interactive"]["goodput_ratio"] == pytest.approx(1.0)
+    assert pr["batch"]["goodput_ratio"] == pytest.approx(0.5)
+    assert pr["interactive"]["ttft_s"]["p50"] == pytest.approx(0.15)
+    # the tenant table records each tenant's priority class
+    assert out["tenants"]["hot"]["priority"] == "interactive"
+    assert out["tenants"]["bulk"]["priority"] == "batch"
 
 
 # ----------------------------------------------------------- --tiny smoke
@@ -151,7 +195,7 @@ def test_replay_tiny_smoke(tmp_path):
     assert len(artifact["schedule_sha"]) == 16
     tenants = artifact["tenants"]
     assert len(tenants) >= 2
-    rates = {artifact["config"]["tenants"][t] for t in tenants}
+    rates = {artifact["config"]["tenants"][t]["rate"] for t in tenants}
     assert len(rates) >= 2  # genuinely different offered rates
     for t, d in tenants.items():
         for k in ("offered", "ok", "shed", "deadline", "error",

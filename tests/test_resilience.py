@@ -820,6 +820,19 @@ def test_retry_delay_honours_retry_after_and_backoff():
         # a hostile/huge hint is capped
         assert mod.retry_delay_s(0, "99999", rng=_FixedRng) == \
             mod.MAX_RETRY_SLEEP_S
+        # QoS quota sheds (X-Shed-Reason: quota → exact=True): the hint
+        # is the tenant's OWN bucket-refill ETA — honoured exactly, NOT
+        # capped (sleeping less guarantees a re-shed) and without the
+        # proportional jitter (which would oversleep a long refill)
+        assert mod.retry_delay_s(0, "300", rng=_FixedRng,
+                                 exact=True) == 300.0
+        assert mod.retry_delay_s(0, "7", rng=_FixedRng, exact=True) == 7.0
+        # exact with a garbage/absent hint still falls back to capped
+        # exponential backoff
+        assert mod.retry_delay_s(2, "soon", backoff_s=0.5, rng=_FixedRng,
+                                 exact=True) == 2.0
+        assert mod.retry_delay_s(1, None, backoff_s=0.5, rng=_FixedRng,
+                                 exact=True) == 1.0
 
 
 class _ScriptedHandler:

@@ -38,10 +38,13 @@ LLM_EXTRA_KEEP = (
     "tp_ways", "weights_per_chip_bytes", "kv_per_chip_bytes",
     "flight", "error",
     # replay artifact keys: offered vs achieved goodput + the per-tenant
-    # percentile/outcome table + the schedule digest (same seed = same
-    # offered load across driver rounds)
+    # AND per-priority-class percentile/outcome tables + the schedule
+    # digest (same seed = same offered load across driver rounds) + the
+    # self-hosted server's qos counter view (shed/preempt/quota_throttle
+    # by priority — the "shed lands on batch first" evidence)
     "seed", "schedule_sha", "offered_rps", "goodput_rps",
     "goodput_ratio", "shed", "deadline", "errors", "tenants",
+    "priorities", "server_qos",
     # provenance + the machine-exact perf signature (tpustack.obs.perfsig)
     # ride each cell into the driver artifact: BENCH_r*.json rounds carry
     # the exact counters the perf gate ratchets on, per measurement

@@ -69,18 +69,32 @@ _WORDS = ("the", "chip", "wave", "slot", "block", "cache", "queue",
 
 
 # ------------------------------------------------------------- schedule
-def parse_tenants(spec: str) -> Dict[str, float]:
-    """``"a:2,b:0.5"`` → {"a": 2.0, "b": 0.5} (requests/second each)."""
-    out: Dict[str, float] = {}
+def parse_tenants(spec: str) -> Dict[str, Dict]:
+    """``"a:2,b:0.5:batch"`` → {"a": {"rate": 2.0, "priority": None},
+    "b": {"rate": 0.5, "priority": "batch"}}.  The optional third field
+    is the QoS priority class every one of that tenant's requests
+    carries as ``X-Priority`` (None sends no header — the server's
+    per-tenant/policy default applies)."""
+    out: Dict[str, Dict] = {}
     for part in spec.split(","):
         part = part.strip()
         if not part:
             continue
-        name, _, rate = part.partition(":")
-        if not name or not rate:
+        fields = part.split(":")
+        if (len(fields) < 2 or len(fields) > 3
+                or not fields[0].strip() or not fields[1].strip()):
             raise ValueError(
-                f"bad --tenants entry {part!r} (want name:rate)")
-        out[name.strip()] = float(rate)
+                f"bad --tenants entry {part!r} (want name:rate[:priority])")
+        prio = fields[2].strip().lower() if len(fields) == 3 else None
+        if prio is not None and prio not in ("interactive", "batch"):
+            raise ValueError(f"bad --tenants entry {part!r}: priority "
+                             f"{prio!r} not in (interactive, batch)")
+        try:
+            rate = float(fields[1])
+        except ValueError:
+            raise ValueError(f"bad --tenants entry {part!r}: rate "
+                             f"{fields[1]!r} is not a number") from None
+        out[fields[0].strip()] = {"rate": rate, "priority": prio}
     if not out:
         raise ValueError("--tenants resolved to no tenants")
     return out
@@ -111,16 +125,22 @@ def _lognormal_int(rng: random.Random, median: float, sigma: float,
         median * math.exp(rng.gauss(0.0, sigma))))))
 
 
-def build_schedule(seed: int, tenants: Dict[str, float], duration: float,
+def build_schedule(seed: int, tenants: Dict[str, Dict], duration: float,
                    burstiness: float, prompt_chars: float,
                    prompt_sigma: float, new_tokens: float,
                    output_sigma: float, prefix_pool: int,
                    max_new_cap: int = 256) -> List[Dict]:
     """The full offered load, derived from the seed up front (open-loop:
     nothing about the server's behaviour can perturb it).  One dict per
-    request: send-time offset, tenant, prompt text, n_predict.  Each
-    tenant gets its own child RNG (seeded from (seed, tenant)), so adding
-    a tenant never reshuffles another's arrivals."""
+    request: send-time offset, tenant, priority class (None = let the
+    server's policy default apply), prompt text, n_predict.  Each tenant
+    gets its own child RNG (seeded from (seed, tenant)), so adding a
+    tenant never reshuffles another's arrivals."""
+    # accept both shapes: {"a": 2.0} (legacy rate-only) and
+    # {"a": {"rate": 2.0, "priority": "batch"}} (parse_tenants)
+    tenants = {t: (v if isinstance(v, dict)
+                   else {"rate": float(v), "priority": None})
+               for t, v in tenants.items()}
     requests: List[Dict] = []
     for tenant in sorted(tenants):
         rng = random.Random(f"{seed}:{tenant}")
@@ -130,12 +150,13 @@ def build_schedule(seed: int, tenants: Dict[str, float], duration: float,
             pool.append(f"[{tenant}/{p}] " + " ".join(
                 rng.choice(_WORDS) for _ in range(max(1, n // 5))))
         for i, at in enumerate(_gamma_interarrivals(
-                rng, tenants[tenant], duration, burstiness)):
+                rng, tenants[tenant]["rate"], duration, burstiness)):
             prefix = rng.choice(pool)
             suffix = " ".join(rng.choice(_WORDS) for _ in range(3))
             requests.append({
                 "at": round(at, 6),
                 "tenant": tenant,
+                "priority": tenants[tenant]["priority"],
                 "prompt": f"{prefix} q{i}: {suffix}",
                 "n_predict": _lognormal_int(rng, new_tokens, output_sigma,
                                             1, max_new_cap),
@@ -164,13 +185,17 @@ def _post_completion(url: str, req: Dict, deadline_s: float,
     data = json.dumps(body).encode()
     t0 = time.perf_counter()
     rec = {"tenant": req["tenant"], "at": req["at"], "status": 0,
+           "priority": req.get("priority"),
            "e2e_s": None, "ttft_s": None, "tpot_ms": None,
            "tokens": 0}
     try:
+        headers = {"Content-Type": "application/json",
+                   "X-Tenant-Id": req["tenant"]}
+        if req.get("priority"):
+            headers["X-Priority"] = req["priority"]
         r = urllib.request.Request(
             url.rstrip("/") + "/completion", data=data,
-            headers={"Content-Type": "application/json",
-                     "X-Tenant-Id": req["tenant"]})
+            headers=headers)
         with urllib.request.urlopen(r, timeout=timeout_s) as resp:
             payload = json.loads(resp.read().decode())
             rec["status"] = resp.status
@@ -239,46 +264,69 @@ def _outcome(status: int) -> str:
     return "error"
 
 
+def _bucket_stats(rs: List[Dict], offered: int, duration: float) -> Dict:
+    """Outcome counts + percentiles for one grouping (a tenant or a
+    priority class) — the shared reducer body."""
+    counts = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
+    for r in rs:
+        counts[_outcome(r["status"])] += 1
+    finished = sum(counts.values())
+    oks = [r for r in rs if _outcome(r["status"]) == "ok"]
+    e2e = sorted(r["e2e_s"] for r in oks if r["e2e_s"] is not None)
+    ttft = sorted(r["ttft_s"] for r in oks if r["ttft_s"] is not None)
+    tpot = sorted(r["tpot_ms"] for r in oks if r["tpot_ms"] is not None)
+    return {
+        "offered": offered,
+        "offered_rps": round(offered / duration, 4),
+        "completed": finished,
+        **counts,
+        "goodput_ratio": (counts["ok"] / finished) if finished else 0.0,
+        # same horizon as offered_rps: the ok answers correspond to
+        # offers made during `duration`, so dividing by the longer
+        # wall (which includes the post-schedule drain tail) would
+        # fake a throughput loss even at 100% goodput
+        "goodput_rps": round(counts["ok"] / duration, 4),
+        "tokens": sum(r["tokens"] for r in oks),
+        "ttft_s": {"p50": _pct(ttft, 50), "p99": _pct(ttft, 99)},
+        "tpot_ms": {"p50": _pct(tpot, 50), "p99": _pct(tpot, 99)},
+        "e2e_s": {"p50": _pct(e2e, 50), "p99": _pct(e2e, 99)},
+    }
+
+
 def reduce_results(requests: List[Dict], results: List[Dict],
                    duration: float, wall_s: float) -> Dict:
-    """Per-tenant percentiles + goodput-vs-offered — the artifact body."""
+    """Per-tenant AND per-priority percentiles + goodput-vs-offered —
+    the artifact body.  The ``priorities`` split is how the QoS
+    acceptance bar reads: under a saturating batch tenant, interactive
+    goodput and tail latency must hold while batch eats the sheds."""
     by_tenant: Dict[str, List[Dict]] = {}
+    by_prio: Dict[str, List[Dict]] = {}
     for r in results:
         by_tenant.setdefault(r["tenant"], []).append(r)
+        if r.get("priority"):
+            by_prio.setdefault(r["priority"], []).append(r)
     offered_by: Dict[str, int] = {}
+    offered_prio: Dict[str, int] = {}
+    prio_of: Dict[str, Optional[str]] = {}
     for r in requests:
         offered_by[r["tenant"]] = offered_by.get(r["tenant"], 0) + 1
+        prio_of[r["tenant"]] = r.get("priority")
+        if r.get("priority"):
+            offered_prio[r["priority"]] = (
+                offered_prio.get(r["priority"], 0) + 1)
     tenants = {}
     for tenant in sorted(offered_by):
-        rs = by_tenant.get(tenant, [])
-        counts = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
-        for r in rs:
-            counts[_outcome(r["status"])] += 1
-        finished = sum(counts.values())
-        oks = [r for r in rs if _outcome(r["status"]) == "ok"]
-        e2e = sorted(r["e2e_s"] for r in oks if r["e2e_s"] is not None)
-        ttft = sorted(r["ttft_s"] for r in oks if r["ttft_s"] is not None)
-        tpot = sorted(r["tpot_ms"] for r in oks if r["tpot_ms"] is not None)
-        tenants[tenant] = {
-            "offered": offered_by[tenant],
-            "offered_rps": round(offered_by[tenant] / duration, 4),
-            "completed": finished,
-            **counts,
-            "goodput_ratio": (counts["ok"] / finished) if finished else 0.0,
-            # same horizon as offered_rps: the ok answers correspond to
-            # offers made during `duration`, so dividing by the longer
-            # wall (which includes the post-schedule drain tail) would
-            # fake a throughput loss even at 100% goodput
-            "goodput_rps": round(counts["ok"] / duration, 4),
-            "tokens": sum(r["tokens"] for r in oks),
-            "ttft_s": {"p50": _pct(ttft, 50), "p99": _pct(ttft, 99)},
-            "tpot_ms": {"p50": _pct(tpot, 50), "p99": _pct(tpot, 99)},
-            "e2e_s": {"p50": _pct(e2e, 50), "p99": _pct(e2e, 99)},
-        }
+        tenants[tenant] = _bucket_stats(by_tenant.get(tenant, []),
+                                        offered_by[tenant], duration)
+        tenants[tenant]["priority"] = prio_of.get(tenant)
+    priorities = {p: _bucket_stats(by_prio.get(p, []), offered_prio[p],
+                                   duration)
+                  for p in sorted(offered_prio)}
     total_ok = sum(t["ok"] for t in tenants.values())
     total_finished = sum(t["completed"] for t in tenants.values())
     return {
         "tenants": tenants,
+        "priorities": priorities,
         "offered": len(requests),
         "offered_rps": round(len(requests) / duration, 4),
         "goodput_rps": round(total_ok / duration, 4),
@@ -356,6 +404,10 @@ class _SelfHosted:
     def ledger_snapshot(self) -> Dict:
         return self.server.ledger.snapshot()
 
+    def qos_snapshot(self) -> Dict:
+        qos = getattr(self.server, "qos", None)
+        return qos.snapshot() if qos is not None else {"enabled": False}
+
     def close(self):
         import asyncio
 
@@ -374,7 +426,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help=f"target server (default: TPUSTACK_REPLAY_URL or "
                         f"{DEFAULT_URL})")
     p.add_argument("--tenants", default="interactive:4,batch:1",
-                   help="per-tenant offered rates, name:rps[,name:rps...]")
+                   help="per-tenant offered load: name:rps[:priority]"
+                        "[,...] — the optional priority (interactive|"
+                        "batch) rides every request as X-Priority")
     p.add_argument("--duration", type=float, default=10.0,
                    help="seconds of offered load (the schedule horizon)")
     p.add_argument("--seed", type=int, default=0,
@@ -408,6 +462,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--tiny", action="store_true",
                    help="CPU smoke: self-host the tiny model with a short, "
                         "small schedule (the tier-1/CI gate)")
+    p.add_argument("--qos-policy", default="",
+                   help="TPUSTACK_QOS_POLICY for the self-hosted server "
+                        "(inline JSON or a file path): per-tenant "
+                        "priority defaults + token-bucket quotas")
+    p.add_argument("--env", action="append", default=[], metavar="K=V",
+                   help="extra env for the self-hosted server (e.g. "
+                        "TPUSTACK_MAX_QUEUE_DEPTH=4); repeatable, applied "
+                        "before the server module is imported")
+    p.add_argument("--assert-qos", action="store_true",
+                   help="exit 3 unless interactive goodput_ratio >= batch "
+                        "goodput_ratio AND the self-hosted server shed at "
+                        "least one batch request (the CI mixed-priority "
+                        "smoke gate)")
     p.add_argument("--out", default="",
                    help="write the JSON artifact here (default: stdout)")
     args = p.parse_args(argv)
@@ -431,6 +498,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.max_new = min(args.max_new, 8)
         args.deadline_s = min(args.deadline_s, 60.0)
 
+    # self-hosted server env: QoS policy + ad-hoc knobs land in
+    # os.environ BEFORE the server is imported/constructed (the knob
+    # registry reads at construction time)
+    for kv in args.env:
+        k, sep, v = kv.partition("=")
+        if not sep:
+            p.error(f"--env {kv!r}: want K=V")
+        os.environ[k] = v
+    if args.qos_policy:
+        os.environ["TPUSTACK_QOS_POLICY"] = args.qos_policy
+
     tenants = parse_tenants(args.tenants)
     schedule = build_schedule(
         args.seed, tenants, args.duration, args.burstiness,
@@ -439,7 +517,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     sha = schedule_sha(schedule)
     log(f"schedule: {len(schedule)} requests over {args.duration}s from "
         f"seed {args.seed} (sha {sha}), tenants "
-        + ", ".join(f"{t}@{r}rps" for t, r in sorted(tenants.items())))
+        + ", ".join(f"{t}@{c['rate']}rps"
+                    + (f"/{c['priority']}" if c["priority"] else "")
+                    for t, c in sorted(tenants.items())))
     if not schedule:
         print(json.dumps({"error": "empty schedule (rates x duration "
                           "produced no arrivals)"}))
@@ -488,6 +568,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             # the server-side ledger view of the same run — what the
             # conservation tests cross-check the client artifact against
             artifact["server_tenants"] = host.ledger_snapshot()
+            # ... and the QoS policy's own counters/buckets (shed,
+            # preempt, quota_throttle per priority) — the smoke gate's
+            # "shed landed on batch" evidence
+            artifact["server_qos"] = host.qos_snapshot()
     finally:
         if host is not None:
             host.close()
@@ -498,6 +582,31 @@ def main(argv: Optional[List[str]] = None) -> int:
             f.write(blob + "\n")
         log(f"artifact written to {args.out}")
     print(blob)
+
+    if args.assert_qos:
+        prios = artifact.get("priorities") or {}
+        inter = (prios.get("interactive") or {}).get("goodput_ratio")
+        batch = (prios.get("batch") or {}).get("goodput_ratio")
+        counters = (artifact.get("server_qos") or {}).get("counters") or {}
+        batch_shed = (counters.get("shed", {}).get("batch", 0)
+                      + counters.get("quota_throttle", {}).get("batch", 0))
+        problems = []
+        if inter is None or batch is None:
+            problems.append("need both an interactive and a batch tenant "
+                            "(--tenants name:rps:priority)")
+        elif inter < batch:
+            problems.append(f"interactive goodput {inter:.3f} < batch "
+                            f"goodput {batch:.3f}")
+        if batch_shed == 0:
+            problems.append("no batch request was shed/throttled "
+                            "(qos_shed{priority='batch'} == 0) — the "
+                            "smoke did not saturate, or QoS is off")
+        if problems:
+            for msg in problems:
+                log(f"--assert-qos FAILED: {msg}")
+            return 3
+        log(f"--assert-qos ok: interactive {inter:.3f} >= batch "
+            f"{batch:.3f}, batch sheds {batch_shed}")
     return 0
 
 

@@ -169,6 +169,12 @@ class SlotRequest:
     # paths: no ledger, no accounting.
     tenant: Optional[str] = None
     t_kv_alloc: Optional[float] = None
+    # QoS priority class (tpustack.serving.qos): "interactive" | "batch",
+    # resolved once by the resilience middleware and carried here
+    # explicitly (same contract as tenant/span_ctx).  None (bench/CLI
+    # paths, or TPUSTACK_QOS=0) means the request neither preempts nor
+    # can be preempted — the QoS-free engine behavior.
+    priority: Optional[str] = None
 
 
 class _Slot:
@@ -238,7 +244,9 @@ class ContinuousEngine:
                  tracer=None, paged=None, spec=None, on_spec=None,
                  compile_budgets: Optional[Dict[str, int]] = None,
                  flight=None, queue_depth: Optional[Callable[[], int]] = None,
-                 ledger=None):
+                 ledger=None,
+                 preempt_hint: Optional[Callable[[], bool]] = None,
+                 on_preempt: Optional[Callable[[str], None]] = None):
         self.gen = gen
         self.B = slots
         self.chunk = chunk
@@ -316,6 +324,23 @@ class ContinuousEngine:
         # engine accounting-free (bench/CLI paths).
         self.ledger = ledger
         self._queue_depth_fn = queue_depth
+        # QoS preemption (tpustack.serving.qos, paged engines only):
+        # `preempt_hint()` answers "is an interactive request waiting for
+        # a slot?" (the server's queue view; racy reads are fine — a
+        # stale True costs one spurious park, a stale False one wave of
+        # extra wait).  When it fires with every slot busy and a batch
+        # occupant live, the engine PARKS the batch slot at the wave
+        # boundary: its pool block refs are retained on a parked
+        # SlotRequest that re-admits through the _admit_prefix_paged
+        # warm start (prompt + generated KV is the "cached prefix" —
+        # no prefill work is lost; greedy resume is byte-identical).
+        # `on_preempt(tenant)` is the server's metrics hook.  Both None
+        # (TPUSTACK_QOS=0 / bench paths) keeps the loop byte-for-byte
+        # the preemption-free engine.
+        self._preempt_hint = preempt_hint
+        self._on_preempt = on_preempt
+        self._parked: List[SlotRequest] = []
+        self._preempted = 0
         self._last_wave_t: Optional[float] = None
         self._to_park: List[int] = []  # retirements awaiting a fused park
         self._pending: List[_PendingWave] = []
@@ -920,6 +945,137 @@ class ContinuousEngine:
                                  if out else 0.0),
             })
 
+    # ------------------------------------------------------ QoS preemption
+    def _maybe_preempt(self, slots: List[_Slot]) -> None:
+        """Park one batch slot at the wave boundary when an interactive
+        request is waiting and every slot is busy — the freed slot is fed
+        (interactive-first) by the next ``admit_free``.  Paged engines
+        only: the park keeps the slot's pool block refs, which is what
+        makes resumption free of prefill work.  At most one park per
+        boundary (no thrash), and none while a park is already pending."""
+        if (self.paged is None or self._preempt_hint is None
+                or self._to_park or self._pending):
+            return
+        for s in slots:
+            if s.req is None:
+                return  # a free slot exists — nothing to preempt for
+        if not self._preempt_hint():
+            return
+        victim, best = None, -1
+        for i, s in enumerate(slots):
+            if s.req is None or s.pending or s.done:
+                continue
+            if s.req.priority != "batch":
+                continue
+            # the victim with the most remaining budget frees capacity
+            # for the longest (and has the most to gain from its warm
+            # resume)
+            rem = s.budget - len(s.out)
+            if rem > best:
+                best, victim = rem, i
+        if victim is not None:
+            self._park_slot(slots, victim)
+
+    def _park_slot(self, slots: List[_Slot], i: int) -> None:
+        """Evict slot ``i``'s occupant to a parked :class:`SlotRequest`.
+
+        The parked entry's ``ids`` are the full history (prompt + every
+        consumed token) and its ``prefix`` is the slot's retained pool
+        blocks with ``plen = len(history) - 1``: positions ``[0, plen)``
+        hold valid KV (prompt + all but the pending token), so
+        re-admission runs the existing ``_admit_prefix_paged`` warm start
+        — a one-token masked suffix "prefill" of the pending token, and
+        the first sampled token is exactly the next token an
+        uninterrupted greedy run would have produced.  Device-side
+        overshoot KV past ``plen`` (in-flight chunks dispatched before
+        the park) is overwritten by the suffix prefill + contiguous
+        decode before any position is attended — the same reassignment-
+        safety argument the engine docstring makes for retired slots."""
+        s = slots[i]
+        req = s.req
+        prior = list(s.out)
+        orig_budget, orig_cached = s.budget, s.cached
+        blocks = list(s.blocks)
+        # the parked entry inherits the slot's pool references — no decref
+        s.blocks, s.alloc = [], 0
+        s.req, s.done, s.pending = None, True, False
+        if s.span is not None:
+            s.span.add_event("preempted", tokens_so_far=len(prior))
+            s.span.end()
+            s.span = None
+        if self._bt is not None:
+            self._bt[i, :] = 0
+        self._to_park.append(i)
+        # prior tokens were generated and delivered during this occupancy;
+        # the resumed occupancy's retire counts only its own
+        self._retired_tokens += len(prior)
+        new_ids = list(req.ids) + prior
+        plen = len(new_ids) - 1
+        orig_done = req.on_done
+
+        def on_done(tokens, stats):
+            if orig_done is None:
+                return
+            if tokens is None:  # resume-time admission failure
+                orig_done(None, stats)
+                return
+            st = dict(stats)
+            # report the ORIGINAL request's shape, not the resume's
+            # history-as-prompt view; timing fields stay the resumed
+            # occupancy's (the prior occupancy's wall already elapsed)
+            st["prompt_tokens"] = len(req.ids)
+            st["generated_tokens"] = len(prior) + len(tokens)
+            st["cached_tokens"] = orig_cached
+            st["prefill_tokens"] = len(req.ids) - orig_cached
+            st["preempted"] = st.get("preempted", 0) + 1
+            orig_done(prior + tokens, st)
+
+        parked = SlotRequest(
+            ids=new_ids,
+            max_new=orig_budget - len(prior),
+            sample=req.sample,
+            on_tokens=req.on_tokens,
+            on_done=on_done,
+            cancelled=req.cancelled,
+            # greedy resume (the byte-identity contract) ignores seeds;
+            # a seeded sampled row resumes on a history-derived subkey —
+            # still deterministic under a deterministic preemption
+            # schedule, but its chain differs from the uninterrupted run
+            seed=(None if req.seed is None
+                  else (req.seed + plen) % (2 ** 32)),
+            prefix=(plen, blocks),
+            span_ctx=req.span_ctx,
+            speculative=req.speculative,
+            tenant=req.tenant,
+            t_kv_alloc=req.t_kv_alloc,
+            priority=req.priority,
+        )
+        self._parked.append(parked)
+        self._preempted += 1
+        if self.flight is not None:
+            self.flight.record(
+                "preempt", slot=i, priority=req.priority,
+                tenant=req.tenant, parked_tokens=len(prior),
+                prefix_tokens=plen, blocks=len(blocks))
+        if self._on_preempt is not None:
+            try:
+                self._on_preempt(req.tenant)
+            except Exception:
+                log.exception("on_preempt hook failed")
+
+    def _pop_parked(self) -> Optional[SlotRequest]:
+        """Next parked entry ready to resume (FIFO); cancelled entries
+        release their retained blocks and report once."""
+        while self._parked:
+            req = self._parked.pop(0)
+            if req.cancelled():
+                self._release_blocks(req)
+                if req.on_done is not None:
+                    req.on_done(None, {"error": "cancelled while parked"})
+                continue
+            return req
+        return None
+
     def _flush_park(self, state):
         """Apply pending slot parks in one fused update."""
         if not self._to_park:
@@ -956,6 +1112,9 @@ class ContinuousEngine:
         admitted = 0
         self._to_park = []
         self._pending = []
+        self._parked = []
+        self._preempted = 0
+        self._resumed = 0
         self._retired_tokens = 0  # per-run total, counted at _retire
         self._spec_drafted = self._spec_accepted = 0
         self._spec_dispatches = self._plain_steps = 0
@@ -977,8 +1136,15 @@ class ContinuousEngine:
                     continue
                 req = feed()
                 if req is None:
-                    break
-                admitted += 1
+                    # no fresh work for this slot: resume preempted batch
+                    # entries (their retained blocks warm-start through
+                    # the prefix path — counted as resumes, not requests)
+                    req = self._pop_parked()
+                    if req is None:
+                        break
+                    self._resumed += 1
+                else:
+                    admitted += 1
                 wave.append((i, req))
             if wave:
                 gen_ctr = self._admit_dispatch(state, slots, wave, gen_ctr)
@@ -1016,6 +1182,15 @@ class ContinuousEngine:
                         log.exception("failed releasing slot blocks after "
                                       "engine failure")
                     s.blocks = []
+            for req in self._parked:
+                # parked entries hold retained refs on their prefix blocks
+                # — a failed run must hand those back too
+                try:
+                    self._release_blocks(req)
+                except Exception:
+                    log.exception("failed releasing parked blocks after "
+                                  "engine failure")
+            self._parked = []
             raise
         finally:
             if self.paged is not None:
@@ -1043,10 +1218,13 @@ class ContinuousEngine:
         # amortisation figure speculation exists to raise: plain decode is
         # bounded by the live slot count, speculation by live × (k+1)
         passes = self._plain_steps + self._spec_dispatches
-        decoded = max(0, n_tok - admitted)  # firsts come from prefill
+        # firsts come from prefill — one per admission AND per resume (a
+        # resumed parked entry samples its first from the warm start)
+        decoded = max(0, n_tok - admitted - self._resumed)
         stats.update({
             "decode_weight_passes": passes,
             "tokens_per_weight_pass": decoded / passes if passes else 0.0,
+            "preempted": self._preempted,
         })
         if self.spec is not None:
             stats.update({
@@ -1113,11 +1291,22 @@ class ContinuousEngine:
                 tenants[s.req.tenant] = tenants.get(s.req.tenant, 0) + 1
         return tenants
 
+    @staticmethod
+    def _priority_occupancy(slots) -> Dict[str, int]:
+        """{priority: live slots} — the QoS flight-record field (same
+        pre-retire snapshot discipline as the tenant split)."""
+        prios: Dict[str, int] = {}
+        for s in slots:
+            if s.req is not None and s.req.priority is not None:
+                prios[s.req.priority] = prios.get(s.req.priority, 0) + 1
+        return prios
+
     def _flight_wave(self, slots, kind: str, tokens: int,
                      weight_passes: int, stride: float,
                      drafted: int = 0, accepted: int = 0,
                      occupancy: Optional[int] = None,
-                     tenants: Optional[Dict[str, int]] = None) -> None:
+                     tenants: Optional[Dict[str, int]] = None,
+                     priorities: Optional[Dict[str, int]] = None) -> None:
         """Append one flight record for a fetched wave (plain chunk or
         speculative verify).  Host-side values only — the fetch that
         produced ``tokens`` already synced, so this is a dict build and a
@@ -1162,6 +1351,13 @@ class ContinuousEngine:
             tenants = self._tenant_occupancy(slots)
         if tenants:
             rec["tenants"] = tenants
+        # priority split ({priority: slots served}) — the QoS flight-
+        # record field: /debug/flight shows which class each wave's
+        # capacity went to
+        if priorities is None:
+            priorities = self._priority_occupancy(slots)
+        if priorities:
+            rec["priorities"] = priorities
         slowest, age = None, 0.0
         for s in slots:
             if s.req is not None and now - s.t0 > age:
@@ -1189,6 +1385,7 @@ class ContinuousEngine:
                 self._wave_ctr))
         live = self._live(slots)
         tenants = self._tenant_occupancy(slots)  # pre-retire, like live
+        priorities = self._priority_occupancy(slots)
         wave_tokens = 0
         for i, gid, offset in snapshot:
             s = slots[i]
@@ -1219,12 +1416,15 @@ class ContinuousEngine:
                 self._retire(state, slots, i, live)
         self._flight_wave(slots, "wave", wave_tokens, self.chunk,
                           stride=self.chunk, occupancy=live,
-                          tenants=tenants)
+                          tenants=tenants, priorities=priorities)
 
     def _run_loop(self, state, slots, chain, admit_free, dispatch_ok):
         while True:
-            # parks MUST land before admissions: a freshly admitted slot's
+            # wave boundary: park a batch slot first if an interactive
+            # request is waiting (no-op without a QoS preempt hint), then
+            # flush parks BEFORE admissions — a freshly admitted slot's
             # state would otherwise be zeroed by its predecessor's park
+            self._maybe_preempt(slots)
             self._flush_park(state)
             admit_free()
             if self._live(slots) == 0:
@@ -1369,6 +1569,7 @@ class ContinuousEngine:
         alpha = spec.ema_alpha
         live = self._live(slots)
         tenants = self._tenant_occupancy(slots)  # pre-retire, like live
+        priorities = self._priority_occupancy(slots)
         wave_tokens = wave_drafted = wave_accepted = 0
         for i, gid in rows:
             s = slots[i]
@@ -1420,7 +1621,8 @@ class ContinuousEngine:
         self._flight_wave(slots, "verify", wave_tokens, 1,
                           stride=wave_tokens / max(1, len(rows)),
                           drafted=wave_drafted, accepted=wave_accepted,
-                          occupancy=live, tenants=tenants)
+                          occupancy=live, tenants=tenants,
+                          priorities=priorities)
 
     def _run_loop_spec(self, state, slots, chain, admit_free, dispatch_ok):
         """Variable-stride wave loop (``spec`` configured): whenever the
@@ -1434,6 +1636,7 @@ class ContinuousEngine:
         and traffic that never drafts runs the plain loop at full depth —
         degrade-to-plain, never below it."""
         while True:
+            self._maybe_preempt(slots)
             self._flush_park(state)
             admit_free()
             if self._live(slots) == 0:

@@ -177,6 +177,35 @@ class TenantLedger:
         # labels it approximate.
         self._seen_overflow: set = set()
         self._seen_overflow_cap = 8192
+        # charge listeners (the QoS layer's quota buckets): called OUTSIDE
+        # the account lock with the RAW sanitized tenant (pre-overflow
+        # canonicalisation — quota policy is keyed on real tenant ids, not
+        # the bounded label) as (server, tenant, dimension, amount).
+        # Listeners must be cheap and must never raise into a charge path.
+        self._listeners: list = []
+
+    def add_listener(self, fn) -> None:
+        """Subscribe to charges (idempotent by identity).  Fired for the
+        metered dimensions (``tokens``, ``chip_seconds``) after each
+        charge lands in the accounts."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def _notify(self, server: str, tenant: Optional[str], dimension: str,
+                amount: float) -> None:
+        if not self._listeners:
+            return
+        t = sanitize_tenant(tenant)
+        if t is None:
+            t = knobs.get_str("TPUSTACK_TENANT_DEFAULT")
+        for fn in self._listeners:
+            try:
+                fn(server, t, dimension, amount)
+            except Exception:
+                from tpustack.utils import get_logger
+
+                get_logger("obs.accounting").exception(
+                    "ledger charge listener failed")
 
     # ------------------------------------------------------------- labels
     def _canon_locked(self, t: str) -> str:
@@ -216,6 +245,8 @@ class TenantLedger:
             self._m_prompt.labels(server=server, tenant=label).inc(prompt)
         if generated > 0:
             self._m_gen.labels(server=server, tenant=label).inc(generated)
+        self._notify(server, tenant, "tokens",
+                     max(0, int(prompt)) + max(0, int(generated)))
 
     def charge_chip_seconds(self, server: str, tenant: Optional[str],
                             seconds: float) -> None:
@@ -225,6 +256,7 @@ class TenantLedger:
             label, acct = self._account(tenant, server)
             acct["chip_seconds"] += float(seconds)
         self._m_chip.labels(server=server, tenant=label).inc(seconds)
+        self._notify(server, tenant, "chip_seconds", float(seconds))
 
     def charge_flight_wave(self, server: str, record: Mapping,
                            seconds_key: str = "wave_s") -> None:

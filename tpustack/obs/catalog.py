@@ -211,6 +211,46 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "SLO-aware shedding — ROADMAP item 5) will be judged by.",
                ("server", "tenant"), unit="ratio"),
 
+    # ---- multi-tenant QoS (tpustack.serving.qos; priority ∈
+    # interactive|batch.  The bucket gauge's tenant label is bounded by
+    # construction: policy tenants are operator-declared config, never
+    # client-minted) ----
+    MetricSpec("tpustack_qos_shed_total", "counter",
+               "Requests shed by the priority-aware backpressure wall: "
+               "batch sheds at batch_shed_ratio of TPUSTACK_MAX_QUEUE_"
+               "DEPTH, interactive at the full depth — under pressure "
+               "batch eats the 429s first, by design.",
+               ("server", "priority"), unit="total"),
+    MetricSpec("tpustack_qos_preempt_total", "counter",
+               "Engine slots preempted at a wave boundary so a waiting "
+               "interactive request could run: the batch slot's state "
+               "parks with its paged block refs retained and resumes via "
+               "the prefix warm-start path (no prefill work lost).",
+               ("priority",), unit="total"),
+    MetricSpec("tpustack_qos_quota_throttle_total", "counter",
+               "Requests 429'd because the tenant's token bucket (tokens/"
+               "s or chip-seconds/s, TPUSTACK_QOS_POLICY) was in debt; "
+               "Retry-After is that bucket's own refill ETA, not the "
+               "global p50 heuristic.", ("server", "priority"),
+               unit="total"),
+    MetricSpec("tpustack_qos_requests_total", "counter",
+               "Work requests finished per priority class, by outcome "
+               "(same outcome taxonomy as tpustack_tenant_requests_total)"
+               " — the numerator/denominator of the per-priority goodput "
+               "recordings slo-rules.yaml alerts on (interactive only).",
+               ("server", "priority", "outcome"), unit="total"),
+    MetricSpec("tpustack_qos_queue_wait_seconds", "histogram",
+               "Admission-queue wall time by priority class (llm engine "
+               "queue: enqueue to slot pickup) — the latency the "
+               "interactive-first dequeue and wave-boundary preemption "
+               "exist to bound.", ("priority",), unit="seconds"),
+    MetricSpec("tpustack_qos_bucket_level_ratio", "gauge",
+               "Live token-bucket balance over burst per policy tenant "
+               "and dimension (tokens|chip_seconds): 1 = full headroom, "
+               "<= 0 = in debt (requests 429 until refill).  Tenant "
+               "label bounded by the operator-declared policy, not "
+               "client input.", ("tenant", "dimension"), unit="ratio"),
+
     # ---- serving mesh (tensor/data-parallel GSPMD serving) ----
     MetricSpec("tpustack_mesh_axis_chips", "gauge",
                "Serving-mesh axis sizes (dp/fsdp/tp/sp ways) of the "

@@ -108,7 +108,11 @@ async def _extract_tenant(request, read_body: bool) -> str:
     untouched."""
     header = request.headers.get("X-Tenant-Id")
     body = None
-    if (header is None and read_body and request.method == "POST"
+    # the body is peeked on work endpoints even when the tenant header is
+    # present: the QoS middleware resolves the `priority` field from the
+    # same cached parse, and the cache saves the handler a second
+    # json.loads either way
+    if (read_body and request.method == "POST"
             and request.can_read_body
             and request.content_type == "application/json"):
         try:
@@ -238,6 +242,16 @@ def instrument(server_name: str, registry: Optional[Registry] = None,
                            or obs_accounting.outcome_from_status(status))
                 if outcome_accounting == "full" or outcome != "ok":
                     ledger.note_outcome(server_name, tenant, outcome)
+                    # per-priority goodput accounting (the QoS resilience
+                    # middleware resolved the class; absent = QoS off):
+                    # same taxonomy and counting mode as the tenant
+                    # outcomes, keyed on the bounded priority label —
+                    # what slo-rules.yaml's interactive burn-rate reads
+                    priority = request.get("priority")
+                    if priority is not None:
+                        m["tpustack_qos_requests_total"].labels(
+                            server=server_name, priority=priority,
+                            outcome=outcome).inc()
             obs_accounting.current_tenant.reset(tenant_token)
             if span is not None:
                 obs_trace.current_span.reset(token)
@@ -272,16 +286,22 @@ def add_debug_trace_routes(app, tracer: Optional[obs_trace.Tracer] = None):
     app.router.add_get("/debug/traces/{trace_id}", get_trace)
 
 
-def add_debug_tenant_routes(app, ledger=None) -> None:
+def add_debug_tenant_routes(app, ledger=None, qos=None) -> None:
     """Mount ``GET /debug/tenants``: the tenant ledger's exact per-tenant
     cost accounts (tokens, chip/KV-block/queue seconds, outcomes,
-    goodput) — what a scrape's bounded ``tenant`` label summarises."""
+    goodput) — what a scrape's bounded ``tenant`` label summarises.
+    With a QoS policy attached, the payload gains a ``qos`` section:
+    live token-bucket levels/ETAs per policy tenant plus the shed/
+    preempt/throttle counters."""
     from aiohttp import web
 
     led = ledger if ledger is not None else obs_accounting.LEDGER
 
     async def tenants_view(request: web.Request) -> web.Response:
-        return web.json_response(led.snapshot())
+        payload = led.snapshot()
+        payload["qos"] = (qos.snapshot() if qos is not None
+                          else {"enabled": False})
+        return web.json_response(payload)
 
     app.router.add_get("/debug/tenants", tenants_view)
 

@@ -670,6 +670,10 @@ class HistoryEntry:
     # shared), read by the worker at plan/finalize — the graph analog of
     # SlotRequest.tenant
     tenant: Optional[str] = None
+    # QoS priority class, same capture point: the worker counts the
+    # prompt's per-priority outcome at its publish/refuse points (the
+    # accept-and-poll analog of the middleware's status-derived count)
+    priority: Optional[str] = None
 
     def as_json(self) -> Dict[str, Any]:
         return {"status": {"completed": self.completed,
@@ -697,6 +701,15 @@ class GraphServer:
         # tenant cost ledger: process-wide on the default registry, private
         # per injected test Registry (the tracer's isolation contract)
         self.ledger = obs_accounting.for_registry(registry)
+        # multi-tenant QoS (tpustack.serving.qos): priority resolution +
+        # quota/priority-aware admission via the resilience middleware;
+        # outcome counts land at the worker's publish/refuse points
+        # (accept-and-poll: the HTTP status can't carry the verdict)
+        from tpustack.serving import qos as qos_mod
+
+        self.qos = qos_mod.QosPolicy.from_env(registry=registry)
+        if self.qos is not None:
+            self.ledger.add_listener(self.qos.on_ledger_charge)
         # engine flight recorder: per-node records from graph resolution
         # plus per-dispatch/finalize records from the worker, served on
         # /debug/flight and dumped by the resilience post-mortem hooks
@@ -739,7 +752,7 @@ class GraphServer:
             "graph", registry, concurrency=self.max_batch,
             queue_depth=self._queue.qsize,
             extra_busy=self._graph_busy, observe_http=False,
-            expected_service_s=60.0)  # video prompts run minutes, and the
+            expected_service_s=60.0, qos=self.qos)  # video prompts run minutes, and the
         # cold-start seed must say so before the first publish is observed
         self._t_submit: Dict[str, float] = {}  # guarded-by: _lock
         # serialises device dispatch against an in-progress /profile
@@ -830,6 +843,7 @@ class GraphServer:
                         status="error").inc()
                     self.ledger.note_outcome("graph", entry.tenant,
                                              "deadline")
+                    self._note_qos_outcome(entry, "deadline")
                     if pspan is not None:
                         pspan.add_event("deadline_exceeded", phase="queued")
                         pspan.end(status="error")
@@ -859,6 +873,7 @@ class GraphServer:
                     self.metrics["tpustack_graph_prompts_total"].labels(
                         status="error").inc()
                     self.ledger.note_outcome("graph", entry.tenant, "error")
+                    self._note_qos_outcome(entry, "error")
                     if pspan is not None:
                         pspan.set_attribute("error",
                                             f"{type(e).__name__}: {e}")
@@ -1017,6 +1032,16 @@ class GraphServer:
             dispatch_s=round(dispatch_s, 6),
             queue_depth=self._queue.qsize())
 
+    def _note_qos_outcome(self, entry: HistoryEntry, outcome: str) -> None:
+        """Per-priority goodput count at the worker's publish/refuse
+        points — the accept-and-poll analog of the middleware's
+        status-derived count (the /prompt 200 said nothing about whether
+        the work succeeded).  No-op with QoS off (no priority resolved)."""
+        if self.qos is None or entry.priority is None:
+            return
+        self.metrics["tpustack_qos_requests_total"].labels(
+            server="graph", priority=entry.priority, outcome=outcome).inc()
+
     def _finalize(self, pid, entry, outputs, finish, pspan=None):
         """Run deferred saves (fetch + encode + write) and publish."""
         self.resilience.beat()  # publishing is progress too
@@ -1038,6 +1063,7 @@ class GraphServer:
             self.ledger.charge_chip_seconds("graph", entry.tenant,
                                             finalize_s)
             self.ledger.note_outcome("graph", entry.tenant, "ok")
+            self._note_qos_outcome(entry, "ok")
             tr.observe_into(
                 self.metrics["tpustack_request_phase_latency_seconds"],
                 server="graph")
@@ -1061,6 +1087,7 @@ class GraphServer:
                                finalize_s=round(
                                    time.perf_counter() - t_fin, 6))
             self.ledger.note_outcome("graph", entry.tenant, "error")
+            self._note_qos_outcome(entry, "error")
             if fspan is not None:
                 fspan.end(status="error")
             if pspan is not None:
@@ -1124,7 +1151,8 @@ class GraphServer:
         pid = str(uuid.uuid4())
         entry = HistoryEntry(prompt_id=pid,
                              client_id=str(body.get("client_id", "")),
-                             tenant=obs_accounting.current_tenant.get())
+                             tenant=obs_accounting.current_tenant.get(),
+                             priority=request.get("priority"))
         parent = obs_trace.current_span.get()
         with self._lock:
             self._history[pid] = entry
@@ -1262,7 +1290,7 @@ class GraphServer:
                          self.resilience.middleware(work)])
         obs_http.add_debug_trace_routes(app, self.tracer)
         obs_http.add_debug_flight_routes(app, self.flight)
-        obs_http.add_debug_tenant_routes(app, self.ledger)
+        obs_http.add_debug_tenant_routes(app, self.ledger, qos=self.qos)
         app.router.add_get("/queue", self.queue_state)
         app.router.add_get("/object_info", self.object_info)
         app.router.add_get("/metrics",

@@ -101,6 +101,7 @@ from tpustack.obs import flight as obs_flight
 from tpustack.obs import http as obs_http
 from tpustack.obs import profile as obs_profile
 from tpustack.obs import trace as obs_trace
+from tpustack.serving import qos as qos_mod
 from tpustack.serving.resilience import (DeadlineExceeded,
                                          InjectedDeviceError,
                                          ResilienceManager)
@@ -233,7 +234,7 @@ class _PendingCompletion:
                  "stream_put", "seed", "prefix", "kv_extract", "on_prefill_kv",
                  "phase", "span_ctx", "queue_span", "kv_blocks",
                  "on_prefill_blocks", "speculative", "tenant", "t_enqueue",
-                 "t_kv_alloc")
+                 "t_kv_alloc", "priority")
 
     def __init__(self, ids, n_predict, sample, future, stream_put=None,
                  seed=None, prefix=None, kv_extract=None, on_prefill_kv=None,
@@ -279,6 +280,9 @@ class _PendingCompletion:
         self.tenant = None
         self.t_enqueue = 0.0
         self.t_kv_alloc = t_kv_alloc
+        # QoS priority class (resolved by the resilience middleware,
+        # captured at enqueue like tenant/span_ctx); None with QoS off
+        self.priority = None
 
 
 class LLMServer:
@@ -332,6 +336,14 @@ class LLMServer:
         # one on the default registry, a private one when a test injects
         # its own Registry — the same isolation contract as the tracer
         self.ledger = obs_accounting.for_registry(registry)
+        # multi-tenant QoS (tpustack.serving.qos): priority classes at
+        # admission + interactive-first scheduling + wave-boundary
+        # preemption + per-tenant token-bucket quotas driven by the
+        # ledger's measured charges.  None (TPUSTACK_QOS=0) keeps the
+        # whole serving path byte-for-byte QoS-free.
+        self.qos = qos_mod.QosPolicy.from_env(registry=registry)
+        if self.qos is not None:
+            self.ledger.add_listener(self.qos.on_ledger_charge)
         if generator is None:
             generator, tokenizer, model_name = _build_generator()
         self.gen = generator
@@ -421,7 +433,7 @@ class LLMServer:
         self.resilience = ResilienceManager(
             "llm", registry, concurrency=self.max_batch,
             queue_depth=lambda: len(self._queue) + self._solo_waiting,
-            expected_service_s=2.0)
+            expected_service_s=2.0, qos=self.qos)
         # engine flight recorder (tpustack.obs.flight): one structured
         # record per engine dispatch, served on /debug/flight and
         # auto-dumped on watchdog fire / SIGTERM drain / fatal engine
@@ -897,6 +909,8 @@ class LLMServer:
             req.queue_span = self.tracer.start_span("queue_wait",
                                                     parent=parent)
         req.tenant = obs_accounting.current_tenant.get()
+        req.priority = (qos_mod.current_priority.get()
+                        if self.qos is not None else None)
         req.t_enqueue = time.time()
         if self._wake is None:
             self._wake = asyncio.Event()
@@ -983,7 +997,52 @@ class LLMServer:
                            span_ctx=r.span_ctx, kv_blocks=r.kv_blocks,
                            on_prefill_blocks=r.on_prefill_blocks,
                            speculative=r.speculative, tenant=r.tenant,
-                           t_kv_alloc=r.t_kv_alloc)
+                           t_kv_alloc=r.t_kv_alloc, priority=r.priority)
+
+    # -------------------------------------------------- QoS queue helpers
+    def _pop_queued(self) -> "_PendingCompletion":
+        """(engine thread) Next queued request by priority: the first
+        interactive entry when QoS is on (FIFO within each class), else
+        strict FIFO — byte-for-byte the pre-QoS ``popleft`` with the
+        policy off.  Index-based scan, not iteration: the event loop
+        appends concurrently and deque iteration raises on mutation."""
+        if self.qos is not None:
+            try:
+                for idx in range(len(self._queue)):
+                    if self._queue[idx].priority == qos_mod.INTERACTIVE:
+                        r = self._queue[idx]
+                        del self._queue[idx]
+                        return r
+            except IndexError:
+                pass  # racing an append — fall through to FIFO
+        return self._queue.popleft()
+
+    def _interactive_waiting(self) -> bool:
+        """(engine thread) The engine's preemption hint: an interactive
+        request is waiting in the queue.  Racy by design — a stale answer
+        costs one spurious park or one wave of extra wait, never
+        correctness."""
+        if self._solo_waiting > 0:
+            # feed() refuses ALL admissions while a solo request queues
+            # on the device lock — a park now could not seat the
+            # interactive request, it would only thrash park/resume at
+            # every wave boundary until the solo run got its turn
+            return False
+        try:
+            for idx in range(len(self._queue)):
+                r = self._queue[idx]
+                if r.priority == qos_mod.INTERACTIVE and \
+                        not r.cancel.is_set():
+                    return True
+        except IndexError:
+            pass
+        return False
+
+    def _note_preempt(self, tenant) -> None:
+        """(engine thread) A batch slot was parked for an interactive
+        request — count it (the engine already wrote the flight
+        record)."""
+        self.qos.note_preempt(qos_mod.BATCH)
 
     async def _batch_loop(self):
         """Run the continuous engine whenever requests are queued: the
@@ -1010,7 +1069,15 @@ class LLMServer:
                     tracer=self.tracer, paged=self.paged,
                     spec=self.spec_cfg, on_spec=self._note_spec,
                     flight=self.flight, ledger=self.ledger,
-                    queue_depth=lambda: len(self._queue))
+                    queue_depth=lambda: len(self._queue),
+                    # QoS scheduling: the hint tells the engine an
+                    # interactive request is waiting (it then parks a
+                    # batch slot at the wave boundary); None with QoS
+                    # off keeps the engine byte-for-byte preemption-free
+                    preempt_hint=(self._interactive_waiting
+                                  if self.qos is not None else None),
+                    on_preempt=(self._note_preempt
+                                if self.qos is not None else None))
                 # work() runs on the executor thread WHILE _run_on_device
                 # holds self._lock — the guard is real, just lexically
                 # invisible to the AST walk
@@ -1024,13 +1091,17 @@ class LLMServer:
                         # — sustained batchable traffic must not starve it
                         return None
                     while self._queue:
-                        r = self._queue.popleft()
+                        r = self._pop_queued()
                         self.metrics["tpustack_llm_queue_depth"].set(
                             len(self._queue))
                         if r.t_enqueue:  # queue-seconds to the tenant,
                             # cancelled and admitted alike — both waited
+                            wait_s = time.time() - r.t_enqueue
                             self.ledger.charge_queue_seconds(
-                                "llm", r.tenant, time.time() - r.t_enqueue)
+                                "llm", r.tenant, wait_s)
+                            if self.qos is not None:
+                                self.qos.observe_queue_wait(r.priority,
+                                                            wait_s)
                         if r.cancel.is_set():
                             if r.queue_span is not None:
                                 r.queue_span.set_attribute("cancelled", True)
@@ -1772,7 +1843,7 @@ class LLMServer:
                          self.resilience.middleware(work)])
         obs_http.add_debug_trace_routes(app, self.tracer)
         obs_http.add_debug_flight_routes(app, self.flight)
-        obs_http.add_debug_tenant_routes(app, self.ledger)
+        obs_http.add_debug_tenant_routes(app, self.ledger, qos=self.qos)
         app.router.add_get("/health", self.health)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)

@@ -186,8 +186,16 @@ class ResilienceManager:
                  on_exit: Optional[Callable[[int], None]] = None,
                  env=None, fault: Optional[FaultInjector] = None,
                  observe_http: bool = True,
-                 expected_service_s: float = 1.0):
+                 expected_service_s: float = 1.0, qos=None):
         self.server = server
+        # multi-tenant QoS policy (tpustack.serving.qos.QosPolicy): when
+        # set, the middleware resolves each work request's priority class
+        # and admission becomes priority/quota-aware — quota debt sheds
+        # 429 with the tenant's own bucket-refill ETA as Retry-After, and
+        # batch sheds before interactive under queue pressure.  None
+        # (TPUSTACK_QOS=0) keeps the admission path byte-for-byte the
+        # QoS-free layer.
+        self.qos = qos
         # accept-and-poll servers (graph /prompt answers in ~1ms while the
         # work runs minutes) pass observe_http=False and feed real
         # completion times via observe_service_time themselves — otherwise
@@ -427,9 +435,17 @@ class ResilienceManager:
         if span is not None:
             span.add_event("shed", reason=reason, retry_after_s=retry_after)
 
-    def admission_check(self):
-        """None to admit, or a ready 503 (draining) / 429 (backpressure)
-        ``web.Response`` carrying ``Retry-After``."""
+    def admission_check(self, priority: Optional[str] = None,
+                        tenant: Optional[str] = None):
+        """None to admit, or a ready 503 (draining) / 429 (quota or
+        backpressure) ``web.Response`` carrying ``Retry-After``.
+
+        With a QoS policy attached, ``tenant`` is checked against its
+        token buckets (a tenant in debt gets 429 with its OWN bucket's
+        refill ETA — not the global p50×depth heuristic, which says
+        nothing about when THIS tenant's quota clears) and ``priority``
+        picks the backpressure wall: batch sheds at ``batch_shed_ratio``
+        of the configured depth, interactive at the full depth."""
         from aiohttp import web
 
         if self.draining:
@@ -440,9 +456,33 @@ class ResilienceManager:
             return web.json_response(
                 {"error": "server draining (shutting down)"}, status=503,
                 headers={"Retry-After": str(ra)})
-        if self.max_queue_depth and self.queue_depth() >= self.max_queue_depth:
+        if self.qos is not None and tenant is not None:
+            eta = self.qos.quota_check(tenant)
+            if eta is not None:
+                self.metrics["tpustack_requests_shed_total"].labels(
+                    server=self.server, reason="quota").inc()
+                self.qos.note_quota_throttle(self.server, priority)
+                ra = max(1, math.ceil(eta))
+                self.metrics["tpustack_retry_after_seconds"].labels(
+                    server=self.server).set(ra)
+                self._shed_event("quota", ra)
+                return web.json_response(
+                    {"error": f"tenant {tenant!r} over quota",
+                     "reason": "quota"}, status=429,
+                    headers={"Retry-After": str(ra),
+                             "X-Shed-Reason": "quota"})
+        depth_limit = self.max_queue_depth
+        if (depth_limit and self.qos is not None
+                and priority == "batch"):
+            # SLO-aware shedding: batch hits the wall earlier, so under
+            # saturation the 429s land on batch while interactive still
+            # has queue headroom
+            depth_limit = self.qos.batch_shed_depth(self.max_queue_depth)
+        if depth_limit and self.queue_depth() >= depth_limit:
             self.metrics["tpustack_requests_shed_total"].labels(
                 server=self.server, reason="backpressure").inc()
+            if self.qos is not None:
+                self.qos.note_shed(self.server, priority)
             ra = self.retry_after_s()
             self._shed_event("backpressure", ra)
             return web.json_response(
@@ -463,21 +503,51 @@ class ResilienceManager:
         async def resilience_middleware(request, handler):
             if request.method != "POST" or request.path not in work_paths:
                 return await handler(request)
-            shed = self.admission_check()
-            if shed is not None:
-                return shed
-            self.beat()  # arriving work arms the watchdog from "now"
-            with self._lock:
-                self._inflight += 1
-            t0 = time.perf_counter()
+            prio_token = None
+            if self.qos is not None:
+                # priority class, resolved ONCE per request: X-Priority
+                # header > body `priority` field (the obs middleware's
+                # cached parse) > tenant default in the policy.  Carried
+                # like the tenant: request key + contextvar in handler
+                # context, explicit fields across thread boundaries.
+                from tpustack.serving import qos as qos_mod
+
+                body = request.get("json_body")
+                priority = self.qos.resolve_priority(
+                    request.headers.get("X-Priority"),
+                    body.get("priority") if isinstance(body, dict) else None,
+                    request.get("tenant"))
+                request["priority"] = priority
+                prio_token = qos_mod.current_priority.set(priority)
+                from tpustack.obs import trace as obs_trace
+
+                span = obs_trace.current_span.get()
+                if span is not None:
+                    span.set_attribute("priority", priority)
+            else:
+                priority = None
             try:
-                resp = await handler(request)
-                if resp.status < 400 and self._observe_http:
-                    self.observe_service_time(time.perf_counter() - t0)
-                return resp
-            finally:
+                shed = self.admission_check(priority=priority,
+                                            tenant=request.get("tenant"))
+                if shed is not None:
+                    return shed
+                self.beat()  # arriving work arms the watchdog from "now"
                 with self._lock:
-                    self._inflight -= 1
+                    self._inflight += 1
+                t0 = time.perf_counter()
+                try:
+                    resp = await handler(request)
+                    if resp.status < 400 and self._observe_http:
+                        self.observe_service_time(time.perf_counter() - t0)
+                    return resp
+                finally:
+                    with self._lock:
+                        self._inflight -= 1
+            finally:
+                if prio_token is not None:
+                    from tpustack.serving import qos as qos_mod
+
+                    qos_mod.current_priority.reset(prio_token)
 
         return resilience_middleware
 

@@ -118,6 +118,15 @@ class SDServer:
         # tenant cost ledger: process-wide on the default registry, private
         # per injected test Registry (the tracer's isolation contract)
         self.ledger = obs_accounting.for_registry(registry)
+        # multi-tenant QoS (tpustack.serving.qos): priority resolution +
+        # quota/priority-aware admission via the resilience middleware;
+        # measured ledger charges drive the quota buckets.  None
+        # (TPUSTACK_QOS=0) keeps admission byte-for-byte QoS-free.
+        from tpustack.serving import qos as qos_mod
+
+        self.qos = qos_mod.QosPolicy.from_env(registry=registry)
+        if self.qos is not None:
+            self.ledger.add_listener(self.qos.on_ledger_charge)
         if pipeline is None:
             pipeline = self._pipeline_from_env()
         self.pipe = pipeline
@@ -171,7 +180,8 @@ class SDServer:
         # it is dispatched, so group size alone under-counts waiting work
         self.resilience = ResilienceManager("sd", registry,
                                             concurrency=self.max_batch,
-                                            expected_service_s=5.0)
+                                            expected_service_s=5.0,
+                                            qos=self.qos)
         # mesh-shape gauges: operators confirm a google.com/tpu: N pod is
         # actually fanning batches out dp-ways (SD15_DP) from /metrics
         from tpustack.parallel.sharding import export_mesh_axis_gauges
@@ -613,7 +623,7 @@ class SDServer:
                          self.resilience.middleware(work)])
         obs_http.add_debug_trace_routes(app, self.tracer)
         obs_http.add_debug_flight_routes(app, self.flight)
-        obs_http.add_debug_tenant_routes(app, self.ledger)
+        obs_http.add_debug_tenant_routes(app, self.ledger, qos=self.qos)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)
         app.router.add_get("/", self.index)

@@ -224,6 +224,18 @@ _declare("TPUSTACK_TENANT_DEFAULT", str, "anonymous",
 _declare("TPUSTACK_REPLAY_URL", str, "",
          "Default target URL for tools/replay.py (the in-cluster replay "
          "Job sets it); empty = the tool's --url default.")
+
+# --------------------------------------------------------------------- QoS
+_declare("TPUSTACK_QOS", bool, True,
+         "Multi-tenant QoS layer (tpustack.serving.qos): priority classes "
+         "at admission/scheduling, per-tenant token-bucket quotas, and "
+         "SLO-aware shedding; 0 is the bisection flag — the admission "
+         "path and engine outputs are byte-for-byte the QoS-free stack.")
+_declare("TPUSTACK_QOS_POLICY", str, "",
+         "QoS policy: inline JSON (starts with '{') or a path to a JSON "
+         "file — per-tenant priority defaults and token-bucket quotas "
+         "(docs/QOS.md documents the schema); empty = priorities only, "
+         "no quotas.")
 _declare("TPUSTACK_BENCH_BASELINES", str, "",
          "Committed perf-baseline store read by tools/perf_gate.py and "
          "exported as tpustack_bench_baseline_* gauges at server start; "
