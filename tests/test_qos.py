@@ -80,6 +80,26 @@ def test_token_bucket_refill_debt_and_eta():
         TokenBucket(rate_per_s=0.0, burst=1.0)
 
 
+def test_queue_wait_recorded_per_server_and_priority():
+    """tpustack_qos_queue_wait_seconds carries a server label (PR 14's
+    llm-only follow-up): llm records at the engine-queue pop, sd at the
+    micro-batch build, graph at the worker pickup — all through ONE
+    observe_queue_wait, with None priority falling to the policy
+    default."""
+    reg = Registry()
+    p = QosPolicy({"default_priority": "interactive"}, registry=reg)
+    p.observe_queue_wait("llm", "interactive", 0.25)
+    p.observe_queue_wait("sd", "batch", 1.5)
+    p.observe_queue_wait("graph", None, 0.1)  # → default priority
+    wait_lines = [ln for ln in reg.render().splitlines()
+                  if ln.startswith("tpustack_qos_queue_wait_seconds")]
+    for labels in ('server="llm",priority="interactive"',
+                   'server="sd",priority="batch"',
+                   'server="graph",priority="interactive"'):
+        # label order in the exposition follows the catalog declaration
+        assert any(labels in ln for ln in wait_lines), (labels, wait_lines)
+
+
 # ------------------------------------------------------------------ policy
 def test_policy_parse_and_priority_resolution():
     p = QosPolicy({

@@ -24,6 +24,115 @@ import statistics
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _paged_mode(args) -> int:
+    """``--paged``: gather-vs-in-place paged decode attention.
+
+    Two implementations of the same math — gather every table-mapped pool
+    block into a dense ``[B, max_seq]`` view then run the masked XLA
+    partial (what ``_pool_gather_body`` + ``dot_product_attention_partial``
+    do per chunk), vs the scalar-prefetch Pallas kernel reading the pool
+    blocks IN PLACE (``paged_attention_partial``).  Asserts the outputs
+    agree and that the in-place path moves STRICTLY fewer HBM bytes per
+    decode step (``paged_bytes_accounting`` — the same arithmetic
+    ``bench_llm --paged`` embeds in its roofline block); on CPU this runs
+    the kernel in interpret mode, so timing is only reported on real TPU
+    backends (interpret wall clock proves nothing)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpustack.ops.attention import dot_product_attention_partial
+    from tpustack.ops.pallas.flash_attention import (paged_attention_partial,
+                                                     paged_bytes_accounting)
+
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)
+    on_tpu = jax.default_backend() == "tpu"
+    if args.tiny or not on_tpu:
+        # the CPU smoke shape (the tier-1 suite shells this): interpret-
+        # mode kernel over a scrambled table, ragged lengths, GQA
+        b, s, h, hkv, d, blk, nb = 4, 1, 4, 2, 16, 8, 8
+        n_steps = 8
+    else:
+        # Qwen-7B serving decode: 8 slots, GQA 28q/4kv, 64-token blocks
+        # over a 2048-token table span
+        b, s, h, hkv, d, blk, nb = 8, 1, 28, 4, 128, 64, 32
+        n_steps = 16
+    max_seq = blk * nb
+    n_pool = b * nb + 1  # every slot fully backed + reserved block 0
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    q = jnp.asarray(rng.randn(b, s, h, d), dt)
+    pool_k = jnp.asarray(rng.randn(n_pool, blk, hkv, d), dt)
+    pool_v = jnp.asarray(rng.randn(n_pool, blk, hkv, d), dt)
+    # scrambled tables: valid prefix blocks are real allocations, the idle
+    # tail points at the reserved block 0 (whose garbage must never leak)
+    lens = np.asarray([max_seq * (i + 1) // b for i in range(b)], np.int32)
+    lens[0] = 3  # one ragged mid-block row
+    bt = np.zeros((b, nb), np.int32)
+    alloc = rng.permutation(np.arange(1, n_pool))
+    pos = 0
+    for i in range(b):
+        valid = -(-int(lens[i]) // blk)
+        bt[i, :valid] = alloc[pos:pos + valid]
+        pos += valid
+    bt, lens = jnp.asarray(bt), jnp.asarray(lens)
+
+    def gather_partial(qq):
+        def ga(x):
+            g = jnp.take(x, bt.reshape(-1), axis=0)
+            return g.reshape((b, nb * x.shape[1]) + x.shape[2:])
+        mask = jnp.arange(max_seq)[None, None, :] < lens[:, None, None]
+        return dot_product_attention_partial(
+            qq, ga(pool_k), ga(pool_v),
+            mask=jnp.broadcast_to(mask, (b, s, max_seq)))
+
+    inplace_partial = lambda qq: paged_attention_partial(
+        qq, pool_k, pool_v, bt, lens)
+
+    ref = jax.jit(gather_partial)(q)
+    got = jax.jit(inplace_partial)(q)
+    ok = all(np.allclose(np.asarray(x), np.asarray(y), rtol=2e-2, atol=2e-2)
+             for x, y in zip(got, ref))
+    log(f"[bench_flash] paged in-place vs gather allclose: {ok}")
+
+    esize = jnp.dtype(dt).itemsize
+    mean_valid = float(np.mean([-(-int(x) // blk) for x in np.asarray(lens)]))
+    bytes_acct = paged_bytes_accounting(
+        n_valid_blocks=int(round(mean_valid)), blocks_per_seq=nb, block=blk,
+        kvh=hkv, hd=d, esize=esize, scale_bytes=0, n_steps=n_steps)
+    fewer = (bytes_acct["paged_flash_step_bytes"]
+             < bytes_acct["gather_step_bytes"])
+    log(f"[bench_flash] per-step bytes (mean slot): gather "
+        f"{bytes_acct['gather_step_bytes']:.0f} vs in-place "
+        f"{bytes_acct['paged_flash_step_bytes']:.0f} (fewer={fewer})")
+
+    timing = None
+    if on_tpu:
+        from tpustack.utils.benchmark import pipelined_intervals
+
+        for name, fn in (("gather", jax.jit(gather_partial)),
+                         ("inplace", jax.jit(inplace_partial))):
+            np.asarray(fn(q)[0])  # compile
+            times = pipelined_intervals(lambda seed: fn(q)[0],
+                                        repeats=args.repeats,
+                                        warmup_min=1, warmup_max=4,
+                                        unit="call")
+            med = statistics.median(times)
+            timing = dict(timing or {}, **{f"{name}_ms": round(med * 1e3, 3)})
+            log(f"[bench_flash] paged {name}: {med * 1e3:.3f} ms")
+
+    print(json.dumps({
+        "shape": "paged", "batch": b, "heads": h, "kv_heads": hkv,
+        "head_dim": d, "block": blk, "blocks_per_seq": nb,
+        "interpret": not on_tpu, "outputs_allclose": bool(ok),
+        "bytes_per_step": {k: round(v, 1) for k, v in bytes_acct.items()},
+        "inplace_moves_fewer_bytes": bool(fewer), "timing": timing,
+    }))
+    # both properties gate: a wrong kernel or a bytes model that stopped
+    # favoring in-place fails the smoke (tier-1 shells this)
+    return 0 if (ok and fewer) else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--shape", default="wan",
@@ -33,7 +142,16 @@ def main() -> int:
     p.add_argument("--block-k", type=int, nargs="*", default=[512, 1024])
     p.add_argument("--panel", action="store_true",
                    help="also try the panel kernel (raise panel_max_kv)")
+    p.add_argument("--paged", action="store_true",
+                   help="paged decode attention microbench: gather the "
+                        "block table into a dense view vs the in-place "
+                        "scalar-prefetch kernel (correctness + per-step "
+                        "bytes always; timing on real TPU only)")
+    p.add_argument("--tiny", action="store_true",
+                   help="paged mode: force the CPU smoke shape")
     args = p.parse_args()
+    if args.paged:
+        return _paged_mode(args)
 
     import jax
     import jax.numpy as jnp

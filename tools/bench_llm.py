@@ -194,6 +194,14 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
             footprints = [ctx // 4, ctx // 2, ctx]
     footprints = sorted({min(max(f, 8), ctx) for f in footprints})
 
+    # which decode-attention body the paged engines run (the gather copy
+    # vs the in-place scalar-prefetch kernel) — forced by --paged-flash,
+    # knob-resolved otherwise — plus the exact per-path dispatch split
+    # for the perf signature (gather dispatches MUST read zero when the
+    # kernel is active: that is the "the copy never ran" counter)
+    flash_force = True if args.paged_flash else None
+    kern = {"tag": None, "gather": 0, "flash": 0}
+
     def run_fleet(engine, reqs, pool=None):
         results = {}
         peak = {"batch": 0, "used": 0}
@@ -223,6 +231,10 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
             return queue.pop(0)
 
         stats = engine.run(feed)
+        if engine.paged is not None:
+            kern["tag"] = stats.get("decode_kernel")
+            kern["gather"] += stats.get("kernel_gather_dispatches", 0)
+            kern["flash"] += stats.get("kernel_paged_flash_dispatches", 0)
         ttfts = sorted(st["prefill_s"] for _, st in results.values())
         q = lambda p: ttfts[min(len(ttfts) - 1,
                                 int(round(p * (len(ttfts) - 1))))]
@@ -265,7 +277,8 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
             pool, ctx)
         paged_eng = lambda: ContinuousEngine(gen, slots=paged_slots,
                                              chunk=min(args.chunk, new),
-                                             paged=rt)
+                                             paged=rt,
+                                             paged_flash=flash_force)
         run_fleet(paged_eng(), warm, pool=pool)
         free0 = pool.n_free
         paged_res, paged = run_fleet(paged_eng(), reqs, pool=pool)
@@ -298,9 +311,38 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
 
     sig_extra.update({"kv_pool.block_tokens": block,
                       "kv_pool.pool_blocks": capacity,
+                      "kernel.gather_dispatches": kern["gather"],
+                      "kernel.paged_flash_dispatches": kern["flash"],
                       "outputs_identical": identical,
                       "leak_check_ok": leak_ok})
     sig = perfsig.signature(watch=watch, extra=sig_extra)
+    # roofline block: what ONE decode step actually moves for the mid
+    # footprint's KV reads, gather vs in-place — the same accounting the
+    # bench_flash --paged microbench asserts on (shared helper, so bench
+    # and microbench can never disagree)
+    from tpustack.ops.pallas.flash_attention import paged_bytes_accounting
+
+    import jax.numpy as _jnp
+
+    kv_int8 = cfg.kv_quant == "int8"
+    esize = 1 if kv_int8 else _jnp.dtype(gen.cache_dtype).itemsize
+    bytes_acct = paged_bytes_accounting(
+        n_valid_blocks=-(-mid["req_ctx"] // block),
+        blocks_per_seq=ctx // block, block=block, kvh=cfg.n_kv_heads,
+        hd=cfg.head_dim, esize=esize, scale_bytes=8 if kv_int8 else 0,
+        n_steps=min(args.chunk, max(4, mid["req_ctx"] // 8)))
+    roofline = {
+        "kernel": kern["tag"],
+        "per_slot_layer_step_bytes": {
+            k: round(v, 1) for k, v in bytes_acct.items()
+            if k.endswith("step_bytes")},
+        "kv_step_bytes_saved_pct": round(
+            100 * (1 - bytes_acct["paged_flash_step_bytes"]
+                   / bytes_acct["gather_step_bytes"]), 1),
+    }
+    log(f"[bench_llm] paged roofline: kernel={kern['tag']} per-slot/layer "
+        f"step bytes gather {bytes_acct['gather_step_bytes']:.0f} vs "
+        f"in-place {bytes_acct['paged_flash_step_bytes']:.0f}")
     return _emit({
         "metric": f"{args.preset}_{args.quant or 'bf16'}_ctx{args.ctx}"
                   f"_paged_admitted_concurrency",
@@ -310,6 +352,8 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
         "block_tokens": block,
         "pool_blocks": capacity,
         "mid_req_ctx": mid["req_ctx"],
+        "kernel": kern["tag"],
+        "roofline": roofline,
         "sweep": sweep,
         "outputs_identical": identical,
         "leak_check_ok": leak_ok,
@@ -626,6 +670,12 @@ def main() -> int:
                         "pool utilization paged vs dense per --req-ctx "
                         "footprint (greedy outputs asserted identical, "
                         "free-block leak check)")
+    p.add_argument("--paged-flash", action="store_true",
+                   help="paged mode: FORCE the in-place paged-flash "
+                        "decode kernel on the paged engines (interpret "
+                        "mode on CPU — the perf-gate scenario pins the "
+                        "gather copy counter at zero); default resolves "
+                        "TPUSTACK_PAGED_FLASH (auto: TPU on, CPU off)")
     p.add_argument("--tiny", action="store_true",
                    help="paged-mode CPU smoke shape: --preset tiny with "
                         "scaled footprints (the tier-1 suite shells this)")

@@ -33,6 +33,9 @@ LLM_EXTRA_KEEP = (
     "prefill_tokens_per_sec", "roofline_pct", "prefill_roofline_pct",
     "cache_on", "cache_off", "ttft_p50_speedup", "outputs_identical",
     "dense_slot_cap", "sweep", "leak_check_ok",
+    # paged mode: which decode-attention body served the sweep (gather vs
+    # the in-place paged-flash kernel) + the per-step KV bytes both ways
+    "kernel", "roofline",
     "acceptance_rate", "tokens_per_weight_pass_on",
     "tokens_per_weight_pass_off", "speedup_batch1",
     "tp_ways", "weights_per_chip_bytes", "kv_per_chip_bytes",

@@ -81,6 +81,11 @@ TINY_SCENARIOS = (
               "cache_off.ttft_p50_ms": "lower"}),
     Scenario("llm_paged_tiny", "tools/bench_llm.py",
              ("--tiny", "--paged", "--requests", "4"), {}),
+    # the in-place paged-flash kernel forced on (interpret mode on CPU):
+    # the committed baseline pins kernel.gather_dispatches at ZERO — the
+    # gather copy silently coming back is an exact-counter regression
+    Scenario("llm_paged_flash_tiny", "tools/bench_llm.py",
+             ("--tiny", "--paged", "--paged-flash", "--requests", "4"), {}),
     Scenario("llm_spec_tiny", "tools/bench_llm.py",
              ("--tiny", "--speculative"), {"value": "higher"}),
     Scenario("sd_small", "bench.py",

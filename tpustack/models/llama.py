@@ -163,7 +163,14 @@ class LlamaAttention(nn.Module):
             # in-segment causal generalisation of the single-token mask,
             # which it collapses to exactly at s == 1.
             cur0, t = cache_index      # [B] slot frontiers, scalar chunk step
-            quantized = "k_scale" in kv_cache
+            # the frozen main-cache view is either a dense per-slot line
+            # (k/v keys) or the paged-flash IN-PLACE pool view (pk/pv +
+            # block table bt, TPUSTACK_PAGED_FLASH): same key set, same
+            # masking semantics, different storage — see the partial
+            # branch below
+            paged_flash = "pk" in kv_cache
+            quantized = ("k_scale" in kv_cache
+                         or "pk_scale" in kv_cache)
             cbuf_len = kv_cache["ck"].shape[1]
             if quantized:
                 # quantise at write — the buffer holds the SAME int8 values
@@ -192,8 +199,6 @@ class LlamaAttention(nn.Module):
             from tpustack.ops.attention import (dot_product_attention_partial,
                                                 merge_attention_partials)
 
-            main_mask = (jnp.arange(kv_cache["k"].shape[1])[None, None, :]
-                         < cur0[:, None, None])          # [B, 1, S]
             if s == 1:
                 buf_mask = jnp.broadcast_to(
                     jnp.arange(cbuf_len)[None, None, :] <= t,
@@ -203,10 +208,29 @@ class LlamaAttention(nn.Module):
                 buf_mask = jnp.broadcast_to(
                     jnp.arange(cbuf_len)[None, None, :]
                     <= (t + jnp.arange(s))[None, :, None], (b, s, cbuf_len))
-            part_main = dot_product_attention_partial(
-                q, kv_cache["k"], kv_cache["v"], mask=main_mask,
-                k_scale=kv_cache.get("k_scale"),
-                v_scale=kv_cache.get("v_scale"))
+            if paged_flash:
+                # read the KV pool blocks IN PLACE through the slot block
+                # tables (scalar-prefetch Pallas kernel, per-row `cur0`
+                # masking + int8 dequant in-kernel) — no dense [B, max_seq]
+                # gather copy; every query row of a multi-query verify
+                # attends the same [0, cur0) pool prefix, so ONE kernel
+                # pass covers the whole segment and the in-segment causal
+                # half stays in the buffer partial below
+                from tpustack.ops.pallas.flash_attention import (
+                    paged_attention_partial)
+
+                part_main = paged_attention_partial(
+                    q, kv_cache["pk"], kv_cache["pv"], kv_cache["bt"],
+                    cur0, k_scale=kv_cache.get("pk_scale"),
+                    v_scale=kv_cache.get("pv_scale"))
+            else:
+                main_mask = (jnp.arange(kv_cache["k"].shape[1])
+                             [None, None, :]
+                             < cur0[:, None, None])      # [B, 1, S]
+                part_main = dot_product_attention_partial(
+                    q, kv_cache["k"], kv_cache["v"], mask=main_mask,
+                    k_scale=kv_cache.get("k_scale"),
+                    v_scale=kv_cache.get("v_scale"))
             part_buf = dot_product_attention_partial(
                 q, new_cache["ck"], new_cache["cv"], mask=buf_mask,
                 k_scale=new_cache.get("ck_scale"),

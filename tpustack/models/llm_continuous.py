@@ -241,7 +241,8 @@ class ContinuousEngine:
     def __init__(self, gen: Generator, slots: int = 8, chunk: int = 32,
                  stop_tokens: Tuple[int, ...] = (), depth: int = 2,
                  on_progress: Optional[Callable[[str], None]] = None,
-                 tracer=None, paged=None, spec=None, on_spec=None,
+                 tracer=None, paged=None, paged_flash: Optional[bool] = None,
+                 spec=None, on_spec=None,
                  compile_budgets: Optional[Dict[str, int]] = None,
                  flight=None, queue_depth: Optional[Callable[[], int]] = None,
                  ledger=None,
@@ -294,6 +295,25 @@ class ContinuousEngine:
                 raise ValueError(
                     f"paged runtime max_seq {paged.max_seq} != engine "
                     f"config {gen.cfg.max_seq}")
+        # paged-flash (TPUSTACK_PAGED_FLASH): read pool blocks IN PLACE
+        # via the scalar-prefetch Pallas kernel instead of gathering a
+        # dense per-slot copy every chunk — the static `flash` flag on
+        # the SAME _decode_scan_paged/_spec_verify_paged entry points, so
+        # QoS preemption warm-starts, the prefix trie, and the tp-sharded
+        # pool all ride it unchanged.  None resolves the knob ('auto' =
+        # on for real TPU kinds, off on CPU/interpret and under a mesh);
+        # False is byte-for-byte the gather engine.
+        if paged_flash is None:
+            from tpustack.models.llm_generate import resolve_paged_flash
+
+            paged_flash = paged is not None and resolve_paged_flash(
+                mesh=gen.mesh)
+        self.paged_flash = bool(paged_flash) and paged is not None
+        # per-run kernel-dispatch split (perfsig signature counters: the
+        # gather path's copy count must read ZERO when the kernel is
+        # active — the perf gate's paged-flash scenario pins it)
+        self._gather_dispatches = 0
+        self._flash_dispatches = 0
         self._bt = None  # paged: host block tables [B, blocks_per_seq]
         self._slots_view = None  # live slots during run() (release hints)
         # distributed tracing (tpustack.obs.trace.Tracer): per-request
@@ -372,6 +392,11 @@ class ContinuousEngine:
             # still blow any constant budget, which is what the check is
             # for.
             default_budget = 2 if gen.mesh is None else 6
+            # _decode_scan_paged/_spec_verify_paged carry BOTH bodies
+            # behind the static `flash` flag (gather vs in-place paged-
+            # flash kernel); one engine uses exactly one flag value, so
+            # the per-engine growth budget is unchanged — a flash engine
+            # that silently retraced its kernel program still gates here
             for name in ("_decode_scan_cont", "_decode_scan_paged",
                          "_spec_verify_cont", "_spec_verify_paged"):
                 watch.watch(name, cls.__dict__.get(name),
@@ -1226,6 +1251,18 @@ class ContinuousEngine:
             "tokens_per_weight_pass": decoded / passes if passes else 0.0,
             "preempted": self._preempted,
         })
+        if self.paged is not None:
+            # which decode-attention body served this run, plus the exact
+            # dispatch split — `kernel_gather_dispatches` at ZERO is the
+            # "the gather copy never ran" signature counter the paged-
+            # flash perf-gate scenario pins (dense engines omit all three:
+            # their signature keys must not change under the flag)
+            stats.update({
+                "decode_kernel": ("paged_flash" if self.paged_flash
+                                  else "gather"),
+                "kernel_gather_dispatches": self._gather_dispatches,
+                "kernel_paged_flash_dispatches": self._flash_dispatches,
+            })
         if self.spec is not None:
             stats.update({
                 "spec_drafted_tokens": self._spec_drafted,
@@ -1252,7 +1289,11 @@ class ContinuousEngine:
                     state["active"], state["pool"],
                     jnp.asarray(self._bt), state["keys"],
                     state["temp"], state["topk"], state["greedy"],
-                    self.chunk)
+                    self.chunk, flash=self.paged_flash)
+                if self.paged_flash:
+                    self._flash_dispatches += 1
+                else:
+                    self._gather_dispatches += 1
             else:
                 (toks, last, state["cur"], state["caches"],
                  state["keys"]) = g._decode_scan_cont(
@@ -1343,6 +1384,7 @@ class ContinuousEngine:
             rec["kv_free"] = free
             rec["kv_used"] = used
             rec["kv_fragmentation"] = round(frag, 4)
+            rec["kernel"] = "paged_flash" if self.paged_flash else "gather"
         # per-wave tenant occupancy ({tenant: slots served}): the split
         # key for the chip-seconds attribution — recorded IN the flight
         # record and charged FROM it, so /debug/flight and the tenant
@@ -1545,7 +1587,12 @@ class ContinuousEngine:
                 g.params, state["first"], jnp.asarray(draft),
                 jnp.asarray(dlen), state["cur"], state["active"],
                 state["pool"], jnp.asarray(self._bt), state["keys"],
-                state["temp"], state["topk"], state["greedy"], K)
+                state["temp"], state["topk"], state["greedy"], K,
+                flash=self.paged_flash)
+            if self.paged_flash:
+                self._flash_dispatches += 1
+            else:
+                self._gather_dispatches += 1
         else:
             (toks_dev, n_acc, last, state["cur"], state["caches"],
              state["keys"]) = g._spec_verify_cont(

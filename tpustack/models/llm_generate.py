@@ -40,6 +40,34 @@ class SampleConfig:
     greedy: bool = False
 
 
+def resolve_paged_flash(env=None, mesh=None) -> bool:
+    """The ``TPUSTACK_PAGED_FLASH`` verdict for a paged engine: read the
+    KV pool blocks in place via the scalar-prefetch Pallas kernel
+    (``ops.pallas.flash_attention.paged_attention_partial``) instead of
+    gathering a dense per-slot copy every chunk.
+
+    ``auto`` (the default) turns the kernel on for real TPU backends and
+    off on CPU/interpret (where the gather path's XLA ops are faster than
+    an interpreted kernel grid) — tests force it on explicitly.  Under a
+    tp mesh ``auto`` stays on the gather path too: the kernel's GSPMD
+    partition over the head-axis-sharded pool is compile-verified in
+    interpret mode (the kernel grid walks kv heads, so the shard split is
+    natural) but not yet measured on multi-chip hardware; forcing ``1``
+    overrides.  ``0`` is the bisection flag — byte-for-byte the gather
+    engine."""
+    from tpustack.utils import knobs
+
+    val = knobs.get_str("TPUSTACK_PAGED_FLASH", env=env).strip().lower()
+    if val in ("", "auto"):
+        return jax.default_backend() == "tpu" and mesh is None
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(f"TPUSTACK_PAGED_FLASH={val!r} is not auto or a "
+                     "boolean (want auto, 1/true/yes/on or 0/false/no/off)")
+
+
 def _advance_keys(keys):
     """Advance per-row PRNG chains ``[B, 2]`` one step: returns
     ``(step_keys [B, 2], next_keys [B, 2])``.  Row i's chain is seeded at
@@ -777,6 +805,25 @@ class Generator:
         return [{k: ga(v) for k, v in layer.items()} for layer in pool]
 
     @staticmethod
+    def _pool_views(pool, bt):
+        """Per-layer IN-PLACE pool views for the paged-flash attention
+        branch (``TPUSTACK_PAGED_FLASH``): the pool tensors ride into the
+        attention dict unchanged under ``pk``/``pv`` (+ scales) keys next
+        to the block table, and ``LlamaAttention`` reads them in place
+        through the scalar-prefetch Pallas kernel — the zero-copy
+        replacement for ``_pool_gather_body``'s dense ``[B, max_seq]``
+        materialisation (and the whole point of the paged-flash path:
+        the gather's read+write copy never happens)."""
+        def view(layer):
+            v = {"pk": layer["k"], "pv": layer["v"], "bt": bt}
+            if "k_scale" in layer:
+                v["pk_scale"] = layer["k_scale"]
+                v["pv_scale"] = layer["v_scale"]
+            return v
+
+        return [view(layer) for layer in pool]
+
+    @staticmethod
     def _pool_scatter_body(pool, bt_rows, src_layers, keymap, positions,
                            valid):
         """Traced: scatter per-row values at global cache ``positions
@@ -844,16 +891,31 @@ class Generator:
         uses it to build row caches for the flash-chunk prefill loop."""
         return self._pool_gather_body(pool, bt_rows)
 
-    @functools.partial(jax.jit, static_argnums=(0, 11), donate_argnums=(5,))
+    @functools.partial(jax.jit, static_argnums=(0, 11),
+                       static_argnames=("flash",), donate_argnums=(5,))
     def _decode_scan_paged(self, params, first_tok, cur, active, pool, bt,
-                           keys, temperature, top_k, greedy, n_steps: int):
-        """Paged twin of ``_decode_scan_cont``: gather the frozen chunk
-        view from the pool, run the IDENTICAL scan body, scatter the chunk
+                           keys, temperature, top_k, greedy, n_steps: int,
+                           flash: bool = False):
+        """Paged twin of ``_decode_scan_cont``: present the frozen chunk
+        view of the pool, run the IDENTICAL scan body, scatter the chunk
         buffers back through the block tables at ``[cur0, cur_end)``.
         Only the new tokens' K/V move pool-ward — shared prefix blocks are
-        read, never rewritten."""
+        read, never rewritten.
+
+        ``flash`` (static; the engine passes its knob-resolved
+        ``TPUSTACK_PAGED_FLASH`` verdict) picks HOW the frozen view is
+        read: False gathers a dense ``[B, max_seq]`` copy per chunk
+        (``_pool_gather_body`` — the bisection path), True hands the pool
+        tensors + block tables straight to the attention layer, which
+        reads the blocks IN PLACE via the scalar-prefetch Pallas kernel
+        (``paged_attention_partial``) — no gather copy, no dense
+        intermediate, per-row ``cur`` masking and int8 dequant inside the
+        kernel.  Same traced scan body either way, so greedy outputs are
+        token-identical across the flag."""
+        view = (self._pool_views(pool, bt) if flash
+                else self._pool_gather_body(pool, bt))
         toks, last, cur_end, bufs, keys = self._decode_cont_body(
-            params, first_tok, cur, active, self._pool_gather_body(pool, bt),
+            params, first_tok, cur, active, view,
             keys, temperature, top_k, greedy, n_steps)
         B = bt.shape[0]
         positions = cur[:, None] + jnp.arange(n_steps)[None, :]
@@ -1006,18 +1068,29 @@ class Generator:
                                         n_draft + 1)
         return toks, n_acc, last, cur_end, caches, keys
 
-    @functools.partial(jax.jit, static_argnums=(0, 13), donate_argnums=(7,))
+    @functools.partial(jax.jit, static_argnums=(0, 13),
+                       static_argnames=("flash",), donate_argnums=(7,))
     def _spec_verify_paged(self, params, first_tok, draft, draft_len, cur,
                            active, pool, bt, keys, temperature, top_k,
-                           greedy, n_draft: int):
-        """Paged twin of ``_spec_verify_cont``: gather the frozen view from
+                           greedy, n_draft: int, flash: bool = False):
+        """Paged twin of ``_spec_verify_cont``: present the frozen view of
         the block pool, run the IDENTICAL verify body, scatter ONLY the
         accepted positions back through the block tables — so shared
         prefix blocks are read but never rewritten, and block accounting
-        stays capacity-true (no rejected-draft KV ever lands)."""
+        stays capacity-true (no rejected-draft KV ever lands).
+
+        ``flash=True`` is the FUSED verify: the K+1 query positions go
+        through ONE in-place pass over the pool blocks (the multi-query
+        rows of the same scalar-prefetch kernel; the in-segment causal
+        half rides the chunk-buffer partial) instead of gather + attention
+        — a verify step then costs one read of the KV working set, which
+        is the whole speculative-bandwidth argument.  See
+        ``_decode_scan_paged`` for the flag's contract."""
+        view = (self._pool_views(pool, bt) if flash
+                else self._pool_gather_body(pool, bt))
         toks, n_acc, last, cur_end, bufs, keys = self._spec_verify_parts(
             params, first_tok, draft, draft_len, cur, active,
-            self._pool_gather_body(pool, bt), keys, temperature, top_k,
+            view, keys, temperature, top_k,
             greedy, n_draft)
         S = n_draft + 1
         positions = cur[:, None] + jnp.arange(S)[None, :]

@@ -240,10 +240,12 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "recordings slo-rules.yaml alerts on (interactive only).",
                ("server", "priority", "outcome"), unit="total"),
     MetricSpec("tpustack_qos_queue_wait_seconds", "histogram",
-               "Admission-queue wall time by priority class (llm engine "
-               "queue: enqueue to slot pickup) — the latency the "
-               "interactive-first dequeue and wave-boundary preemption "
-               "exist to bound.", ("priority",), unit="seconds"),
+               "Admission-queue wall time by priority class: llm engine "
+               "queue (enqueue to slot pickup), sd micro-batch window "
+               "(enqueue to fused dispatch), graph worker queue (submit "
+               "to worker pickup) — the latency the interactive-first "
+               "dequeue and wave-boundary preemption exist to bound.",
+               ("server", "priority"), unit="seconds"),
     MetricSpec("tpustack_qos_bucket_level_ratio", "gauge",
                "Live token-bucket balance over burst per policy tenant "
                "and dimension (tokens|chip_seconds): 1 = full headroom, "

@@ -377,3 +377,283 @@ def _flash_attention(q, k, v, *, causal, scale, block_q, block_k, interpret,
 
     out = out[:, :sq]                                  # drop q padding
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+# --------------------------------------------------------- paged attention
+#
+# Decode attention that reads the paged KV pool IN PLACE (vLLM-style
+# PagedAttention, Kwon et al. SOSP'23): the per-slot block table is a
+# scalar-prefetch operand (pltpu.PrefetchScalarGridSpec), so each grid
+# step's BlockSpec index map looks its pool block up BEFORE the kernel
+# body runs and the pipeline DMAs that block [block, head_dim] straight
+# from the pool tensor [n_blocks, block, kvh, hd] into VMEM — no dense
+# [B, max_seq] gather copy ever materialises in HBM.  Softmax is the
+# online (m, l, acc) carry across the block grid dim, exactly like
+# _attn_kernel_stream; the result is returned as the UNNORMALISED partial
+# (acc, m, l) in dot_product_attention_partial's layout so the continuous
+# decode/verify step can merge it with the chunk-buffer partial
+# (merge_attention_partials) — the buffer carries the in-segment causal
+# half of a multi-query speculative verify, the pool partial the shared
+# [0, cur) prefix every query row attends.
+#
+# Traffic discipline for blocks past a row's `cur` frontier: their index
+# map CLAMPS to the row's last valid block, so consecutive grid steps
+# present the SAME block index and the Pallas pipeline elides the re-DMA
+# (a revisited block is not refetched) — the idle tail of a short row
+# costs one extra block fetch, not (nb - valid) fetches.  Their compute
+# is skipped outright (pl.when), and the reserved block 0 (which idle
+# table entries point at) is therefore only ever read by fully-masked
+# grid steps whose contribution is exactly zero.
+
+
+def _paged_attn_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                       acc_out, m_out, l_out, m_s, l_s, acc_s, *,
+                       scale: float, blk: int, n_b: int, quant: bool):
+    """One (batch, kv-head, pool-block) grid step of in-place paged decode
+    attention.  ``q_ref`` holds this (b, kv-head)'s query rows [R, D]
+    (R = S·group, the multi-query verify rows x GQA group, padded to >= 8
+    sublanes); ``k_ref``/``v_ref`` the table-mapped pool block.  Numerics
+    mirror ``dot_product_attention_partial`` per element: f32 logits,
+    int8 dequant via cast-to-compute + per-vector scales OUTSIDE the
+    d-contraction (``k_scale`` on the scores, ``v_scale`` on the probs
+    after the denominator), plain ``exp`` — only the summation ORDER
+    differs (per-block online carry vs one-pass), the same split the
+    chunk-boundary merge already makes."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    kv_len = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    col0 = j * blk
+
+    @pl.when(col0 < kv_len)
+    def _compute():
+        q = q_ref[0, 0]                                 # [R, D]
+        k = k_ref[0, :, 0, :]                           # [blk, D]
+        v = v_ref[0, :, 0, :]
+        if quant:
+            # int8 pool blocks: HALF the bytes cross HBM; the cast to the
+            # compute dtype happens here in VMEM (int8 values are exact in
+            # bf16 — 8 mantissa bits cover +-127)
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [R, blk]
+        if quant:
+            logits = logits * ks_ref[0, :, 0][None, :]
+        logits = logits * scale
+        col = col0 + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(col < kv_len, logits, NEG_INF)
+        m_prev = m_s[:, :1]                             # [R, 1]
+        l_prev = l_s[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(logits - m_cur)
+        p = jnp.where(logits <= NEG_INF, 0.0, p)        # masked cols: l += 0
+        l_s[...] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True), l_s.shape)
+        if quant:
+            p = p * vs_ref[0, :, 0][None, :]
+        acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[...] = jnp.broadcast_to(m_cur, m_s.shape)
+
+    @pl.when(j == n_b - 1)
+    def _finish():
+        # a row whose EVERY pool column is masked (cur == 0: fresh slot,
+        # parked slot) leaves the init carry: m = NEG_INF, l = 0, acc = 0
+        # — merge_attention_partials weights it out against the buffer
+        # partial, which always holds the freshly-written position
+        acc_out[0, 0] = acc_s[...]
+        m_out[0, 0] = m_s[:, 0]
+        l_out[0, 0] = l_s[:, 0]
+
+
+def paged_attention_partial(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+):
+    """In-place paged decode attention over key set ``[0, lengths[b])``,
+    returned as the online-softmax partial ``(acc [B,S,H,D] f32
+    unnormalised, m [B,S,H] f32, l [B,S,H] f32)`` —
+    ``dot_product_attention_partial``'s contract, so it merges with the
+    chunk-buffer partial via ``merge_attention_partials`` unchanged.
+
+    ``q [B, S, H, D]``: S = 1 for a plain decode step, K+1 for a
+    speculative multi-query verify (every row attends the same pool
+    prefix; the in-segment causal half lives in the buffer partial).
+    ``pool_k/pool_v [N, block, Hkv, D]`` are the POOL tensors — read
+    through ``block_tables [B, nb]`` in place, never gathered into a
+    dense per-row view.  ``lengths [B]``: each row's valid prefix (the
+    slot's ``cur`` frontier); idle table entries may point anywhere
+    (the reserved block 0 included) — blocks at or past ``lengths`` are
+    compute-skipped and their index map clamps to the last valid block
+    so the pipeline elides their DMA.  ``k_scale``/``v_scale``
+    ``[N, block, Hkv]``: the int8 pool's per-vector dequant scales —
+    dequant happens IN the kernel, so int8 halves the HBM bytes decode
+    actually moves.  GQA (Hkv < H) walks kv heads as a grid dim with the
+    whole q group as rows of one matmul.
+
+    VMEM per grid step: 2 pool block panels (block x D) + the q rows +
+    f32 (R x D) carry — a few hundred KB at serving shapes (docs/PERF.md
+    round 15 has the table); sequence length is bounded by HBM only.
+    """
+    b, s, h, d = q.shape
+    n_blocks, blk, hkv, dk = pool_k.shape
+    if d != dk:
+        raise ValueError(f"q head_dim {d} != pool head_dim {dk}")
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    nb = block_tables.shape[1]
+    g = h // hkv
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
+
+    # rows of the per-(b, kv-head) matmul: the S query positions x the GQA
+    # group, padded to the 8-sublane minimum (padded rows compute garbage
+    # the slice below drops)
+    rows = s * g
+    r_pad = max(8, rows)
+    qr = q.reshape(b, s, hkv, g, d).transpose(0, 2, 1, 3, 4)
+    qr = qr.reshape(b, hkv, rows, d)
+    if r_pad != rows:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, r_pad - rows), (0, 0)))
+
+    bt = block_tables.astype(jnp.int32)
+    lens = lengths.astype(jnp.int32)
+
+    def kv_map(bi, hi, j, bt_ref, len_ref):
+        # clamp past-the-frontier grid steps to the row's LAST valid block:
+        # consecutive identical indices → the pipeline skips the re-DMA
+        last = jnp.maximum((len_ref[bi] + blk - 1) // blk - 1, 0)
+        return (bt_ref[bi, jnp.minimum(j, last)], 0, hi, 0)
+
+    def scale_map(bi, hi, j, bt_ref, len_ref):
+        last = jnp.maximum((len_ref[bi] + blk - 1) // blk - 1, 0)
+        return (bt_ref[bi, jnp.minimum(j, last)], 0, hi)
+
+    q_spec = pl.BlockSpec((1, 1, r_pad, d),
+                          lambda bi, hi, j, bt_ref, len_ref: (bi, hi, 0, 0))
+    kv_spec = pl.BlockSpec((1, blk, 1, d), kv_map)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qr, pool_k, pool_v]
+    if quant:
+        ks_spec = pl.BlockSpec((1, blk, 1), scale_map)
+        in_specs += [ks_spec, ks_spec]
+        operands += [k_scale, v_scale]
+    else:
+        # dummy scalar operands keep ONE kernel arity (the kernel ignores
+        # them when quant=False; SMEM spec so no tile constraints apply)
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+        zero = jnp.zeros((1,), jnp.float32)
+        operands += [zero, zero]
+
+    out_specs = [
+        pl.BlockSpec((1, 1, r_pad, d),
+                     lambda bi, hi, j, bt_ref, len_ref: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, 1, r_pad),
+                     lambda bi, hi, j, bt_ref, len_ref: (bi, hi, 0)),
+        pl.BlockSpec((1, 1, r_pad),
+                     lambda bi, hi, j, bt_ref, len_ref: (bi, hi, 0)),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, nb),          # block dim innermost: carry per (b, h)
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((r_pad, 128), jnp.float32),   # running max m
+            pltpu.VMEM((r_pad, 128), jnp.float32),   # running denom l
+            pltpu.VMEM((r_pad, d), jnp.float32),     # unnormalised acc
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, scale=scale, blk=blk, n_b=nb,
+                          quant=quant),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, r_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, r_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, r_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(bt, lens, *operands)
+
+    # [B, Hkv, R(, D)] → [B, S, H(, D)] (drop row padding first)
+    acc = acc[:, :, :rows].reshape(b, hkv, s, g, d)
+    acc = acc.transpose(0, 2, 1, 3, 4).reshape(b, s, h, d)
+    to_bsh = lambda x: (x[:, :, :rows].reshape(b, hkv, s, g)
+                        .transpose(0, 2, 1, 3).reshape(b, s, h))
+    return acc, to_bsh(m), to_bsh(l)
+
+
+def paged_flash_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Normalised in-place paged attention ``[B, S, H, D]`` (the
+    standalone/microbench surface; the serving path merges the partial
+    with its chunk-buffer half instead — see ``paged_attention_partial``).
+    Rows with ``lengths[b] == 0`` return zeros (no valid key)."""
+    acc, _, l = paged_attention_partial(
+        q, pool_k, pool_v, block_tables, lengths, scale=scale,
+        k_scale=k_scale, v_scale=v_scale, interpret=interpret)
+    return (acc / jnp.maximum(l[..., None], 1e-30)).astype(q.dtype)
+
+
+def paged_bytes_accounting(*, n_valid_blocks: int, blocks_per_seq: int,
+                           block: int, kvh: int, hd: int, esize: int,
+                           scale_bytes: int, n_steps: int) -> dict:
+    """Per-decode-step HBM bytes for ONE slot's pool reads, gather vs
+    in-place — the shared arithmetic ``tools/bench_flash.py --paged`` and
+    ``bench_llm --paged`` both report (and the microbench asserts on), so
+    the two can never disagree.
+
+    Gather (the ``_pool_gather_body`` path) pays, per chunk of
+    ``n_steps``: read EVERY table-mapped block + write the dense
+    ``[max_seq]`` copy once, then read the full dense copy per step.
+    In place pays: read the valid blocks per step, plus ONE clamped
+    re-fetch block for the idle tail (the pipeline elides the rest —
+    consecutive identical block indices are not re-DMA'd).  Bytes are
+    K + V per position (``esize`` each) plus the int8 layout's per-vector
+    scales (``scale_bytes``: 2 x 4 f32, or 0)."""
+    pos_bytes = kvh * (2 * hd * esize + scale_bytes)
+    full = blocks_per_seq * block * pos_bytes          # whole table span
+    valid = n_valid_blocks * block * pos_bytes
+    tail = (block * pos_bytes) if n_valid_blocks < blocks_per_seq else 0
+    gather_chunk = 2 * full + n_steps * full           # copy (r+w) + reads
+    inplace_chunk = n_steps * (valid + tail)
+    return {
+        "gather_step_bytes": gather_chunk / max(1, n_steps),
+        "paged_flash_step_bytes": inplace_chunk / max(1, n_steps),
+        "gather_chunk_bytes": gather_chunk,
+        "paged_flash_chunk_bytes": inplace_chunk,
+    }

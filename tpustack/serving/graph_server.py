@@ -832,9 +832,12 @@ class GraphServer:
                 if t_submit is not None:
                     # queue-seconds: submit → worker pickup (charged
                     # outside the lock — the ledger has its own)
+                    wait_s = time.monotonic() - t_submit
                     self.ledger.charge_queue_seconds(
-                        "graph", entry.tenant,
-                        time.monotonic() - t_submit)
+                        "graph", entry.tenant, wait_s)
+                    if self.qos is not None:
+                        self.qos.observe_queue_wait(
+                            "graph", entry.priority, wait_s)
                 if deadline is not None and time.monotonic() > deadline:
                     # expired while queued: refuse to start it (its device
                     # work would be wasted), publish the verdict in history

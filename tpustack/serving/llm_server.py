@@ -368,6 +368,14 @@ class LLMServer:
                 paged.cache = None  # caller asked for NO prefix cache:
                 # keep the paged engine, drop the block trie
         self.paged = paged
+        # paged-flash verdict resolved ONCE at boot: a typo'd
+        # TPUSTACK_PAGED_FLASH fails startup like every other knob typo,
+        # not on the first work cycle's executor thread; engines and
+        # /props both read this resolved value
+        from tpustack.models.llm_generate import resolve_paged_flash
+
+        self.paged_flash = (resolve_paged_flash(mesh=self.gen.mesh)
+                            if paged is not None else False)
         if self.paged is not None:
             prefix_cache = None  # the block trie replaces the host store
         # cross-request prefix KV cache, DENSE fallback form
@@ -1067,6 +1075,7 @@ class LLMServer:
                     stop_tokens=(self.tok.eos_id,),
                     on_progress=self.resilience.progress,
                     tracer=self.tracer, paged=self.paged,
+                    paged_flash=self.paged_flash,
                     spec=self.spec_cfg, on_spec=self._note_spec,
                     flight=self.flight, ledger=self.ledger,
                     queue_depth=lambda: len(self._queue),
@@ -1100,8 +1109,8 @@ class LLMServer:
                             self.ledger.charge_queue_seconds(
                                 "llm", r.tenant, wait_s)
                             if self.qos is not None:
-                                self.qos.observe_queue_wait(r.priority,
-                                                            wait_s)
+                                self.qos.observe_queue_wait(
+                                    "llm", r.priority, wait_s)
                         if r.cancel.is_set():
                             if r.queue_span is not None:
                                 r.queue_span.set_attribute("cancelled", True)
@@ -1636,8 +1645,11 @@ class LLMServer:
         }
         if self.paged is not None:
             rt = self.paged
-            payload["paged_kv"] = dict(rt.stats(), enabled=True,
-                                       dense_fallback=False)
+            payload["paged_kv"] = dict(
+                rt.stats(), enabled=True, dense_fallback=False,
+                # which decode-attention body the engines run (the
+                # TPUSTACK_PAGED_FLASH verdict resolved at boot)
+                kernel=("paged_flash" if self.paged_flash else "gather"))
             payload["prefix_cache"] = (rt.cache.stats()
                                        if rt.cache is not None
                                        else {"enabled": False})

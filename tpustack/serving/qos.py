@@ -344,9 +344,14 @@ class QosPolicy:
         self._m_throttle.labels(server=server, priority=p).inc()
         self._count("quota_throttle", p)
 
-    def observe_queue_wait(self, priority: Optional[str],
+    def observe_queue_wait(self, server: str, priority: Optional[str],
                            seconds: float) -> None:
+        """Admission-queue wall time per priority class — recorded at
+        each server's own pickup point: llm at ``feed()``'s queue pop,
+        sd at the micro-batch build, graph at the worker's pickup (the
+        three places a request stops waiting and starts costing chip)."""
         self._m_queue_wait.labels(
+            server=server,
             priority=priority or self.default_priority).observe(seconds)
 
     # ------------------------------------------------------------- reading

@@ -101,6 +101,10 @@ class _PendingReq:
     # explicitly — the batch task serves many riders, each charged its
     # share of the fused dispatch
     tenant: Optional[str] = None
+    # QoS priority class (resolved by the resilience middleware into the
+    # current_priority contextvar), carried across the window/batch
+    # boundary for the per-priority queue-wait recording
+    priority: Optional[str] = None
 
 
 class SDServer:
@@ -340,6 +344,8 @@ class SDServer:
             req.seed if req.seed is not None else "auto", width, height)
 
         key = (steps, float(guidance), width, height)
+        from tpustack.serving import qos as qos_mod
+
         parent = obs_trace.current_span.get()
         pending = _PendingReq(req.prompt, req.negative_prompt or "",
                               req.seed,
@@ -347,7 +353,9 @@ class SDServer:
                               t_enqueue=time.perf_counter(),
                               span_ctx=parent.context if parent else None,
                               t_enqueue_unix=time.time(),
-                              tenant=obs_accounting.current_tenant.get())
+                              tenant=obs_accounting.current_tenant.get(),
+                              priority=(qos_mod.current_priority.get()
+                                        if self.qos is not None else None))
         try:
             img = await asyncio.wait_for(self._enqueue(key, pending),
                                          deadline_s)
@@ -479,6 +487,8 @@ class SDServer:
                 wait_s = time.perf_counter() - r.t_enqueue
                 tr.add("queue_wait", wait_s)
                 self.ledger.charge_queue_seconds("sd", r.tenant, wait_s)
+                if self.qos is not None:
+                    self.qos.observe_queue_wait("sd", r.priority, wait_s)
         if len(batch) > 1 or pad:
             log.info("Micro-batch: %d requests (+%d pad) in one program (dp=%s)",
                      len(batch), pad, self._mesh_data_size() or 1)

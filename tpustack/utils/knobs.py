@@ -121,6 +121,13 @@ _declare("LLM_BATCH_WINDOW_MS", float, 0.0,
          "Legacy pre-continuous batching window; accepted, unused.")
 
 # ----------------------------------------------------------------- KV cache
+_declare("TPUSTACK_PAGED_FLASH", str, "auto",
+         "Paged-flash decode attention: read KV pool blocks in place via "
+         "the scalar-prefetch Pallas kernel (fused speculative verify "
+         "included) instead of gathering a dense per-slot copy each "
+         "chunk.  'auto' = on for real TPU backends, off on CPU/"
+         "interpret and under a tp mesh; 0 bisects to the gather path "
+         "(greedy outputs identical).")
 _declare("TPUSTACK_PAGED_KV", bool, True,
          "Paged KV substrate for batched serving (block pool + block "
          "tables); 0 falls back to the dense per-slot engine (bisection).")
