@@ -50,6 +50,13 @@ def test_llm_extras_schema(monkeypatch):
                    # qos counters ride the replay cell too
                    "priorities": {"batch": {"shed": 2}},
                    "server_qos": {"counters": {"shed": {"batch": 2}}},
+                   # KV working-set observatory snapshots: the paged
+                   # bench's per-pool profiler view and the replay
+                   # server's /debug/kvcache ride the same keep list
+                   "kvprof": {"working_set_blocks": 12.0,
+                              "counterfactual_hit_ratio": {"2x": 0.8}},
+                   "server_kvcache": {"enabled": True,
+                                      "working_set_blocks": 9.0},
                    # provenance + exact-counter signature (PR 13): every
                    # tool artifact carries them and the driver keeps them
                    "meta": {"schema_version": 1, "git_sha": "cafe",
@@ -86,6 +93,10 @@ def test_llm_extras_schema(monkeypatch):
     # the per-priority split + server qos counters ride the replay cell
     assert out["replay"]["priorities"]["batch"]["shed"] == 2
     assert out["replay"]["server_qos"]["counters"]["shed"]["batch"] == 2
+    # the kvprof snapshots (paged pool view + replay server view) are kept
+    assert out["paged"]["kvprof"]["working_set_blocks"] == 12.0
+    assert out["paged"]["kvprof"]["counterfactual_hit_ratio"]["2x"] == 0.8
+    assert out["replay"]["server_kvcache"]["working_set_blocks"] == 9.0
     # the bench replay scenario is mixed-priority (one tenant per class)
     assert any(":interactive" in " ".join(c) and ":batch" in " ".join(c)
                for c in calls)

@@ -176,6 +176,7 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
     from tpustack.models.llama import init_kv_pool
     from tpustack.models.llm_continuous import ContinuousEngine, SlotRequest
     from tpustack.models.llm_generate import SampleConfig
+    from tpustack.obs.kvprof import KVProfiler
     from tpustack.serving.kv_pool import KVBlockPool, PagedKVRuntime
 
     sample = SampleConfig(greedy=True)
@@ -253,6 +254,7 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
     identical = True
     leak_ok = True
     sig_extra = {}  # per-footprint exact admission/allocator counters
+    kvprof_snaps = {}  # per-footprint KV observatory snapshots
     for req_ctx in footprints:
         blocks_per_req = (req_ctx + block - 1) // block
         paged_slots = max(dense_slots, min(args.max_paged_slots,
@@ -275,6 +277,11 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
         rt = PagedKVRuntime(
             init_kv_pool(cfg, capacity + 1, block, dtype=gen.cache_dtype),
             pool, ctx)
+        # KV working-set observatory riding the bench pool: forced-on
+        # sampling, snapshot-only (no registry) — the artifact carries
+        # block-lifetime/curve/calibration evidence; the pool counters in
+        # sig_extra are observer-independent, so the signature can't move
+        kvprof = KVProfiler(pool, rate=1.0).attach()
         paged_eng = lambda: ContinuousEngine(gen, slots=paged_slots,
                                              chunk=min(args.chunk, new),
                                              paged=rt,
@@ -283,6 +290,7 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
         free0 = pool.n_free
         paged_res, paged = run_fleet(paged_eng(), reqs, pool=pool)
         leak_ok = leak_ok and pool.n_free == free0
+        kvprof_snaps[req_ctx] = kvprof.snapshot()
         same = all(dense_res[i][0] == paged_res[i][0]
                    for i in range(n_requests))
         identical = identical and same
@@ -357,6 +365,7 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
         "sweep": sweep,
         "outputs_identical": identical,
         "leak_check_ok": leak_ok,
+        "kvprof": kvprof_snaps[mid["req_ctx"]],
     }, t0, sig)
 
 

@@ -48,6 +48,11 @@ LLM_EXTRA_KEEP = (
     "seed", "schedule_sha", "offered_rps", "goodput_rps",
     "goodput_ratio", "shed", "deadline", "errors", "tenants",
     "priorities", "server_qos",
+    # KV working-set observatory (tpustack.obs.kvprof): the paged bench's
+    # per-pool snapshot and the replay's server-side /debug/kvcache view
+    # (miss-ratio curve, working set, block lifetimes, Retry-After
+    # calibration) — the sizing evidence ROADMAP item 4 reads
+    "kvprof", "server_kvcache",
     # provenance + the machine-exact perf signature (tpustack.obs.perfsig)
     # ride each cell into the driver artifact: BENCH_r*.json rounds carry
     # the exact counters the perf gate ratchets on, per measurement

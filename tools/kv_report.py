@@ -1,0 +1,261 @@
+"""Render the KV working-set observatory into a capacity recommendation.
+
+The profiler (``tpustack.obs.kvprof``) measures the prefix-cache demand
+curve online — sampled stack distances over token-chunk keys → an
+estimated working set and counterfactual hit rates at 0.5x/1x/2x/4x of
+the current pool.  This tool turns one snapshot of that into the table a
+capacity decision actually needs: *is the pool sized right, and what
+would more (or less) HBM buy?* — the sizing evidence ROADMAP item 4
+(host-tier KV offload) starts from.
+
+Sources (exactly one):
+
+- ``--url http://host:port`` — scrape ``GET /debug/kvcache`` off a live
+  llm server or the stdlib metrics sidecar;
+- ``--file artifact.json`` — a ``tools/replay.py`` artifact
+  (``server_kvcache``), a ``bench_llm --paged`` artifact (``kvprof``),
+  or a raw snapshot object;
+- ``--tiny`` — run the CPU replay smoke self-hosted (``replay.py
+  --tiny``) and render its server-side snapshot: the CI path, no
+  cluster needed.
+
+``--json`` emits the machine-readable report (CI artifact); ``--out``
+writes it to a file as well.  With ``--max-hbm-ratio R`` the exit code
+gates: 1 when the estimated working set exceeds ``R x`` current pool
+capacity (the "you are undersized" tripwire), 0 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(f"[kv_report] {msg}", file=sys.stderr, flush=True)
+
+
+# ------------------------------------------------------------- sources
+def _from_url(url: str) -> Dict:
+    import urllib.request
+
+    target = url.rstrip("/") + "/debug/kvcache"
+    log(f"scraping {target}")
+    with urllib.request.urlopen(target, timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _from_tiny() -> Dict:
+    """The CI smoke: replay --tiny against an in-process tiny server,
+    then read the artifact's server-side kvprof snapshot."""
+    from tools import replay
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "replay.json")
+        # replay prints its artifact blob on stdout (its own contract);
+        # this tool's stdout is the report — reroute the blob to stderr
+        with contextlib.redirect_stdout(sys.stderr):
+            rc = replay.main(["--tiny", "--out", out])
+        if rc != 0:
+            raise SystemExit(f"replay --tiny failed with exit {rc}")
+        with open(out) as f:
+            return json.load(f)
+
+
+def extract_snapshot(payload: Dict) -> Tuple[Optional[Dict], str]:
+    """Normalise any supported payload shape into ONE profiler snapshot:
+    a raw snapshot (has ``curve``), a replay artifact (``server_kvcache``),
+    a paged-bench artifact (``kvprof``), or the sidecar's name-keyed map
+    of snapshots (prefers ``llm``)."""
+    if not isinstance(payload, dict):
+        return None, "unrecognised payload"
+    if "curve" in payload:
+        return payload, "snapshot"
+    for key in ("server_kvcache", "kvprof"):
+        inner = payload.get(key)
+        if isinstance(inner, dict) and "curve" in inner:
+            return inner, key
+    # sidecar shape: {profiler_name: snapshot, ...}
+    if isinstance(payload.get("llm"), dict) and "curve" in payload["llm"]:
+        return payload["llm"], "sidecar:llm"
+    for name, inner in payload.items():
+        if isinstance(inner, dict) and "curve" in inner:
+            return inner, f"sidecar:{name}"
+    return None, "no kvprof snapshot found (profiler off? " \
+                 "TPUSTACK_KVPROF_RATE=0)"
+
+
+# ------------------------------------------------------------ reporting
+def _fmt_ratio(r) -> str:
+    return f"{r:.3f}" if isinstance(r, (int, float)) else "n/a"
+
+
+def build_report(snap: Dict, max_hbm_ratio: float) -> Dict:
+    """The machine-readable report: the capacity table, the working-set /
+    capacity ratio, and a one-line recommendation."""
+    capacity = max(1, int(snap.get("capacity_blocks") or 1))
+    ws = float(snap.get("working_set_blocks") or 0.0)
+    ratio = ws / capacity
+    rows: List[Dict] = []
+    best_hit = None
+    for pt in snap.get("curve") or []:
+        hr = pt.get("hit_ratio")
+        rows.append({"scale": pt.get("scale"),
+                     "capacity_blocks": pt.get("capacity_blocks"),
+                     "predicted_hit_ratio": hr})
+        if isinstance(hr, (int, float)):
+            best_hit = hr if best_hit is None else max(best_hit, hr)
+    # the smallest capacity already delivering (within a point of) the
+    # curve's ceiling — paying for more buys nothing the trace wants
+    rec_scale = None
+    if best_hit is not None:
+        for row in rows:
+            hr = row["predicted_hit_ratio"]
+            if isinstance(hr, (int, float)) and hr >= best_hit - 0.01:
+                rec_scale = row["scale"]
+                break
+    if ws == 0:
+        recommendation = ("no sampled accesses yet — run traffic through "
+                          "the prefix cache before sizing")
+    elif rec_scale is None:
+        recommendation = "curve empty — not enough samples to recommend"
+    elif rec_scale > 1.0:
+        recommendation = (f"working set wants ~{rec_scale:g}x the current "
+                          f"pool ({int(capacity * rec_scale)} blocks) to "
+                          f"reach the trace's hit-rate ceiling")
+    elif rec_scale < 1.0:
+        recommendation = (f"pool is oversized for this trace: {rec_scale:g}x "
+                          f"({int(capacity * rec_scale)} blocks) already "
+                          f"hits the ceiling")
+    else:
+        recommendation = "pool is sized right: 1x sits at the curve ceiling"
+    gated = bool(max_hbm_ratio > 0 and ratio > max_hbm_ratio)
+    return {
+        "metric": "kv_working_set_report",
+        "capacity_blocks": capacity,
+        "block_tokens": snap.get("block_tokens"),
+        "working_set_blocks": ws,
+        "capacity_ratio": round(ratio, 4),
+        "max_hbm_ratio": max_hbm_ratio,
+        "rate": snap.get("rate"),
+        "lookups": snap.get("lookups"),
+        "sampled_accesses": snap.get("sampled_accesses"),
+        "table": rows,
+        "counterfactual_hit_ratio": snap.get("counterfactual_hit_ratio"),
+        "tenants": snap.get("tenants") or {},
+        "block_lifetime": snap.get("block_lifetime") or {},
+        "eviction_age": snap.get("eviction_age"),
+        "reuse_gap": snap.get("reuse_gap"),
+        "calibration": snap.get("calibration") or {},
+        "prefix_cache": snap.get("prefix_cache"),
+        "recommendation": recommendation,
+        "ok": not gated,
+    }
+
+
+def render_text(rep: Dict, source: str) -> str:
+    lines = [f"KV working-set report ({source})"]
+    lines.append(
+        f"  pool: {rep['capacity_blocks']} blocks x "
+        f"{rep.get('block_tokens')} tokens | working set ~= "
+        f"{rep['working_set_blocks']:g} blocks "
+        f"({rep['capacity_ratio']:.2f}x of capacity)")
+    lines.append(
+        f"  lookups: {rep.get('lookups')} "
+        f"(sampled accesses {rep.get('sampled_accesses')} @ rate "
+        f"{rep.get('rate')})")
+    lines.append("")
+    lines.append("  capacity   blocks   predicted hit rate")
+    for row in rep["table"]:
+        lines.append(f"  {row['scale']:>7g}x  {row['capacity_blocks']:>7}"
+                     f"   {_fmt_ratio(row['predicted_hit_ratio'])}")
+    pc = rep.get("prefix_cache") or {}
+    if pc.get("enabled"):
+        lines.append(f"  measured hit rate (1x, actual): "
+                     f"{_fmt_ratio(pc.get('hit_rate'))} | evictions "
+                     f"warm {pc.get('evicted_warm', 0)} / cold "
+                     f"{pc.get('evicted_cold', 0)}")
+    life = rep["block_lifetime"]
+    if life:
+        parts = [f"{o} n={v.get('count')} mean={v.get('mean_s', 0):.3f}s"
+                 for o, v in sorted(life.items())]
+        lines.append("  block lifetime: " + "; ".join(parts))
+    calib = rep["calibration"]
+    if calib.get("count"):
+        lines.append(
+            f"  retry-after calibration: n={calib['count']} mean abs err "
+            f"{calib.get('mean_abs_error_s', 0):.3f}s (max "
+            f"{calib.get('max_abs_error_s', 0):.3f}s)")
+    if rep["tenants"]:
+        lines.append("  tenants:")
+        for t, v in sorted(rep["tenants"].items()):
+            lines.append(
+                f"    {t}: ws={v.get('working_set_blocks')} blocks, "
+                f"hit@1x={_fmt_ratio(v.get('hit_ratio_1x'))}, "
+                f"hit@2x={_fmt_ratio(v.get('hit_ratio_2x'))}")
+    lines.append(f"  recommendation: {rep['recommendation']}")
+    if rep["max_hbm_ratio"] > 0:
+        verdict = "OK" if rep["ok"] else "FAIL"
+        lines.append(
+            f"  gate: working set {rep['capacity_ratio']:.2f}x vs "
+            f"--max-hbm-ratio {rep['max_hbm_ratio']:g} -> {verdict}")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- main
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="scrape GET /debug/kvcache from a live "
+                                   "server or metrics sidecar")
+    src.add_argument("--file", help="read a replay/bench artifact or raw "
+                                    "snapshot JSON")
+    src.add_argument("--tiny", action="store_true",
+                     help="CPU smoke: self-host replay --tiny and render "
+                          "its server_kvcache (the CI path)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the machine-readable report on stdout")
+    p.add_argument("--out", default="",
+                   help="also write the JSON report here")
+    p.add_argument("--max-hbm-ratio", type=float, default=0.0,
+                   help="exit 1 when working_set / pool_capacity exceeds "
+                        "this (0 disables the gate)")
+    args = p.parse_args(argv)
+
+    if args.url:
+        payload, source = _from_url(args.url), args.url
+    elif args.file:
+        with open(args.file) as f:
+            payload = json.load(f)
+        source = args.file
+    else:
+        payload, source = _from_tiny(), "replay --tiny (self-hosted)"
+
+    snap, how = extract_snapshot(payload)
+    if snap is None:
+        log(f"error: {how}")
+        return 2
+    if how != "snapshot":
+        source = f"{source} [{how}]"
+
+    rep = build_report(snap, args.max_hbm_ratio)
+    blob = json.dumps(rep)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob + "\n")
+        log(f"report written to {args.out}")
+    print(blob if args.as_json else render_text(rep, source))
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -408,6 +408,12 @@ class _SelfHosted:
         qos = getattr(self.server, "qos", None)
         return qos.snapshot() if qos is not None else {"enabled": False}
 
+    def kvprof_snapshot(self) -> Dict:
+        prof = getattr(self.server, "kvprof", None)
+        if prof is None:
+            return {"enabled": False}
+        return dict(prof.snapshot(), enabled=True)
+
     def close(self):
         import asyncio
 
@@ -572,6 +578,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             # preempt, quota_throttle per priority) — the smoke gate's
             # "shed landed on batch" evidence
             artifact["server_qos"] = host.qos_snapshot()
+            # ... and the KV working-set observatory's snapshot (miss-
+            # ratio curve, working set, calibration) — what kv_report.py
+            # renders a capacity recommendation from
+            artifact["server_kvcache"] = host.kvprof_snapshot()
     finally:
         if host is not None:
             host.close()

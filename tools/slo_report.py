@@ -255,12 +255,15 @@ _TENANT_COUNTERS = (
 )
 
 
-def tenant_report(samples: Dict[Sample, float]) -> Dict[str, dict]:
+def tenant_report(samples: Dict[Sample, float],
+                  current: Dict[Sample, float] = None) -> Dict[str, dict]:
     """Per-tenant cost + goodput over the report window, from the
     ``tpustack_tenant_*`` counters (tpustack.obs.accounting; the tenant
     label is cardinality-bounded, so this table is too — the ``other``
-    row aggregates the tail).  Empty dict when the scrape carries no
-    tenant metrics (pre-accounting pods)."""
+    row aggregates the tail).  ``current`` is the undelta'd scrape: the
+    KV working-set gauges (tpustack.obs.kvprof via the ledger) read from
+    it, like the utilization gauges — a gauge has no window.  Empty dict
+    when the scrape carries no tenant metrics (pre-accounting pods)."""
     out: Dict[str, dict] = {}
 
     def row(tenant: str) -> dict:
@@ -281,6 +284,16 @@ def tenant_report(samples: Dict[Sample, float]) -> Dict[str, dict]:
         for counter, key in _TENANT_COUNTERS:
             if name == counter:
                 row(tenant)[key] = round(row(tenant)[key] + v, 6)
+    for (name, labels), v in (current or {}).items():
+        d = dict(labels)
+        tenant = d.get("tenant")
+        if tenant is None:
+            continue
+        if name == "tpustack_tenant_kv_working_set_blocks":
+            row(tenant)["kv_working_set_blocks"] = round(v, 2)
+        elif name == "tpustack_tenant_kv_hit_ratio":
+            row(tenant).setdefault("kv_hit_ratio", {})[
+                d.get("capacity", "?")] = round(v, 6)
     for tenant, entry in out.items():
         denom = sum(entry["requests"].get(k, 0) for k in _GOODPUT_OUTCOMES)
         if denom:
@@ -349,7 +362,7 @@ def main(argv: List[str] = None) -> int:
     windowed = delta(samples, prev)
     rep = report(windowed)
     util = utilization_report(samples)
-    tenants = tenant_report(windowed)
+    tenants = tenant_report(windowed, current=samples)
     if args.as_json:
         out = dict(rep)
         if util:
@@ -368,13 +381,20 @@ def main(argv: List[str] = None) -> int:
             for t, e in sorted(tenants.items()):
                 gp = (f"{e['goodput_ratio']:.2%}"
                       if e["goodput_ratio"] is not None else "—")
+                ws = ""
+                if "kv_working_set_blocks" in e:
+                    hr = e.get("kv_hit_ratio") or {}
+                    hits = "/".join(f"{c}:{r:.2f}"
+                                    for c, r in sorted(hr.items()))
+                    ws = (f" kv_ws={e['kv_working_set_blocks']:g}blk"
+                          + (f" hit[{hits}]" if hits else ""))
                 print(f"  {t:<20} goodput={gp} "
                       f"chip={e['chip_seconds']:.2f}s "
                       f"kv={e['kv_block_seconds']:.1f}blk·s "
                       f"queue={e['queue_seconds']:.2f}s "
                       f"tok={int(e['prompt_tokens'])}+"
                       f"{int(e['generated_tokens'])} "
-                      f"requests={e['requests']}")
+                      f"requests={e['requests']}{ws}")
     ok = all(r["ok"] for entry in rep.values() for r in entry.values())
     return 0 if ok else 1
 

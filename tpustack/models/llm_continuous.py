@@ -948,7 +948,7 @@ class ContinuousEngine:
             # blocks the prefix cache also references survive — everything
             # else returns to the free list before on_done fires, so a
             # waiter observing the pool sees its capacity already released
-            self.paged.pool.decref(s.blocks)
+            self.paged.pool.decref(s.blocks, outcome="retired")
             s.blocks, s.alloc = [], 0
             if self._bt is not None:
                 self._bt[i, :] = 0

@@ -161,6 +161,8 @@ class TenantLedger:
         self._m_queue = m["tpustack_tenant_queue_seconds_total"]
         self._m_req = m["tpustack_tenant_requests_total"]
         self._m_goodput = m["tpustack_tenant_goodput_ratio"]
+        self._m_kv_ws = m["tpustack_tenant_kv_working_set_blocks"]
+        self._m_kv_hit = m["tpustack_tenant_kv_hit_ratio"]
         # the account table and the overflow election both ride this lock
         # (handlers + engine thread + batch/worker threads all charge);
         # like the flight recorder, the ledger stays OUT of the sanitizer
@@ -311,6 +313,39 @@ class TenantLedger:
         self._m_req.labels(server=server, tenant=label,
                            outcome=outcome).inc()
         self._m_goodput.labels(server=server, tenant=label).set(ratio)
+
+    def export_kv_working_sets(self,
+                               per_tenant: Mapping[str, Mapping]) -> None:
+        """Scrape-time export of the KV profiler's per-tenant working-set
+        attribution (:mod:`tpustack.obs.kvprof`): working-set blocks and
+        the 1x/2x counterfactual hit ratios.  Lives on the ledger because
+        the tenant label must stay BOUNDED — kvprof hands over raw
+        tenants, the cardinality cap canonicalises here (the TPL502
+        single-writer rule, same as every other tenant metric)."""
+        if not per_tenant:
+            return
+        rows = []
+        with self._lock:
+            for tenant, vals in per_tenant.items():
+                t = sanitize_tenant(tenant)
+                if t is None:
+                    t = knobs.get_str("TPUSTACK_TENANT_DEFAULT")
+                rows.append((self._canon_locked(t), vals))
+        # overflow tenants share the 'other' label: working sets SUM
+        # (they partition the global set); hit ratios are last-writer
+        ws_by_label: Dict[str, float] = {}
+        for label, vals in rows:
+            ws_by_label[label] = (ws_by_label.get(label, 0.0)
+                                  + float(vals.get("working_set_blocks")
+                                          or 0.0))
+        for label, ws in ws_by_label.items():
+            self._m_kv_ws.labels(tenant=label).set(ws)
+        for label, vals in rows:
+            for cap in ("1x", "2x"):
+                r = vals.get(f"hit_ratio_{cap}")
+                if r is not None:
+                    self._m_kv_hit.labels(tenant=label,
+                                          capacity=cap).set(float(r))
 
     # ------------------------------------------------------------ reading
     def tenants(self) -> list:

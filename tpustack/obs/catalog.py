@@ -114,6 +114,45 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "(block-size rounding): 0 = tight fit, rises with larger "
                "TPUSTACK_KV_BLOCK against short requests.", unit="ratio"),
 
+    # ---- KV working-set observatory (tpustack.obs.kvprof; SHARDS-style
+    # sampled stack distances over prefix-chunk keys.  Gauges refresh at
+    # scrape time via the profiler's collector; histograms observe at
+    # event time.  All series absent at TPUSTACK_KVPROF_RATE=0 — the
+    # profiler's bisection contract) ----
+    MetricSpec("tpustack_llm_kv_working_set_blocks", "gauge",
+               "Estimated prefix working-set size in pool blocks (distinct "
+               "sampled chunks / sampling rate) — the number ROADMAP item "
+               "4 sizes the host KV tier against.", unit="blocks"),
+    MetricSpec("tpustack_llm_kv_counterfactual_hit_ratio", "gauge",
+               "Online miss-ratio curve: predicted prefix hit rate IF the "
+               "pool were capacity x {0.5x|1x|2x|4x} — the 1x point "
+               "tracks the measured hit rate (CI-asserted), the others "
+               "answer what more/less HBM would buy.",
+               ("capacity",), unit="ratio"),
+    MetricSpec("tpustack_llm_kv_block_lifetime_seconds", "histogram",
+               "Alloc→release age of pool blocks by release outcome "
+               "(retired | evicted_warm | evicted_cold | died_queued | "
+               "other) — how long KV actually lives, and why it dies.",
+               ("outcome",), buckets=SAVE_BUCKETS, unit="seconds"),
+    MetricSpec("tpustack_llm_kv_eviction_age_seconds", "histogram",
+               "Seconds since last hit for evicted prefix-cache entries "
+               "(low = the LRU is churning entries still in use).",
+               buckets=SAVE_BUCKETS, unit="seconds"),
+    MetricSpec("tpustack_llm_kv_reuse_gap_seconds", "histogram",
+               "Wall time between successive hits on the same cached "
+               "prefix — the residency an entry needs to convert reuse "
+               "into hits.", buckets=SAVE_BUCKETS, unit="seconds"),
+    MetricSpec("tpustack_llm_kv_retry_after_error_seconds", "histogram",
+               "Absolute error of the paged 429's projected block-release "
+               "ETA vs the observed release wall — calibration of the "
+               "Retry-After admission math.",
+               buckets=(0.1, 0.5, 1.0, 2.0, 5.0, 15.0, 60.0),
+               unit="seconds"),
+    MetricSpec("tpustack_llm_prefix_evicted_warm_total", "counter",
+               "Prefix-cache entries evicted within TPUSTACK_KVPROF_WARM_S "
+               "of their last hit — avoidable evictions a bigger pool "
+               "would have kept.", unit="total"),
+
     # ---- LLM speculative decoding (prompt-lookup / draft-model verify) ----
     MetricSpec("tpustack_llm_spec_drafted_tokens_total", "counter",
                "Draft tokens proposed to verify steps (prompt-lookup "
@@ -210,6 +249,17 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "error).  The number the QoS layer (quotas, priorities, "
                "SLO-aware shedding — ROADMAP item 5) will be judged by.",
                ("server", "tenant"), unit="ratio"),
+    MetricSpec("tpustack_tenant_kv_working_set_blocks", "gauge",
+               "Estimated prefix working-set blocks attributed to the "
+               "tenant (sampled chunks owned by last toucher / rate) — "
+               "tenant values partition the global working set, so the "
+               "sum never exceeds tpustack_llm_kv_working_set_blocks.",
+               ("tenant",), unit="blocks"),
+    MetricSpec("tpustack_tenant_kv_hit_ratio", "gauge",
+               "Per-tenant counterfactual prefix hit rate at {1x|2x} of "
+               "current pool capacity, from the tenant's own sampled "
+               "reuse distances — which tenant a host KV tier would "
+               "actually help.", ("tenant", "capacity"), unit="ratio"),
 
     # ---- multi-tenant QoS (tpustack.serving.qos; priority ∈
     # interactive|batch.  The bucket gauge's tenant label is bounded by

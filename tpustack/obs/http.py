@@ -55,7 +55,7 @@ from tpustack.obs.trace import bind_request_id
 UNTRACED_ENDPOINTS = frozenset({
     "/metrics", "/health", "/healthz", "/readyz",
     "/debug/traces", "/debug/traces/{trace_id}", "/debug/flight",
-    "/debug/tenants",
+    "/debug/tenants", "/debug/kvcache",
     "__unmatched__",
     # poll loops (the wan client hits /history every few seconds for
     # minutes per prompt) — the prompt's real work is traced via its
@@ -286,13 +286,16 @@ def add_debug_trace_routes(app, tracer: Optional[obs_trace.Tracer] = None):
     app.router.add_get("/debug/traces/{trace_id}", get_trace)
 
 
-def add_debug_tenant_routes(app, ledger=None, qos=None) -> None:
+def add_debug_tenant_routes(app, ledger=None, qos=None,
+                            kvprof=None) -> None:
     """Mount ``GET /debug/tenants``: the tenant ledger's exact per-tenant
     cost accounts (tokens, chip/KV-block/queue seconds, outcomes,
     goodput) — what a scrape's bounded ``tenant`` label summarises.
     With a QoS policy attached, the payload gains a ``qos`` section:
     live token-bucket levels/ETAs per policy tenant plus the shed/
-    preempt/throttle counters."""
+    preempt/throttle counters.  With a KV profiler attached, a
+    ``kv_working_set`` section: each tenant's estimated working-set
+    blocks + 1x/2x counterfactual hit ratios (tpustack.obs.kvprof)."""
     from aiohttp import web
 
     led = ledger if ledger is not None else obs_accounting.LEDGER
@@ -301,9 +304,29 @@ def add_debug_tenant_routes(app, ledger=None, qos=None) -> None:
         payload = led.snapshot()
         payload["qos"] = (qos.snapshot() if qos is not None
                           else {"enabled": False})
+        payload["kv_working_set"] = (kvprof.tenant_working_sets()
+                                     if kvprof is not None
+                                     else {"enabled": False})
         return web.json_response(payload)
 
     app.router.add_get("/debug/tenants", tenants_view)
+
+
+def add_debug_kvcache_routes(app, kvprof=None) -> None:
+    """Mount ``GET /debug/kvcache``: the KV working-set observatory's
+    snapshot — miss-ratio curve points, working-set estimate, per-tenant
+    split, block-lifetime and Retry-After calibration summaries
+    (tpustack.obs.kvprof; rendered by tools/kv_report.py).  With the
+    profiler off (TPUSTACK_KVPROF_RATE=0) the route still mounts and
+    reports ``enabled: false`` — probes can tell \"off\" from \"gone\"."""
+    from aiohttp import web
+
+    async def kvcache_view(request: web.Request) -> web.Response:
+        if kvprof is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(dict(kvprof.snapshot(), enabled=True))
+
+    app.router.add_get("/debug/kvcache", kvcache_view)
 
 
 def add_debug_flight_routes(app, recorder) -> None:
@@ -370,6 +393,14 @@ def start_metrics_sidecar(port: int,
                 # into the same one their /metrics sidecar exposes)
                 body = _json.dumps(
                     obs_accounting.LEDGER.snapshot()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+            elif path == "/debug/kvcache":
+                # every registered KV profiler in the process (the
+                # flight-recorder registration pattern)
+                from tpustack.obs import kvprof as obs_kvprof
+
+                body = _json.dumps(obs_kvprof.snapshot_all()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
             elif path.startswith("/debug/traces/"):

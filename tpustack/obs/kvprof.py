@@ -1,0 +1,506 @@
+"""KV working-set observatory — online miss-ratio curves and capacity
+what-ifs for the paged KV substrate.
+
+ROADMAP item 4 (host-tier KV offload) and item 2 (cache-aware scale-out)
+both start from a question the hit/miss counters cannot answer: *how big
+is the prefix working set — per tenant — relative to HBM, and what would
+the hit rate be at 2x / 4x / host-RAM capacity?*  This module measures
+the demand curve continuously, from the serving path itself:
+
+- **Sampled stack distances (SHARDS).**  Every prefix-cache lookup is a
+  stream of token-chunk accesses (one per complete block, the same
+  granularity ``PagedPrefixCache`` keys on).  A spatial hash samples a
+  fixed subset of that key space (``TPUSTACK_KVPROF_RATE``); reuse
+  distances measured over the sampled keys, scaled by ``1/rate``, give
+  an online miss-ratio curve — counterfactual hit rates at 0.5x/1x/2x/4x
+  of the CURRENT pool capacity plus an estimated working-set size in
+  blocks, for the cost of a few dict operations per lookup.
+- **Block-lifetime telemetry.**  ``KVBlockPool.decref`` reports each
+  block's alloc→release age tagged with WHY it was released (retired /
+  evicted-warm / evicted-cold / died-queued); the trie reports how long
+  an evicted entry had been idle and the reuse gap between hits.
+- **Per-tenant attribution.**  Each sampled chunk is owned by the tenant
+  that touched it last (the PR 12 ledger's ``current_tenant``), so
+  tenant working sets PARTITION the global one — attribution is
+  accounting, the sum can never exceed the whole.
+- **Retry-After calibration.**  Every paged 429 records the projected
+  block-release ETA; the profiler watches the pool's free count and
+  measures when the shortfall actually freed.  The error histogram holds
+  the admission math item 4's host tier will reuse to measured accuracy.
+
+Hook contract: the profiler attaches as an OBSERVER on the existing
+``KVBlockPool`` / ``PagedPrefixCache`` hot paths (``pool.profiler`` /
+``cache.profiler``); no KV bytes are copied and ``TPUSTACK_KVPROF_RATE=0``
+means nothing attaches at all — the serving path is then byte-for-byte
+the profiler-free one (the bisection contract every optional subsystem
+in this repo honours).
+
+Served as ``GET /debug/kvcache`` on the llm server and the metrics
+sidecar; rendered by ``tools/kv_report.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+from tpustack import sanitize
+from tpustack.obs import accounting as obs_accounting
+from tpustack.utils import get_logger, knobs
+
+log = get_logger("obs.kvprof")
+
+__all__ = ["KVProfiler", "chunk_hashes", "from_env", "register",
+           "snapshot_all", "CAPACITY_SCALES"]
+
+#: counterfactual capacity multipliers the gauges export (labels "0.5x",
+#: "1x", "2x", "4x"); the /debug/kvcache curve adds finer points
+CAPACITY_SCALES = (0.5, 1.0, 2.0, 4.0)
+_CURVE_SCALES = (0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
+
+#: tenant bucket for accesses outside any request context (engine-thread
+#: warm restarts, bench loops) — mirrors the ledger's bounded-label idea
+UNATTRIBUTED = "unattributed"
+
+# 64-bit FNV-1a over token ids — stable across processes (Python's str
+# hash is salted; int arithmetic is not), which keeps the spatial sample
+# set comparable between a run and its replay
+_FNV_OFFSET = 0xcbf29ce484222325
+_FNV_PRIME = 0x100000001b3
+_MASK64 = (1 << 64) - 1
+_HASH_SPACE = 1 << 24
+
+#: cold-miss sentinel in the distance histograms (an access whose chunk
+#: was never seen before misses at EVERY capacity)
+_COLD = -1
+
+
+def chunk_hashes(ids: Sequence[int], block: int) -> List[int]:
+    """One rolling FNV-1a hash per COMPLETE block of ``ids``, capped at
+    ``len(ids) - 1`` tokens — exactly the chunk set a
+    ``PagedPrefixCache.match`` walk considers, so the sampled access
+    stream and the trie's measured hit rate describe the same
+    references."""
+    n = max(0, (len(ids) - 1) // block)
+    out: List[int] = []
+    h = _FNV_OFFSET
+    i = 0
+    for _ in range(n):
+        for t in ids[i:i + block]:
+            h = ((h ^ (int(t) & _MASK64)) * _FNV_PRIME) & _MASK64
+        i += block
+        out.append(h)
+    return out
+
+
+class KVProfiler:
+    """Always-on KV/prefix-cache profiler for ONE paged pool.
+
+    Feed paths (all observer calls, none copies KV):
+
+    - ``on_lookup(ids, reuse_gap_s)`` — from ``PagedPrefixCache.match``;
+    - ``on_block_alloc(n, now)`` / ``on_block_free(ages, now, n_free,
+      outcome)`` — from ``KVBlockPool.alloc_tokens`` / ``decref``;
+    - ``on_evictions(hit_ages, warm)`` — from ``PagedPrefixCache.evict``;
+    - ``note_retry_after(shortfall_blocks, predicted_s)`` — from the
+      server's paged 429 path.
+
+    ``registry`` wires the Prometheus surface (histograms at event time,
+    gauges via :meth:`collect` at scrape time); None keeps the profiler
+    metrics-free — bench/replay paths read :meth:`snapshot` only.
+    """
+
+    #: spatial-sample cap: bounds memory AND the reverse-scan distance
+    #: cost; the sample set LRUs past it (a dropped key's next access
+    #: counts cold — conservative for the hit-rate estimate)
+    MAX_SAMPLES = 8192
+    #: outstanding 429 predictions awaiting their observed release
+    MAX_PENDING = 64
+
+    def __init__(self, pool, cache=None, rate: Optional[float] = None,
+                 registry=None, name: str = "llm"):
+        self.pool = pool
+        self.cache = cache
+        self.name = name
+        if rate is None:
+            rate = knobs.get_float("TPUSTACK_KVPROF_RATE")
+        self.rate = min(1.0, max(0.0, float(rate)))
+        self._thresh = int(self.rate * _HASH_SPACE)
+        self._lock = threading.Lock()
+        # spatial sample set, ordered coldest→hottest: key → owning
+        # tenant (ownership = last toucher, so tenant working sets
+        # partition the sample)
+        self._samples: "OrderedDict[int, str]" = OrderedDict()  # guarded-by: _lock
+        self._tenant_ws: Dict[str, int] = {}  # guarded-by: _lock
+        # reuse-distance histograms (value = sampled-set distance; _COLD
+        # = first access), global and per accessing tenant
+        self._dists: Dict[int, int] = {}  # guarded-by: _lock
+        self._tenant_dists: Dict[str, Dict[int, int]] = {}  # guarded-by: _lock
+        # scalar event counters (sampled accesses, cold misses, pool
+        # alloc/free events seen, sample-cap drops).  chunk_accesses
+        # counts EVERY chunk access, sampled or not: the SHARDS_adj
+        # correction rescales the sampled hit mass to rate x this, which
+        # removes the popularity skew of an unlucky spatial sample (the
+        # dominant error source on small key populations)
+        self._counts: Dict[str, int] = {  # guarded-by: _lock
+            "accesses": 0, "cold": 0, "allocs": 0, "frees": 0,
+            "sample_drops": 0, "lookups": 0, "chunk_accesses": 0,
+        }
+        # per-tenant total chunk accesses (the per-tenant SHARDS_adj base)
+        self._tenant_accesses: Dict[str, int] = {}  # guarded-by: _lock
+        # block-lifetime aggregates by release outcome: [count, sum, max]
+        self._life: Dict[str, List[float]] = {}  # guarded-by: _lock
+        # eviction-age / reuse-gap aggregates: [count, sum, max]
+        self._evage: List[float] = [0, 0.0, 0.0]  # guarded-by: _lock
+        self._gap: List[float] = [0, 0.0, 0.0]  # guarded-by: _lock
+        # Retry-After calibration: outstanding predictions
+        # [(t0, predicted_s, target_free)] and the resolved error
+        # aggregate {count, sum_err, sum_abs, max_abs}
+        self._pending: List[tuple] = []  # guarded-by: _lock
+        self._calib: Dict[str, float] = {  # guarded-by: _lock
+            "count": 0, "sum_error_s": 0.0, "sum_abs_error_s": 0.0,
+            "max_abs_error_s": 0.0,
+        }
+        self._m = None
+        if registry is not None:
+            from tpustack.obs import catalog
+
+            self._m = catalog.build(registry)
+        #: optional TenantLedger the scrape-time collector routes the
+        #: per-tenant gauges through (the ledger is the single writer of
+        #: tenant-labelled metrics — TPL502); the server wires it
+        self.ledger = None
+        sanitize.install_guards(self)
+
+    # ----------------------------------------------------------- wiring
+    def attach(self) -> "KVProfiler":
+        """Install the observer hooks on the pool (and trie, when one
+        exists).  Separated from ``__init__`` so a rate-0 deployment
+        never constructs, let alone attaches, a profiler."""
+        self.pool.profiler = self
+        if self.cache is not None:
+            self.cache.profiler = self
+        return self
+
+    # ------------------------------------------------------ access feed
+    def on_lookup(self, ids: Sequence[int],
+                  reuse_gap_s: Optional[float] = None) -> None:
+        """One prefix-cache lookup: sample its chunk accesses into the
+        stack-distance estimator.  Called OUTSIDE the trie lock."""
+        thresh = self._thresh
+        block = self.cache.block if self.cache is not None else self.pool.block
+        keys = chunk_hashes(ids, block)
+        tenant = obs_accounting.current_tenant.get() or UNATTRIBUTED
+        sampled = [k for k in keys if (k % _HASH_SPACE) < thresh]
+        m = self._m
+        if reuse_gap_s is not None and m is not None:
+            m["tpustack_llm_kv_reuse_gap_seconds"].observe(reuse_gap_s)
+        with self._lock:
+            self._counts["lookups"] += 1
+            if keys:
+                self._counts["chunk_accesses"] += len(keys)
+                self._tenant_accesses[tenant] = (
+                    self._tenant_accesses.get(tenant, 0) + len(keys))
+            if reuse_gap_s is not None:
+                self._gap[0] += 1
+                self._gap[1] += reuse_gap_s
+                self._gap[2] = max(self._gap[2], reuse_gap_s)
+            for k in sampled:
+                owner = self._samples.get(k)
+                if owner is None:
+                    d = _COLD
+                    self._counts["cold"] += 1
+                    if len(self._samples) >= self.MAX_SAMPLES:
+                        _, old_owner = self._samples.popitem(last=False)
+                        self._counts["sample_drops"] += 1
+                        left = self._tenant_ws.get(old_owner, 1) - 1
+                        if left > 0:
+                            self._tenant_ws[old_owner] = left
+                        else:
+                            self._tenant_ws.pop(old_owner, None)
+                    self._samples[k] = tenant
+                    self._tenant_ws[tenant] = (
+                        self._tenant_ws.get(tenant, 0) + 1)
+                else:
+                    # sampled-set stack distance: distinct sampled keys
+                    # touched since this key's last access (reverse scan
+                    # from the hot end — cost IS the distance, bounded by
+                    # MAX_SAMPLES and typically tiny for warm keys)
+                    d = 0
+                    for kk in reversed(self._samples):
+                        if kk == k:
+                            break
+                        d += 1
+                    if owner != tenant:  # ownership follows the last toucher
+                        left = self._tenant_ws.get(owner, 1) - 1
+                        if left > 0:
+                            self._tenant_ws[owner] = left
+                        else:
+                            self._tenant_ws.pop(owner, None)
+                        self._tenant_ws[tenant] = (
+                            self._tenant_ws.get(tenant, 0) + 1)
+                        self._samples[k] = tenant
+                    self._samples.move_to_end(k)
+                self._counts["accesses"] += 1
+                self._dists[d] = self._dists.get(d, 0) + 1
+                td = self._tenant_dists.setdefault(tenant, {})
+                td[d] = td.get(d, 0) + 1
+
+    # ---------------------------------------------------- pool lifetime
+    def on_block_alloc(self, n_blocks: int, now: float) -> None:
+        with self._lock:
+            self._counts["allocs"] += n_blocks
+
+    def on_block_free(self, ages: Sequence[float], now: float,
+                      n_free: int, outcome: Optional[str]) -> None:
+        """Blocks hit refcount 0: record their alloc→release ages under
+        the caller-declared outcome and resolve any 429 predictions whose
+        free-block target the pool just reached."""
+        label = outcome or "other"
+        resolved: List[tuple] = []
+        with self._lock:
+            self._counts["frees"] += len(ages)
+            agg = self._life.setdefault(label, [0, 0.0, 0.0])
+            for a in ages:
+                agg[0] += 1
+                agg[1] += a
+                agg[2] = max(agg[2], a)
+            if self._pending:
+                still = []
+                for p in self._pending:
+                    (still, resolved)[n_free >= p[2]].append(p)
+                self._pending = still
+                for t0, predicted, _ in resolved:
+                    err = (now - t0) - predicted
+                    self._calib["count"] += 1
+                    self._calib["sum_error_s"] += err
+                    self._calib["sum_abs_error_s"] += abs(err)
+                    self._calib["max_abs_error_s"] = max(
+                        self._calib["max_abs_error_s"], abs(err))
+        m = self._m
+        if m is not None:
+            h = m["tpustack_llm_kv_block_lifetime_seconds"]
+            for a in ages:
+                h.labels(outcome=label).observe(a)
+            for t0, predicted, _ in resolved:
+                m["tpustack_llm_kv_retry_after_error_seconds"].observe(
+                    abs((now - t0) - predicted))
+
+    # ------------------------------------------------------- trie evict
+    def on_evictions(self, hit_ages: Sequence[float], warm: int) -> None:
+        """An evict() pass dropped entries: ``hit_ages`` is seconds since
+        each evicted entry's last hit; ``warm`` of them were inside the
+        TPUSTACK_KVPROF_WARM_S window."""
+        with self._lock:
+            for a in hit_ages:
+                self._evage[0] += 1
+                self._evage[1] += a
+                self._evage[2] = max(self._evage[2], a)
+        m = self._m
+        if m is not None:
+            h = m["tpustack_llm_kv_eviction_age_seconds"]
+            for a in hit_ages:
+                h.observe(a)
+            if warm:
+                m["tpustack_llm_prefix_evicted_warm_total"].inc(warm)
+
+    # ----------------------------------------------- 429 calibration
+    def note_retry_after(self, shortfall_blocks: int,
+                         predicted_s: float) -> None:
+        """A paged 429 just answered ``Retry-After: predicted_s`` for a
+        ``shortfall_blocks`` deficit — arm the observation: the release
+        wall is measured when the pool's free count first covers the
+        shortfall."""
+        target = min(self.pool.capacity_blocks,
+                     self.pool.n_free + max(1, int(shortfall_blocks)))
+        with self._lock:
+            if len(self._pending) >= self.MAX_PENDING:
+                self._pending.pop(0)
+            self._pending.append((time.time(), float(predicted_s), target))
+
+    # --------------------------------------------------------- reading
+    def _hit_ratio_locked(self, dists: Dict[int, int],
+                          capacity_blocks: float,
+                          total_accesses: Optional[int] = None
+                          ) -> Optional[float]:
+        sampled = sum(dists.values())
+        if not sampled or self.rate <= 0:
+            return None
+        hits = 0.0
+        for d, n in dists.items():
+            if d == _COLD:
+                continue
+            # scaled LRU stack position: 1/rate distinct blocks per
+            # sampled distance step, +1 for the block itself
+            if d / self.rate + 1.0 <= capacity_blocks:
+                hits += n
+        if total_accesses:
+            # SHARDS_adj (Waldspurger et al.): the spatial sample should
+            # carry rate x total accesses; the realized sample deviates
+            # when popular keys (dis)proportionately land in it.  Credit
+            # the deficit/excess to the shortest-distance bucket — hits
+            # at any nonzero capacity — and express the ratio over the
+            # EXPECTED mass.  Exact sample (rate=1) => diff 0, unchanged.
+            expected = total_accesses * self.rate
+            if expected > 0:
+                hits = min(max(hits + (expected - sampled), 0.0), expected)
+                return hits / expected
+        return hits / sampled
+
+    def _curve_locked(self, dists: Dict[int, int], capacity: int,
+                      total_accesses: Optional[int] = None
+                      ) -> List[Dict[str, object]]:
+        out = []
+        for s in _CURVE_SCALES:
+            r = self._hit_ratio_locked(dists, capacity * s, total_accesses)
+            out.append({"scale": s, "capacity_blocks": int(capacity * s),
+                        "hit_ratio": r})
+        return out
+
+    def snapshot(self) -> Dict[str, object]:
+        """The ``GET /debug/kvcache`` payload: curve points, working set,
+        per-tenant split, lifetime/eviction/gap summaries, calibration."""
+        capacity = self.pool.capacity_blocks
+        with self._lock:
+            inv = (1.0 / self.rate) if self.rate > 0 else 0.0
+            tenants: Dict[str, Dict[str, object]] = {}
+            for t, n in sorted(self._tenant_ws.items()):
+                td = self._tenant_dists.get(t, {})
+                ta = self._tenant_accesses.get(t)
+                tenants[t] = {
+                    "working_set_blocks": round(n * inv, 1),
+                    "hit_ratio_1x": self._hit_ratio_locked(
+                        td, capacity, ta),
+                    "hit_ratio_2x": self._hit_ratio_locked(
+                        td, 2 * capacity, ta),
+                }
+            life = {o: {"count": int(c), "mean_s": (s / c if c else 0.0),
+                        "max_s": mx}
+                    for o, (c, s, mx) in sorted(self._life.items())}
+            calib = dict(self._calib)
+            if calib["count"]:
+                calib["mean_error_s"] = calib["sum_error_s"] / calib["count"]
+                calib["mean_abs_error_s"] = (
+                    calib["sum_abs_error_s"] / calib["count"])
+            calib["pending"] = len(self._pending)
+            total = self._counts["chunk_accesses"]
+            snap = {
+                "rate": self.rate,
+                "block_tokens": self.pool.block,
+                "capacity_blocks": capacity,
+                "lookups": self._counts["lookups"],
+                "sampled_accesses": self._counts["accesses"],
+                "chunk_accesses": total,
+                "sampled_keys": len(self._samples),
+                "sample_drops": self._counts["sample_drops"],
+                "working_set_blocks": round(len(self._samples) * inv, 1),
+                "distinct_blocks_est": round(self._counts["cold"] * inv, 1),
+                "curve": self._curve_locked(self._dists, capacity, total),
+                "counterfactual_hit_ratio": {
+                    f"{s:g}x": self._hit_ratio_locked(self._dists,
+                                                      capacity * s, total)
+                    for s in CAPACITY_SCALES},
+                "tenants": tenants,
+                "block_lifetime": life,
+                "eviction_age": {"count": int(self._evage[0]),
+                                 "mean_s": (self._evage[1] / self._evage[0]
+                                            if self._evage[0] else 0.0),
+                                 "max_s": self._evage[2]},
+                "reuse_gap": {"count": int(self._gap[0]),
+                              "mean_s": (self._gap[1] / self._gap[0]
+                                         if self._gap[0] else 0.0),
+                              "max_s": self._gap[2]},
+                "calibration": calib,
+                "pool_events": {"alloc_blocks": self._counts["allocs"],
+                                "freed_blocks": self._counts["frees"]},
+            }
+        # pool/cache stats OUTSIDE the profiler lock (they take their own)
+        snap["pool"] = self.pool.stats()
+        if self.cache is not None:
+            snap["prefix_cache"] = self.cache.stats()
+        return snap
+
+    def tenant_working_sets(self) -> Dict[str, Dict[str, object]]:
+        """Per-tenant working set + counterfactual hit ratios — the slice
+        the ledger exports as bounded tenant gauges and /debug/tenants
+        embeds."""
+        capacity = self.pool.capacity_blocks
+        with self._lock:
+            inv = (1.0 / self.rate) if self.rate > 0 else 0.0
+            out = {}
+            for t, n in sorted(self._tenant_ws.items()):
+                td = self._tenant_dists.get(t, {})
+                ta = self._tenant_accesses.get(t)
+                out[t] = {
+                    "working_set_blocks": round(n * inv, 1),
+                    "hit_ratio_1x": self._hit_ratio_locked(
+                        td, capacity, ta),
+                    "hit_ratio_2x": self._hit_ratio_locked(
+                        td, 2 * capacity, ta),
+                }
+            return out
+
+    # ------------------------------------------------------ scrape-time
+    def collect(self, registry) -> None:
+        """Scrape-time gauge refresh (``Registry.add_collector``): the
+        counterfactual hit-rate curve points and the working-set size.
+        Histograms are observed at event time; only the derived gauges
+        are computed here, when Prometheus asks."""
+        if self._m is None:
+            return
+        capacity = self.pool.capacity_blocks
+        with self._lock:
+            inv = (1.0 / self.rate) if self.rate > 0 else 0.0
+            ws = len(self._samples) * inv
+            total = self._counts["chunk_accesses"]
+            ratios = {f"{s:g}x": self._hit_ratio_locked(self._dists,
+                                                        capacity * s, total)
+                      for s in CAPACITY_SCALES}
+        self._m["tpustack_llm_kv_working_set_blocks"].set(ws)
+        g = self._m["tpustack_llm_kv_counterfactual_hit_ratio"]
+        for label, r in ratios.items():
+            if r is not None:
+                g.labels(capacity=label).set(r)
+        if self.ledger is not None:
+            self.ledger.export_kv_working_sets(self.tenant_working_sets())
+
+
+def from_env(pool, cache=None, registry=None,
+             name: str = "llm") -> Optional[KVProfiler]:
+    """Build + attach a profiler per ``TPUSTACK_KVPROF_RATE`` — None at
+    rate 0 (the bisection contract: nothing constructs, nothing hooks,
+    the pool/trie hot paths never see a non-None ``profiler``)."""
+    rate = knobs.get_float("TPUSTACK_KVPROF_RATE")
+    if rate <= 0:
+        return None
+    prof = KVProfiler(pool, cache=cache, rate=rate, registry=registry,
+                      name=name).attach()
+    log.info("KV working-set profiler on: rate=%.3g, pool=%d blocks x %d "
+             "tokens", prof.rate, pool.capacity_blocks, pool.block)
+    return register(prof)
+
+
+# ------------------------------------------------------ process registry
+_REG_LOCK = threading.Lock()
+_PROFILERS: List[KVProfiler] = []
+
+
+def register(prof: KVProfiler) -> KVProfiler:
+    """Track ``prof`` for the metrics sidecar's ``/debug/kvcache`` (the
+    flight-recorder registration pattern)."""
+    with _REG_LOCK:
+        if prof not in _PROFILERS:
+            _PROFILERS.append(prof)
+    return prof
+
+
+def snapshot_all() -> Dict[str, object]:
+    """Every registered profiler's snapshot keyed by name — the sidecar's
+    ``/debug/kvcache`` payload."""
+    with _REG_LOCK:
+        profs = list(_PROFILERS)
+    if not profs:
+        return {"enabled": False}
+    return {p.name: p.snapshot() for p in profs}

@@ -104,6 +104,22 @@ GUARDED: Dict[Tuple[str, str], Tuple[GuardedSpec, ...]] = {
     ("tpustack.models.llm_continuous", "ContinuousEngine"): (
         _s("_fetch_marks", "_marks_lock"),
     ),
+    ("tpustack.obs.kvprof", "KVProfiler"): (
+        _s("_samples", "_lock",
+           note="OrderedDict (move_to_end LRU order): passes through the "
+                "container wrapper unproxied; rebinds descriptor-checked, "
+                "mutations covered by TPL201"),
+        _s("_tenant_ws", "_lock"),
+        _s("_tenant_accesses", "_lock"),
+        _s("_dists", "_lock"),
+        _s("_tenant_dists", "_lock"),
+        _s("_counts", "_lock"),
+        _s("_life", "_lock"),
+        _s("_evage", "_lock"),
+        _s("_gap", "_lock"),
+        _s("_pending", "_lock"),
+        _s("_calib", "_lock"),
+    ),
 }
 
 #: module -> repo-relative file, for tpulint TPL203's annotation parse

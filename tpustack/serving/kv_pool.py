@@ -45,7 +45,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tpustack import sanitize
-from tpustack.utils import get_logger
+from tpustack.utils import get_logger, knobs
 
 log = get_logger("serving.kv_pool")
 
@@ -122,6 +122,11 @@ class KVBlockPool:
         # cumulative block-seconds of every block's full alloc→free
         # lifetime (accumulated when a block returns to the free list)
         self.block_seconds_total = 0.0  # guarded-by: _lock (writes)
+        #: optional observer (tpustack.obs.kvprof.KVProfiler) notified of
+        #: alloc/free events OUTSIDE the allocator lock; None (the
+        #: TPUSTACK_KVPROF_RATE=0 default) keeps alloc/decref exactly the
+        #: profiler-free paths
+        self.profiler = None
         sanitize.install_guards(self)
 
     # ------------------------------------------------------------ capacity
@@ -150,6 +155,7 @@ class KVBlockPool:
         :class:`OutOfBlocks` without side effects when the pool is short —
         admission must gate, not half-allocate."""
         need = self.blocks_for(n_tokens)
+        now = time.time()
         with self._lock:
             if need > len(self._free):
                 raise OutOfBlocks(
@@ -157,14 +163,16 @@ class KVBlockPool:
                     f"{len(self._free)} free of {self.capacity_blocks}")
             ids = [self._free.popleft() for _ in range(need)]
             remaining = n_tokens
-            now = time.time()
             for bid in ids:
                 self._ref[bid] = 1
                 self._filled[bid] = min(self.block, remaining)
                 self._alloc_t[bid] = now
                 remaining -= min(self.block, remaining)
             self.allocated_blocks_total += need
-            return ids
+        prof = self.profiler
+        if prof is not None and need:
+            prof.on_block_alloc(need, now)
+        return ids
 
     def incref(self, ids: Sequence[int]) -> None:
         with self._lock:
@@ -173,12 +181,21 @@ class KVBlockPool:
                     raise ValueError(f"incref on free block {bid}")
                 self._ref[bid] += 1
 
-    def decref(self, ids: Sequence[int]) -> int:
+    def decref(self, ids: Sequence[int],
+               outcome: Optional[str] = None) -> int:
         """Drop one reference per id; blocks reaching 0 return to the free
-        list.  Returns how many were actually freed."""
+        list.  Returns how many were actually freed.
+
+        ``outcome`` names WHY the reference dropped for the profiler's
+        block-lifetime split — "retired" (sequence completed), "evicted_warm"
+        / "evicted_cold" (prefix-cache eviction), "died_queued" (released
+        before ever decoding) — and is ignored when no profiler is
+        attached."""
         freed = 0
         now = time.time()
+        ages: List[float] = []
         with self._lock:
+            track = self.profiler is not None
             for bid in ids:
                 if self._ref[bid] <= 0:
                     raise ValueError(f"decref on free block {bid}")
@@ -186,12 +203,18 @@ class KVBlockPool:
                 if self._ref[bid] == 0:
                     self._filled[bid] = 0
                     if self._alloc_t[bid]:
-                        self.block_seconds_total += max(
-                            0.0, now - self._alloc_t[bid])
+                        age = max(0.0, now - self._alloc_t[bid])
+                        self.block_seconds_total += age
                         self._alloc_t[bid] = 0.0
+                        if track:
+                            ages.append(age)
                     self._free.append(bid)
                     freed += 1
             self.freed_blocks_total += freed
+            n_free = len(self._free)
+        prof = self.profiler
+        if prof is not None and freed:
+            prof.on_block_free(ages, now, n_free, outcome)
         return freed
 
     def refcount(self, bid: int) -> int:
@@ -258,7 +281,8 @@ class _Node:
     """One block of a cached prefix: edge label = its token ids, payload =
     the physical block id (the cache holds one pool reference on it)."""
 
-    __slots__ = ("key", "parent", "children", "block_id", "last_used", "uid")
+    __slots__ = ("key", "parent", "children", "block_id", "last_used",
+                 "last_hit_wall", "uid")
 
     def __init__(self, key: Tuple[int, ...], parent: Optional["_Node"],
                  block_id: int):
@@ -267,6 +291,10 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.block_id = block_id
         self.last_used = 0
+        # wall clock of the last touch (insert or match hit) — what the
+        # eviction path reads to tell an avoidable warm eviction from a
+        # cold one, and what the reuse-gap histogram measures between
+        self.last_hit_wall = 0.0
         self.uid = next(_NODE_UIDS)
 
 
@@ -294,13 +322,23 @@ class PagedPrefixCache:
     demand when admission runs short of free blocks.
     """
 
-    def __init__(self, pool: KVBlockPool, on_evict=None):
+    def __init__(self, pool: KVBlockPool, on_evict=None,
+                 on_evict_warm=None, warm_s: Optional[float] = None):
         self.pool = pool
         self.block = pool.block
         #: optional hook called (outside the lock) with the number of
         #: blocks an evict() pass freed — the server bumps its eviction
         #: counter here, mirroring the dense store's contract
         self.on_evict = on_evict
+        #: optional hook: how many of an evict() pass's victims were WARM
+        #: (last hit within warm_s — evictions more capacity would have
+        #: avoided); the server bumps the warm-eviction counter here
+        self.on_evict_warm = on_evict_warm
+        self.warm_s = (knobs.get_float("TPUSTACK_KVPROF_WARM_S")
+                       if warm_s is None else float(warm_s))
+        #: optional observer (tpustack.obs.kvprof.KVProfiler) fed lookup
+        #: and eviction events OUTSIDE the trie lock; None = profiler off
+        self.profiler = None
         self._root = _Node((), None, -1)  # guarded-by: _lock (writes)
         self._lock = threading.Lock()
         self._tick = 0  # guarded-by: _lock (writes)
@@ -312,6 +350,8 @@ class PagedPrefixCache:
         self.evictions = 0
         self.hit_tokens = 0
         self.inserted_tokens = 0
+        self.evicted_warm_total = 0
+        self.evicted_cold_total = 0
         sanitize.install_guards(self)
 
     # ------------------------------------------------------------- lookup
@@ -320,6 +360,8 @@ class PagedPrefixCache:
         ``len(ids) - 1`` tokens).  Increfs every matched block before
         returning — the caller owns those references (see PagedMatch)."""
         max_blocks = max(0, (len(ids) - 1) // self.block)
+        now = time.time()
+        prev_hit = 0.0
         with self._lock:
             self._tick += 1
             self.lookups += 1
@@ -330,15 +372,26 @@ class PagedPrefixCache:
                 if child is None:
                     break
                 child.last_used = self._tick
+                prev_hit = child.last_hit_wall
+                child.last_hit_wall = now
                 blocks.append(child.block_id)
                 node, depth = child, depth + 1
             if not blocks:
                 self.misses += 1
-                return PagedMatch(0, [])
-            self.pool.incref(blocks)
-            self.hits += 1
-            self.hit_tokens += depth * self.block
-            return PagedMatch(depth * self.block, blocks)
+                res = PagedMatch(0, [])
+            else:
+                self.pool.incref(blocks)
+                self.hits += 1
+                self.hit_tokens += depth * self.block
+                res = PagedMatch(depth * self.block, blocks)
+        prof = self.profiler
+        if prof is not None:
+            # reuse gap = time since the DEEPEST matched node's previous
+            # touch (the prefix's whole-entry revisit interval); misses
+            # and first touches carry no gap
+            gap = (now - prev_hit) if (blocks and prev_hit) else None
+            prof.on_lookup(ids, reuse_gap_s=gap)
+        return res
 
     # ------------------------------------------------------------- insert
     def insert(self, ids: List[int], block_ids: Sequence[int]) -> int:
@@ -354,6 +407,7 @@ class PagedPrefixCache:
                 f"{len(block_ids)} blocks cover "
                 f"{len(block_ids) * self.block} tokens > prompt {len(ids)}")
         new_tokens = 0
+        now = time.time()
         with self._lock:
             self._tick += 1
             node = self._root
@@ -367,6 +421,7 @@ class PagedPrefixCache:
                     self.entries += 1
                     new_tokens += self.block
                 child.last_used = self._tick
+                child.last_hit_wall = now
                 node = child
             self.inserted_tokens += new_tokens
         return new_tokens
@@ -392,6 +447,9 @@ class PagedPrefixCache:
         import heapq
 
         freed = 0
+        warm = 0
+        now = time.time()
+        hit_ages: List[float] = []
         with self._lock:
             heap = [(n.last_used, n.uid, n) for n in self._walk()
                     if not n.children
@@ -407,7 +465,20 @@ class PagedPrefixCache:
                 leaf.parent.children.pop(leaf.key)
                 self.entries -= 1
                 self.evictions += 1
-                freed += self.pool.decref([leaf.block_id])
+                # warm = the entry was hit recently enough that a bigger
+                # pool would plausibly have kept it (avoidable eviction)
+                age = ((now - leaf.last_hit_wall)
+                       if leaf.last_hit_wall else -1.0)
+                if 0.0 <= age <= self.warm_s:
+                    warm += 1
+                    self.evicted_warm_total += 1
+                    outcome = "evicted_warm"
+                else:
+                    self.evicted_cold_total += 1
+                    outcome = "evicted_cold"
+                if age >= 0.0:
+                    hit_ages.append(age)
+                freed += self.pool.decref([leaf.block_id], outcome=outcome)
                 parent = leaf.parent
                 if (parent is not self._root and not parent.children
                         and self.pool.refcount(parent.block_id) == 1):
@@ -415,9 +486,14 @@ class PagedPrefixCache:
                                    (parent.last_used, parent.uid, parent))
         if freed:
             log.info("paged prefix cache evicted %d block(s) "
-                     "(%d tokens)", freed, freed * self.block)
+                     "(%d tokens, %d warm)", freed, freed * self.block, warm)
             if self.on_evict is not None:
                 self.on_evict(freed)
+            if warm and self.on_evict_warm is not None:
+                self.on_evict_warm(warm)
+            prof = self.profiler
+            if prof is not None:
+                prof.on_evictions(hit_ages, warm)
         return freed
 
     def _walk(self):
@@ -448,6 +524,8 @@ class PagedPrefixCache:
                 "misses": self.misses,
                 "hit_rate": (self.hits / lookups) if lookups else 0.0,
                 "evictions": self.evictions,
+                "evicted_warm": self.evicted_warm_total,
+                "evicted_cold": self.evicted_cold_total,
                 "cached_tokens_served": self.hit_tokens,
                 "inserted_tokens": self.inserted_tokens,
             }

@@ -396,6 +396,32 @@ class LLMServer:
             self.paged.cache.on_evict = (
                 lambda n: self.metrics[
                     "tpustack_llm_prefix_cache_evictions_total"].inc(n))
+        if self.paged is not None and self.paged.cache is not None:
+            # warm-eviction visibility rides the unconditional last-hit
+            # stamping (kv_pool) — counted whether or not the profiler is on
+            self.paged.cache.on_evict_warm = (
+                lambda n: self.metrics[
+                    "tpustack_llm_prefix_evicted_warm_total"].inc(n))
+        # KV working-set observatory (tpustack.obs.kvprof): SHARDS-sampled
+        # online miss-ratio curve, block-lifetime telemetry, Retry-After
+        # calibration — observer hooks on the pool/trie, gauges refreshed
+        # by a scrape-time collector, served on GET /debug/kvcache.
+        # TPUSTACK_KVPROF_RATE=0 constructs nothing and attaches nothing.
+        self.kvprof = None
+        if self.paged is not None:
+            from tpustack.obs import kvprof as obs_kvprof
+            from tpustack.obs.metrics import REGISTRY as _default_registry
+
+            # resolve the registry the way every other component does —
+            # a None here would leave the profiler metrics-free (the
+            # bench/replay snapshot-only mode), silencing the scrape
+            # gauges on a production boot
+            self.kvprof = obs_kvprof.from_env(
+                self.paged.pool, cache=self.paged.cache,
+                registry=(registry if registry is not None
+                          else _default_registry))
+            if self.kvprof is not None:
+                self.kvprof.ledger = self.ledger
         # speculative decoding (tpustack.serving.speculative.SpecConfig):
         # tests pass a SpecConfig (or None for hard off); serving builds
         # from TPUSTACK_SPEC_TOKENS & friends, default ON — the engine's
@@ -466,6 +492,11 @@ class LLMServer:
 
         (registry if registry is not None else REGISTRY).add_collector(
             self._flight_collector)
+        if self.kvprof is not None:
+            # working-set / counterfactual gauges are derived state:
+            # computed when Prometheus asks, like the roofline gauges
+            (registry if registry is not None else REGISTRY).add_collector(
+                self.kvprof.collect)
         self._export_mesh_gauges()
         # committed perf baselines (bench/baselines) as info gauges: a
         # scrape shows which bench bar this server build is held to
@@ -729,10 +760,15 @@ class LLMServer:
                 ra = None
         if ra is None:
             return self.resilience.retry_after_s()
-        ra = min(max(1, math.ceil(ra)), 120)
+        clamped = min(max(1, math.ceil(ra)), 120)
         self.metrics["tpustack_retry_after_seconds"].labels(
-            server="llm").set(ra)
-        return ra
+            server="llm").set(clamped)
+        if self.kvprof is not None:
+            # calibration: arm the RAW estimate (not the clamp) against
+            # the observed release wall — the 429's admission math is
+            # what item 4's host tier reuses, so IT is what's measured
+            self.kvprof.note_retry_after(shortfall_blocks, float(ra))
+        return clamped
 
     def _paged_admit(self, ids, n_predict: int, cache_prompt: bool):
         """Admission + prefix hooks for the paged engine, in ONE step: the
@@ -818,7 +854,7 @@ class LLMServer:
                 self.ledger.charge_kv_block_seconds(
                     r.tenant,
                     len(ids) * max(0.0, time.time() - r.t_kv_alloc))
-            self.paged.pool.decref(ids)
+            self.paged.pool.decref(ids, outcome="died_queued")
             self._paged_gauges()
 
     def _prefix_lookup(self, ids, allow: bool = True):
@@ -1855,7 +1891,9 @@ class LLMServer:
                          self.resilience.middleware(work)])
         obs_http.add_debug_trace_routes(app, self.tracer)
         obs_http.add_debug_flight_routes(app, self.flight)
-        obs_http.add_debug_tenant_routes(app, self.ledger, qos=self.qos)
+        obs_http.add_debug_tenant_routes(app, self.ledger, qos=self.qos,
+                                         kvprof=self.kvprof)
+        obs_http.add_debug_kvcache_routes(app, self.kvprof)
         app.router.add_get("/health", self.health)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)

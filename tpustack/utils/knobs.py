@@ -231,6 +231,16 @@ _declare("TPUSTACK_TENANT_DEFAULT", str, "anonymous",
 _declare("TPUSTACK_REPLAY_URL", str, "",
          "Default target URL for tools/replay.py (the in-cluster replay "
          "Job sets it); empty = the tool's --url default.")
+_declare("TPUSTACK_KVPROF_RATE", float, 0.1,
+         "Spatial sampling rate for the KV working-set profiler "
+         "(tpustack.obs.kvprof): fraction of the token-chunk key space "
+         "whose reuse distances feed the online miss-ratio curve; 0 is "
+         "the bisection flag — no profiler constructs, no hooks attach, "
+         "the serving path is byte-identical.")
+_declare("TPUSTACK_KVPROF_WARM_S", float, 30.0,
+         "Warm-eviction window: a prefix-cache entry evicted within this "
+         "many seconds of its last hit counts as evicted-warm (an "
+         "avoidable eviction) rather than evicted-cold.")
 
 # --------------------------------------------------------------------- QoS
 _declare("TPUSTACK_QOS", bool, True,
