@@ -50,6 +50,16 @@ def test_llm_extras_schema(monkeypatch):
                    # qos counters ride the replay cell too
                    "priorities": {"batch": {"shed": 2}},
                    "server_qos": {"counters": {"shed": {"batch": 2}}},
+                   # host-tier + chunked-prefill cells: off/on comparison
+                   # tables and the tier's conservation ledger ride the
+                   # same keep list
+                   "tier_off": {"prefix_hit_ratio": 0.1},
+                   "tier_on": {"prefix_hit_ratio": 0.6},
+                   "host_tier": {"spilled_total": 23, "restored_total": 14},
+                   "ttft_p99_speedup": 1.4,
+                   "chunk_off": {"prefill_chunks": 0},
+                   "chunk_on": {"prefill_chunks": 3},
+                   "prefill_chunk_tokens": 512,
                    # KV working-set observatory snapshots: the paged
                    # bench's per-pool profiler view and the replay
                    # server's /debug/kvcache ride the same keep list
@@ -71,7 +81,8 @@ def test_llm_extras_schema(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     out = bench._llm_extras(lambda *a: None)
     assert set(out) == {"continuous_e2e", "prefill_8k", "shared_prefix",
-                        "paged", "speculative", "tp", "replay"}
+                        "paged", "speculative", "host_tier",
+                        "chunked_prefill", "tp", "replay"}
     for sub in out.values():
         assert sub["value"] == 1.0
         assert sub["steady_decode_tokens_per_sec"] == 2.0
@@ -97,6 +108,13 @@ def test_llm_extras_schema(monkeypatch):
     assert out["paged"]["kvprof"]["working_set_blocks"] == 12.0
     assert out["paged"]["kvprof"]["counterfactual_hit_ratio"]["2x"] == 0.8
     assert out["replay"]["server_kvcache"]["working_set_blocks"] == 9.0
+    # the host-tier ledger + off/on tables ride the host_tier cell, the
+    # chunk tables ride chunked_prefill
+    assert out["host_tier"]["host_tier"]["spilled_total"] == 23
+    assert out["host_tier"]["tier_on"]["prefix_hit_ratio"] == 0.6
+    assert out["host_tier"]["ttft_p99_speedup"] == 1.4
+    assert out["chunked_prefill"]["chunk_on"]["prefill_chunks"] == 3
+    assert out["chunked_prefill"]["prefill_chunk_tokens"] == 512
     # the bench replay scenario is mixed-priority (one tenant per class)
     assert any(":interactive" in " ".join(c) and ":batch" in " ".join(c)
                for c in calls)

@@ -362,7 +362,12 @@ def test_kv_report_tiny_smoke(tmp_path):
     rep = json.loads(out.read_text())
     assert rep["metric"] == "kv_working_set_report"
     assert rep["capacity_blocks"] >= 1 and rep["ok"]
-    assert len(rep["table"]) == 6
+    # 6 counterfactual scale rows + the round-17 labeled host_tier point
+    # (the self-hosted tiny server runs with its host KV tier on)
+    assert len(rep["table"]) == 7
+    labels = [r.get("label") for r in rep["table"]]
+    assert labels.count("host_tier") == 1 and labels.count(None) == 6
+    assert rep["host_tier"]["capacity_bytes"] > 0
 
 
 # ------------------------------------------------- the =0 bisection path

@@ -369,6 +369,304 @@ def _paged_bench(args, gen, cfg, log, watch, t0) -> int:
     }, t0, sig)
 
 
+def _host_tier_bench(args, gen, cfg, log, watch, t0) -> int:
+    """``--host-tier``: the working-set-≫-pool workload the host KV tier
+    exists for — ``--docs`` distinct document preambles (each several
+    full blocks of shared prompt) revisited under a seeded Zipf skew,
+    against a pool deliberately sized to ~1/3 of the document working
+    set.  Runs the SAME schedule twice, tier OFF then tier ON
+    (``--host-tier-mb`` arena, admission mirroring the server's
+    ``_paged_admit`` flow: match → claim → fresh restore blocks riding
+    the prefix refcount lifecycle), and reports prefix hit ratio,
+    TTFT p50/p99, and the tier's spill/restore/expire ledger — greedy
+    outputs asserted identical, plus a free-block leak check.
+
+    On the tiny CPU preset the crossover guard is forced off: both of
+    its EMAs measure dispatch overhead there, not HBM copies vs MXU
+    prefill, so the guard would (correctly, for CPU) decline every
+    restore and the smoke would pin zeros."""
+    import random
+
+    from tpustack.models.llama import init_kv_pool
+    from tpustack.models.llm_continuous import ContinuousEngine, SlotRequest
+    from tpustack.models.llm_generate import SampleConfig
+    from tpustack.obs.kvprof import KVProfiler
+    from tpustack.serving.kv_host_tier import HostKVTier
+    from tpustack.serving.kv_pool import (KVBlockPool, OutOfBlocks,
+                                          PagedKVRuntime, PagedPrefixCache)
+
+    sample = SampleConfig(greedy=True)
+    ctx, vocab = cfg.max_seq, cfg.vocab_size
+    block = max(1, min(args.kv_block, ctx))
+    while block > 1 and ctx % block:
+        block //= 2
+    tail = max(1, min(args.unique_tokens, block - 1))
+    new = max(4, min(args.new_tokens, block))
+    n_docs = max(2, args.docs)
+    doc_blocks = max(2, min(args.prompt_tokens // block,
+                            (ctx - tail - new) // block - 1))
+    need = (doc_blocks * block + tail + new + block - 1) // block
+    # pool ~1/3 of the working set: cold revisits are the norm
+    pool_blocks = max(need + 1, (n_docs * doc_blocks) // 3)
+    dchunk = min(args.chunk, new)
+    # the guard is a TPU-economics comparison; see docstring
+    crossover = False if args.preset == "tiny" else None
+
+    doc = lambda d: [(3 + d * 131 + j) % (vocab - 1) + 1
+                     for j in range(doc_blocks * block)]
+    tail_ids = lambda i: [(7000 + i * tail + j) % (vocab - 1) + 1
+                          for j in range(tail)]
+    # schedule: one cold pass over every document, then seeded Zipf
+    # revisits (hot docs revisit often enough to stay HBM-resident; the
+    # cold tail is what the tier converts from recompute to restore)
+    rnd = random.Random(17)
+    revisits = rnd.choices(range(n_docs),
+                           weights=[1.0 / (d + 1) for d in range(n_docs)],
+                           k=max(args.requests, n_docs))
+    schedule = list(range(n_docs)) + revisits
+
+    def admit(rt, cache, tier, ids):
+        """The server's ``_paged_admit`` flow, bench-side: prefix hit
+        increfs shared blocks; claimed host payloads get fresh pool
+        blocks riding the prefix refcount lifecycle (a full pool
+        abandons the claims — conservation ledger stays exact)."""
+        prefix, host_restore = None, None
+        m = cache.match(ids)
+        if m.length:
+            prefix = (m.length, m.block_ids)
+        if m.host_payloads:
+            n_host = len(m.host_payloads)
+            try:
+                rt.ensure_free(n_host)
+                restore_ids = rt.pool.alloc_tokens(n_host * rt.block)
+            except OutOfBlocks:
+                tier.abandon(n_host)
+            else:
+                prefix = (m.length + n_host * rt.block,
+                          m.block_ids + list(restore_ids))
+                host_restore = (restore_ids, m.host_payloads)
+        n_shared = len(prefix[1]) if prefix else 0
+        fresh = rt.need_tokens(len(ids), new) - n_shared * rt.block
+        rt.ensure_free(rt.pool.blocks_for(fresh))
+        kv_blocks = rt.pool.alloc_tokens(fresh)
+        on_insert = (lambda bids, ids_c=list(ids): cache.insert(ids_c, bids))
+        return prefix, kv_blocks, on_insert, host_restore
+
+    def run_mode(tier_mb, order):
+        pool = KVBlockPool(pool_blocks + 1, block)
+        rt = PagedKVRuntime(
+            init_kv_pool(cfg, pool_blocks + 1, block,
+                         dtype=gen.cache_dtype),
+            pool, ctx, cache=None)
+        cache = PagedPrefixCache(pool)
+        rt.cache = cache
+        tier = None
+        if tier_mb:
+            cache.host_tier = tier = HostKVTier(
+                int(tier_mb * 1024 * 1024), pool,
+                arrays_fn=lambda: rt.arrays, crossover=crossover)
+        kvprof = KVProfiler(pool, cache, rate=1.0).attach()
+        results = {}
+        queue = list(enumerate(order))
+
+        def feed():
+            # serial (slots=1): admission happens exactly when a slot
+            # frees, after the previous request's resolve-time insert —
+            # the spill/restore sequence is deterministic, so the tier
+            # counters can sit in the perf signature
+            if not queue:
+                return None
+            i, d = queue.pop(0)
+            ids = doc(d) + tail_ids(i)
+            prefix, kv_blocks, on_insert, host_restore = admit(
+                rt, cache, tier, ids)
+            return SlotRequest(
+                ids=ids, max_new=new, sample=sample, prefix=prefix,
+                kv_blocks=kv_blocks, on_prefill_blocks=on_insert,
+                host_restore=host_restore,
+                on_done=lambda t, s, i=i: results.__setitem__(i, (t, s)))
+
+        eng = ContinuousEngine(gen, slots=1, chunk=dchunk, paged=rt)
+        eng.run(feed)
+        ttfts = sorted(st["prefill_s"] for _, st in results.values())
+        q = lambda p: ttfts[min(len(ttfts) - 1,
+                                int(round(p * (len(ttfts) - 1))))]
+        cached = sum(st["cached_tokens"] for _, st in results.values())
+        prompt_toks = sum(st["cached_tokens"] + st["prefill_tokens"]
+                          for _, st in results.values())
+        snap = kvprof.snapshot()
+        tier_stats = tier.stats() if tier is not None else None
+        # teardown leak check: detach the tier first (a final evict-all
+        # must not spill — the captured ledger is the run's), then every
+        # unreferenced cached block must free back to the pool
+        cache.host_tier = None
+        cache.evict(pool.capacity_blocks)
+        out = {
+            "prefix_hit_ratio": round(cached / max(1, prompt_toks), 4),
+            "prefix_cached_tokens": cached,
+            "prompt_tokens": prompt_toks,
+            "ttft_p50_ms": round(q(0.50) * 1e3, 2),
+            "ttft_p99_ms": round(q(0.99) * 1e3, 2),
+        }
+        return results, out, tier_stats, snap, pool.n_used == 0
+
+    # warm (uncounted, separate pool/cache): compiles prefill + decode +
+    # the host-restore scatter for this shape, so the measured modes are
+    # compile-warm on the SAME programs
+    run_mode(args.host_tier_mb, list(range(min(3, n_docs))) + [0, 1])
+
+    res_off, off, _, _, leak_off = run_mode(0, schedule)
+    log(f"[bench_llm] host tier OFF: {off}")
+    res_on, on, tier_st, kvprof_snap, leak_on = run_mode(
+        args.host_tier_mb, schedule)
+    log(f"[bench_llm] host tier ON:  {on} | spilled "
+        f"{tier_st['spilled_total']} restored {tier_st['restored_total']} "
+        f"expired {tier_st['expired_total']}")
+    identical = all(res_off[i][0] == res_on[i][0]
+                    for i in range(len(schedule)))
+    if not identical:
+        log("[bench_llm] WARNING: tier-on outputs diverged from tier-off")
+    leak_ok = leak_off and leak_on
+    from tpustack.obs import perfsig
+
+    sig = perfsig.signature(watch=watch, extra={
+        "host.spilled": tier_st["spilled_total"],
+        "host.restored": tier_st["restored_total"],
+        "host.expired": tier_st["expired_total"],
+        "host.declined": tier_st["spill_declined_total"],
+        "host.off.cached_tokens": off["prefix_cached_tokens"],
+        "host.on.cached_tokens": on["prefix_cached_tokens"],
+        "kv_pool.block_tokens": block,
+        "kv_pool.pool_blocks": pool_blocks,
+        "outputs_identical": identical,
+        "leak_check_ok": leak_ok})
+    return _emit({
+        "metric": f"{args.preset}_{args.quant or 'bf16'}_ctx{args.ctx}"
+                  f"_host_tier_hit_ratio",
+        "value": on["prefix_hit_ratio"],
+        "unit": "ratio",
+        "block_tokens": block,
+        "pool_blocks": pool_blocks,
+        "docs": n_docs,
+        "doc_tokens": doc_blocks * block,
+        "requests": len(schedule),
+        "host_tier_mb": args.host_tier_mb,
+        "tier_off": off,
+        "tier_on": on,
+        "ttft_p99_speedup": (round(off["ttft_p99_ms"] / on["ttft_p99_ms"], 2)
+                             if on["ttft_p99_ms"] > 0 else None),
+        "host_tier": tier_st,
+        "outputs_identical": identical,
+        "leak_check_ok": leak_ok,
+        "kvprof": kvprof_snap,
+    }, t0, sig)
+
+
+def _chunked_prefill_bench(args, gen, cfg, log, watch, t0) -> int:
+    """``--chunked-prefill``: long prompts through the paged engine with
+    chunking OFF (one monolithic prefill dispatch per prompt) then ON
+    (``--prefill-chunk-tokens`` block-aligned chunks, park/resume at
+    wave boundaries — short peers decode between a long prompt's
+    chunks).  A mixed fleet of long + short requests on a 2-slot
+    engine; reports tokens/s and short-request TTFT both ways with the
+    chunk-dispatch count pinned in the signature, greedy outputs
+    asserted identical and a free-block leak check."""
+    from tpustack.models.llama import init_kv_pool
+    from tpustack.models.llm_continuous import ContinuousEngine, SlotRequest
+    from tpustack.models.llm_generate import SampleConfig
+    from tpustack.serving.kv_pool import KVBlockPool, PagedKVRuntime
+
+    sample = SampleConfig(greedy=True)
+    ctx, vocab = cfg.max_seq, cfg.vocab_size
+    block = max(1, min(args.kv_block, ctx))
+    while block > 1 and ctx % block:
+        block //= 2
+    chunk_toks = args.prefill_chunk_tokens or 2 * block
+    new = max(4, min(args.new_tokens, block))
+    long_p = max(3 * chunk_toks, (ctx * 3) // 4 - new)
+    long_p = min(long_p - long_p % block + 1, ctx - new)  # spans chunks
+    short_p = block // 2
+    n_short = max(2, args.requests // 2)
+    slots = 2
+    pool_blocks = slots * (ctx // block)
+    dchunk = min(args.chunk, new)
+
+    longs = [[(5 + j) % (vocab - 1) + 1 for j in range(long_p)]]
+    shorts = [[(900 + i * short_p + j) % (vocab - 1) + 1
+               for j in range(short_p)] for i in range(n_short)]
+    reqs = longs + shorts
+
+    def run_mode(prefill_chunk):
+        pool = KVBlockPool(pool_blocks + 1, block)
+        rt = PagedKVRuntime(
+            init_kv_pool(cfg, pool_blocks + 1, block,
+                         dtype=gen.cache_dtype),
+            pool, ctx)
+        results = {}
+        queue = [SlotRequest(ids=ids, max_new=new, sample=sample,
+                             on_done=lambda t, s, i=i:
+                             results.__setitem__(i, (t, s)))
+                 for i, ids in enumerate(reqs)]
+
+        def feed():
+            if not queue:
+                return None
+            need = rt.need_blocks(len(queue[0].ids), new)
+            if not rt.ensure_free(need):
+                return None
+            return queue.pop(0)
+
+        free0 = pool.n_free
+        eng = ContinuousEngine(gen, slots=slots, chunk=dchunk, paged=rt,
+                               prefill_chunk=prefill_chunk)
+        stats = eng.run(feed)
+        short_ttfts = sorted(results[i][1]["prefill_s"]
+                             for i in range(1, len(reqs)))
+        q = lambda p: short_ttfts[min(len(short_ttfts) - 1,
+                                      int(round(p * (len(short_ttfts) - 1))))]
+        return results, {
+            "tokens_per_s": round(stats["tokens_per_s"], 2),
+            "prefill_chunks": stats.get("prefill_chunks", 0),
+            "long_ttft_ms": round(results[0][1]["prefill_s"] * 1e3, 2),
+            "short_ttft_p50_ms": round(q(0.50) * 1e3, 2),
+            "short_ttft_p99_ms": round(q(0.99) * 1e3, 2),
+        }, pool.n_free == free0
+
+    run_mode(0)  # warm: monolithic prefill + decode programs
+    run_mode(chunk_toks)  # warm: chunk scatter + park/resume programs
+    res_off, off, leak_off = run_mode(0)
+    log(f"[bench_llm] chunked prefill OFF: {off}")
+    res_on, on, leak_on = run_mode(chunk_toks)
+    log(f"[bench_llm] chunked prefill ON:  {on}")
+    identical = all(res_off[i][0] == res_on[i][0] for i in range(len(reqs)))
+    if not identical:
+        log("[bench_llm] WARNING: chunked outputs diverged from monolithic")
+    leak_ok = leak_off and leak_on
+    from tpustack.obs import perfsig
+
+    sig = perfsig.signature(watch=watch, extra={
+        "prefill.chunks": on["prefill_chunks"],
+        "prefill.off.chunks": off["prefill_chunks"],
+        "prefill.chunk_tokens": chunk_toks,
+        "prefill.long_tokens": long_p,
+        "outputs_identical": identical,
+        "leak_check_ok": leak_ok})
+    return _emit({
+        "metric": f"{args.preset}_{args.quant or 'bf16'}_ctx{args.ctx}"
+                  f"_chunked_prefill_chunks",
+        "value": on["prefill_chunks"],
+        "unit": "dispatches",
+        "block_tokens": block,
+        "prefill_chunk_tokens": chunk_toks,
+        "long_prompt_tokens": long_p,
+        "short_requests": n_short,
+        "chunk_off": off,
+        "chunk_on": on,
+        "outputs_identical": identical,
+        "leak_check_ok": leak_ok,
+    }, t0, sig)
+
+
 def _tp_bench(args, gen, cfg, log, watch, t0) -> int:
     """``--tp N``: the tensor-parallel serving sweep — the continuous
     engine (the served path) run UNSHARDED then over a (1, 1, N, 1) mesh
@@ -702,6 +1000,28 @@ def main() -> int:
     p.add_argument("--max-paged-slots", type=int, default=32,
                    help="paged mode: engine slot ceiling (each slot count "
                         "compiles its own decode program)")
+    p.add_argument("--host-tier", action="store_true",
+                   help="host-KV-tier sweep: --docs document preambles "
+                        "revisited Zipf-skewed against a pool ~1/3 of the "
+                        "working set, tier off vs on — prefix hit ratio, "
+                        "TTFT p50/p99 and the spill/restore/expire ledger "
+                        "(greedy outputs asserted identical, free-block "
+                        "leak check)")
+    p.add_argument("--host-tier-mb", type=float, default=1024.0,
+                   help="host-tier mode: arena capacity "
+                        "(TPUSTACK_KV_HOST_TIER_MB analog; tiny: clamped)")
+    p.add_argument("--docs", type=int, default=8,
+                   help="host-tier mode: distinct document preambles "
+                        "(the working set is docs x doc blocks)")
+    p.add_argument("--chunked-prefill", action="store_true",
+                   help="chunked-prefill sweep: a long prompt + short "
+                        "peers on a 2-slot paged engine, chunking off vs "
+                        "on — tokens/s, short-request TTFT, chunk "
+                        "dispatches (greedy outputs asserted identical)")
+    p.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                   help="chunked-prefill mode: tokens per chunk "
+                        "(TPUSTACK_PREFILL_CHUNK_TOKENS analog; 0 = "
+                        "2 blocks)")
     p.add_argument("--tp", type=int, default=0,
                    help="tensor-parallel sweep: the continuous engine "
                         "unsharded vs over a tp=N mesh (dense AND paged), "
@@ -716,6 +1036,8 @@ def main() -> int:
         args.dense_slots = min(args.dense_slots, 2)
         args.kv_block = min(args.kv_block, 16)
         args.max_paged_slots = min(args.max_paged_slots, 8)
+        args.host_tier_mb = min(args.host_tier_mb, 64.0)
+        args.docs = min(args.docs, 6)
         if args.tp:
             args.batch = min(args.batch if args.batch > 1 else 2, 2)
             args.new_tokens = min(args.new_tokens, 16)
@@ -782,6 +1104,10 @@ def main() -> int:
         return _tp_bench(args, gen, cfg, log, watch, t_bench)
     if args.paged:
         return _paged_bench(args, gen, cfg, log, watch, t_bench)
+    if args.host_tier:
+        return _host_tier_bench(args, gen, cfg, log, watch, t_bench)
+    if args.chunked_prefill:
+        return _chunked_prefill_bench(args, gen, cfg, log, watch, t_bench)
     if args.speculative:
         return _speculative_bench(args, gen, cfg, log, watch, t_bench)
     if args.shared_prefix:

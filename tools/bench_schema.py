@@ -36,6 +36,12 @@ LLM_EXTRA_KEEP = (
     # paged mode: which decode-attention body served the sweep (gather vs
     # the in-place paged-flash kernel) + the per-step KV bytes both ways
     "kernel", "roofline",
+    # host-tier mode: the off/on comparison tables, the tier's spill/
+    # restore/expire ledger, and the p99 speedup the tier bought; chunked-
+    # prefill mode reuses outputs_identical/leak_check_ok plus its own
+    # off/on tables
+    "tier_off", "tier_on", "host_tier", "ttft_p99_speedup",
+    "chunk_off", "chunk_on", "prefill_chunk_tokens",
     "acceptance_rate", "tokens_per_weight_pass_on",
     "tokens_per_weight_pass_off", "speedup_batch1",
     "tp_ways", "weights_per_chip_bytes", "kv_per_chip_bytes",

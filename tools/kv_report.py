@@ -109,17 +109,24 @@ def build_report(snap: Dict, max_hbm_ratio: float) -> Dict:
     best_hit = None
     for pt in snap.get("curve") or []:
         hr = pt.get("hit_ratio")
-        rows.append({"scale": pt.get("scale"),
-                     "capacity_blocks": pt.get("capacity_blocks"),
-                     "predicted_hit_ratio": hr})
+        row = {"scale": pt.get("scale"),
+               "capacity_blocks": pt.get("capacity_blocks"),
+               "predicted_hit_ratio": hr}
+        if pt.get("label"):  # e.g. the host_tier what-if point — keyed,
+            row["label"] = pt["label"]  # so CI can assert it rendered
+        rows.append(row)
         if isinstance(hr, (int, float)):
             best_hit = hr if best_hit is None else max(best_hit, hr)
     # the smallest capacity already delivering (within a point of) the
-    # curve's ceiling — paying for more buys nothing the trace wants
+    # curve's ceiling — paying for more buys nothing the trace wants.
+    # Labeled points (host_tier) describe a DIFFERENT medium, not an HBM
+    # size the recommendation could name — skip them here
     rec_scale = None
     if best_hit is not None:
         for row in rows:
             hr = row["predicted_hit_ratio"]
+            if row.get("label"):
+                continue
             if isinstance(hr, (int, float)) and hr >= best_hit - 0.01:
                 rec_scale = row["scale"]
                 break
@@ -157,6 +164,7 @@ def build_report(snap: Dict, max_hbm_ratio: float) -> Dict:
         "reuse_gap": snap.get("reuse_gap"),
         "calibration": snap.get("calibration") or {},
         "prefix_cache": snap.get("prefix_cache"),
+        "host_tier": snap.get("host_tier"),
         "recommendation": recommendation,
         "ok": not gated,
     }
@@ -176,8 +184,17 @@ def render_text(rep: Dict, source: str) -> str:
     lines.append("")
     lines.append("  capacity   blocks   predicted hit rate")
     for row in rep["table"]:
+        tag = f"  [{row['label']}]" if row.get("label") else ""
         lines.append(f"  {row['scale']:>7g}x  {row['capacity_blocks']:>7}"
-                     f"   {_fmt_ratio(row['predicted_hit_ratio'])}")
+                     f"   {_fmt_ratio(row['predicted_hit_ratio'])}{tag}")
+    tier = rep.get("host_tier") or {}
+    if tier:
+        lines.append(
+            f"  host tier: {tier.get('resident_blocks')} blocks resident "
+            f"({tier.get('resident_bytes')} B of {tier.get('capacity_bytes')}"
+            f" B) | spilled {tier.get('spilled_total')} / restored "
+            f"{tier.get('restored_total')} / expired "
+            f"{tier.get('expired_total')}")
     pc = rep.get("prefix_cache") or {}
     if pc.get("enabled"):
         lines.append(f"  measured hit rate (1x, actual): "

@@ -88,6 +88,16 @@ TINY_SCENARIOS = (
              ("--tiny", "--paged", "--paged-flash", "--requests", "4"), {}),
     Scenario("llm_spec_tiny", "tools/bench_llm.py",
              ("--tiny", "--speculative"), {"value": "higher"}),
+    # host KV tier: the committed baseline pins the spill/restore ledger
+    # (host.spilled / host.restored) and the off/on cached-token split —
+    # the tier silently declining every restore (or the spill path dying)
+    # is an exact-counter regression, not a timing one
+    Scenario("llm_host_tier_tiny", "tools/bench_llm.py",
+             ("--tiny", "--host-tier", "--requests", "8"), {}),
+    # chunked prefill: the baseline pins prefill.chunks (the long prompt
+    # MUST split into chunk dispatches) and outputs_identical
+    Scenario("llm_chunked_prefill_tiny", "tools/bench_llm.py",
+             ("--tiny", "--chunked-prefill"), {}),
     Scenario("sd_small", "bench.py",
              ("--small", "--no-content-check", "--no-extras",
               "--repeats", "2"),

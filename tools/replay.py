@@ -468,6 +468,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--tiny", action="store_true",
                    help="CPU smoke: self-host the tiny model with a short, "
                         "small schedule (the tier-1/CI gate)")
+    p.add_argument("--host-tier-mb", type=float, default=None,
+                   help="self-hosted server's host KV tier arena "
+                        "(TPUSTACK_KV_HOST_TIER_MB) — spilled prefix "
+                        "blocks land in host RAM and warm revisits "
+                        "restore instead of recomputing; the artifact's "
+                        "server_kvcache snapshot then carries the "
+                        "host_tier ledger + capacity what-if point")
     p.add_argument("--qos-policy", default="",
                    help="TPUSTACK_QOS_POLICY for the self-hosted server "
                         "(inline JSON or a file path): per-tenant "
@@ -503,6 +510,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.new_tokens = min(args.new_tokens, 4.0)
         args.max_new = min(args.max_new, 8)
         args.deadline_s = min(args.deadline_s, 60.0)
+        # host KV tier ON for the smoke (tiny arena, crossover guard off
+        # — on CPU both of its EMAs measure dispatch noise): kv_report
+        # --tiny renders this run's server_kvcache, so the host_tier
+        # capacity point and spill/restore ledger get CI coverage.  An
+        # explicit --host-tier-mb (even 0) wins
+        if args.host_tier_mb is None:
+            args.host_tier_mb = 8.0
+            os.environ.setdefault("TPUSTACK_KV_HOST_TIER_CROSSOVER", "0")
 
     # self-hosted server env: QoS policy + ad-hoc knobs land in
     # os.environ BEFORE the server is imported/constructed (the knob
@@ -514,6 +529,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.environ[k] = v
     if args.qos_policy:
         os.environ["TPUSTACK_QOS_POLICY"] = args.qos_policy
+    if args.host_tier_mb is not None:
+        os.environ["TPUSTACK_KV_HOST_TIER_MB"] = str(args.host_tier_mb)
 
     tenants = parse_tenants(args.tenants)
     schedule = build_schedule(

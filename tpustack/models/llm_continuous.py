@@ -175,6 +175,22 @@ class SlotRequest:
     # paths, or TPUSTACK_QOS=0) means the request neither preempts nor
     # can be preempted — the QoS-free engine behavior.
     priority: Optional[str] = None
+    # host-tier KV restore (tpustack.serving.kv_host_tier): ``(block_ids,
+    # payloads)`` — fresh pool blocks the server allocated for claimed
+    # host-tier chunks, plus the claimed host-RAM payloads themselves.
+    # The engine scatters the payloads into the blocks in ONE dispatch
+    # immediately before the ``_admit_prefix_paged`` warm start that
+    # reads them (the blocks ride at the tail of ``prefix[1]``, so the
+    # gather sees restored bytes).  None = no host hit — the tier-free
+    # admission path, byte-for-byte.
+    host_restore: Optional[Tuple[List[int], list]] = None
+    # chunked-prefill continuation (TPUSTACK_PREFILL_CHUNK_TOKENS):
+    # ``(orig_cached, n_chunks)`` carried across the park/resume hops a
+    # long prompt takes through ``_chunk_prefill_step`` — the ORIGINAL
+    # request's cache-hit length (so retire stats report the true
+    # prompt/cached split, not the resume's history-as-prefix view) and
+    # how many chunk dispatches ran so far.  None = not a continuation.
+    chunk_cont: Optional[Tuple[int, int]] = None
 
 
 class _Slot:
@@ -247,7 +263,8 @@ class ContinuousEngine:
                  flight=None, queue_depth: Optional[Callable[[], int]] = None,
                  ledger=None,
                  preempt_hint: Optional[Callable[[], bool]] = None,
-                 on_preempt: Optional[Callable[[str], None]] = None):
+                 on_preempt: Optional[Callable[[str], None]] = None,
+                 prefill_chunk: Optional[int] = None):
         self.gen = gen
         self.B = slots
         self.chunk = chunk
@@ -361,6 +378,20 @@ class ContinuousEngine:
         self._on_preempt = on_preempt
         self._parked: List[SlotRequest] = []
         self._preempted = 0
+        # chunked prefill (TPUSTACK_PREFILL_CHUNK_TOKENS, paged only): a
+        # prompt whose uncached remainder exceeds the chunk size admits
+        # ONE block-aligned chunk at a time, parking the remainder
+        # exactly like QoS preemption does (retained block refs, warm
+        # resume through the prefix path) so decode waves interleave
+        # between chunks.  0 (the default) keeps admission byte-for-byte
+        # the monolithic-prefill engine.
+        if prefill_chunk is None:
+            from tpustack.utils import knobs
+
+            prefill_chunk = knobs.get_int("TPUSTACK_PREFILL_CHUNK_TOKENS")
+        self._chunk_tokens = (max(0, int(prefill_chunk))
+                              if paged is not None else 0)
+        self._prefill_chunks = 0  # per-run chunk dispatches (stats)
         self._last_wave_t: Optional[float] = None
         self._to_park: List[int] = []  # retirements awaiting a fused park
         self._pending: List[_PendingWave] = []
@@ -523,6 +554,98 @@ class ContinuousEngine:
         return eta_until_blocks(rel, need_blocks)
 
     # ---------------------------------------------------------------- admission
+    def _dispatch_restore(self, state, req: SlotRequest) -> None:
+        """Host-tier restore: scatter the request's claimed host-RAM
+        payloads into their fresh pool blocks in ONE dispatch, BEFORE
+        the warm start whose gather reads them (in-order device stream:
+        the scatter completes ahead of any consumer).  The restored
+        blocks ride at the tail of ``req.prefix[1]``, already installed
+        in the slot's block table by ``_alloc_slot_blocks``."""
+        ids, payloads = req.host_restore
+        req.host_restore = None
+        if not ids:
+            return
+        R = len(ids)
+        r_pad = 1 << max(0, (R - 1).bit_length())
+        pad_ids = list(ids) + [ids[-1]] * (r_pad - R)
+        pad_pay = list(payloads) + [payloads[-1]] * (r_pad - R)
+        stacked = [
+            {k: jnp.asarray(np.stack([p[li][k] for p in pad_pay]))
+             for k in pad_pay[0][li]}
+            for li in range(len(pad_pay[0]))]
+        state["pool"] = self.gen._restore_blocks_paged(
+            state["pool"], jnp.asarray(pad_ids, jnp.int32), stacked)
+        self.paged.arrays = state["pool"]
+
+    def _chunk_prefill_step(self, state, slots: List[_Slot], row,
+                            t0: float) -> None:
+        """Dispatch ONE block-aligned prefill chunk for a long prompt,
+        then park the remainder as a warm continuation (retained block
+        refs; ``prefix`` advanced past the chunk) — the chunked-prefill
+        half of the tentpole.  The slot never activates: no sample, no
+        first token, no device slot state — between chunks it is free
+        for decode waves and other admissions, which is the whole point
+        (a 32k prefill stops monopolising the device).  The FINAL chunk
+        is never dispatched here: once the remainder fits the chunk
+        size, admission falls through to the ordinary warm-start path,
+        which samples the first token exactly as a monolithic prefill
+        would have — greedy outputs are byte-identical."""
+        g, c = self.gen, self.gen.cfg
+        i, req, budget = row
+        s = slots[i]
+        rt = self.paged
+        plen = req.prefix[0] if req.prefix else 0
+        step = max(rt.block, (self._chunk_tokens // rt.block) * rt.block)
+        new_plen = plen + step
+        sbucket = g._bucket(step)
+        tokens = np.zeros((1, sbucket), np.int32)
+        tokens[0, :step] = req.ids[plen:new_plen]
+        bt_rows = jnp.asarray(self._bt[[i]])
+        limits = jnp.asarray([new_plen], jnp.int32)  # drop pad garbage
+        if req.host_restore:
+            self._dispatch_restore(state, req)
+        if sbucket * c.max_seq <= g.MASKED_PREFILL_MAX:
+            state["pool"] = g._prefill_chunk_paged(
+                g.params, state["pool"], bt_rows, jnp.asarray(tokens),
+                jnp.asarray(plen, jnp.int32), limits)
+        else:
+            row_caches = g._gather_rows_paged(state["pool"], bt_rows)
+            _, row_caches = g._prefill_from(
+                tokens, plen, jnp.asarray([new_plen], jnp.int32), row_caches)
+            state["pool"] = g._insert_rows_paged(
+                state["pool"], bt_rows, row_caches,
+                jnp.asarray(plen, jnp.int32), sbucket, limits)
+        self.paged.arrays = state["pool"]
+        self._prefill_chunks += 1
+        orig_cached, n_chunks = (req.chunk_cont if req.chunk_cont
+                                 else (s.cached, 0))
+        if s.span is not None:
+            s.span.add_event("prefill_chunk", tokens=step,
+                             chunks=n_chunks + 1)
+            s.span.end()
+            s.span = None
+        if self.flight is not None:
+            self.flight.record(
+                "prefill_chunk", slot=i, chunk_tokens=step,
+                prefilled=new_plen, prompt_tokens=len(req.ids),
+                chunks=n_chunks + 1, wall_s=round(time.time() - t0, 6))
+        # park: the continuation inherits EVERY slot block (prompt +
+        # budget — admission charged the full footprint up front) as its
+        # warm prefix; re-admission allocates nothing
+        blocks = list(s.blocks)
+        s.req, s.done, s.pending = None, True, False
+        s.blocks, s.alloc = [], 0
+        self._bt[i, :] = 0
+        self._parked.append(SlotRequest(
+            ids=req.ids, max_new=req.max_new, sample=req.sample,
+            on_tokens=req.on_tokens, on_done=req.on_done,
+            cancelled=req.cancelled, seed=req.seed,
+            prefix=(new_plen, blocks), span_ctx=req.span_ctx,
+            on_prefill_blocks=req.on_prefill_blocks,
+            speculative=req.speculative, tenant=req.tenant,
+            t_kv_alloc=req.t_kv_alloc, priority=req.priority,
+            chunk_cont=(orig_cached, n_chunks + 1)))
+
     def _admit_dispatch(self, state, slots: List[_Slot],
                         waves: List[Tuple[int, SlotRequest]], gen_ctr: int):
         """Dispatch admissions WITHOUT any host sync: per prompt-bucket
@@ -586,6 +709,26 @@ class ContinuousEngine:
                            "budget": budget})
         if self._on_progress is not None:
             self._on_progress("prefill")
+
+        # chunked prefill: a paged row whose uncached remainder exceeds
+        # the chunk size dispatches ONE block-aligned chunk and parks the
+        # rest (see _chunk_prefill_step) — it never reaches the grouped
+        # admission below this wave
+        if self._chunk_tokens > 0 and self.paged is not None:
+            step = max(self.paged.block,
+                       (self._chunk_tokens // self.paged.block)
+                       * self.paged.block)
+            rest = []
+            for row in valid:
+                plen = row[1].prefix[0] if row[1].prefix else 0
+                if (plen % self.paged.block == 0
+                        and len(row[1].ids) - plen > step):
+                    self._chunk_prefill_step(state, slots, row, t0)
+                else:
+                    rest.append(row)
+            valid = rest
+            if not valid:
+                return gen_ctr
 
         # group by prefill bucket: a 16-token prompt must not pay a 16k
         # peer's padded prefill (the engine admits ANY prompt that fits ctx
@@ -680,7 +823,12 @@ class ContinuousEngine:
                 # this slot's table (installed by _alloc_slot_blocks) and
                 # hold exactly what prefill wrote — no host KV, no
                 # restore; the fused program gathers the line, prefills
-                # the suffix, and scatters it back
+                # the suffix, and scatters it back.  A host-tier hit
+                # first scatters its claimed payloads into the tail
+                # blocks of that prefix (one extra dispatch, no prefill
+                # FLOPs) — the gather below then reads restored bytes.
+                if req.host_restore:
+                    self._dispatch_restore(state, req)
                 bt_rows, limits = paged_rowmeta(rows)
                 if sbucket * c.max_seq <= g.MASKED_PREFILL_MAX:
                     (state["pool"], firsts, state["cur"], state["active"],
@@ -708,6 +856,7 @@ class ContinuousEngine:
                         state["temp"], state["topk"], state["greedy"],
                         state["keys"], slot_ids, lengths, firsts, temp_r,
                         topk_r, greedy_r, row_keys)
+                self.paged.arrays = state["pool"]
                 slots[i].pending = True
                 self._pending.append(_PendingWave(
                     rows, firsts, t0, block_inserts=block_inserts(rows)))
@@ -778,6 +927,7 @@ class ContinuousEngine:
                         state["cur"], state["active"], state["first"],
                         state["temp"], state["topk"], state["greedy"],
                         state["keys"], temp_r, topk_r, greedy_r)
+                self.paged.arrays = state["pool"]
                 for i, _, _ in rows:
                     slots[i].pending = True
                 self._pending.append(_PendingWave(
@@ -827,6 +977,15 @@ class ContinuousEngine:
         overlap this is the request's true time-to-first-token."""
         firsts = [int(t) for t in np.asarray(wave.firsts_dev)]
         t_first = time.time() - wave.t0
+        if self.paged is not None and self.paged.cache is not None:
+            tier = getattr(self.paged.cache, "host_tier", None)
+            if tier is not None:
+                # feed the restore-vs-recompute crossover: this wave
+                # prefilled its rows' uncached tokens in t_first wall
+                n_new = sum(max(0, len(r.ids) - slots[i].cached)
+                            for i, r, _ in wave.rows)
+                tier.note_prefill(self.paged.pool.blocks_for(n_new),
+                                  t_first)
         if self.flight is not None:
             self.flight.record(
                 "prefill", rows=len(wave.rows),
@@ -958,7 +1117,7 @@ class ContinuousEngine:
             self._to_park.append(i)
         if req is not None and req.on_done is not None:
             dt = time.time() - s.t0
-            req.on_done(list(out), {
+            st = {
                 "batch": batch_size,
                 "prompt_tokens": len(req.ids),
                 "generated_tokens": len(out),
@@ -968,7 +1127,16 @@ class ContinuousEngine:
                 "decode_s": max(dt - s.prefill_s, 0.0),
                 "tokens_per_s": (len(out) / max(dt - s.prefill_s, 1e-9)
                                  if out else 0.0),
-            })
+            }
+            if req.chunk_cont is not None:
+                # a chunked-prefill continuation: report the ORIGINAL
+                # request's cache-hit split, not the resume's history-as-
+                # prefix view, plus how many chunk waves the prompt took
+                orig_cached, n_chunks = req.chunk_cont
+                st["cached_tokens"] = orig_cached
+                st["prefill_tokens"] = len(req.ids) - orig_cached
+                st["prefill_chunks"] = n_chunks
+            req.on_done(list(out), st)
 
     # ------------------------------------------------------ QoS preemption
     def _maybe_preempt(self, slots: List[_Slot]) -> None:
@@ -1019,7 +1187,11 @@ class ContinuousEngine:
         s = slots[i]
         req = s.req
         prior = list(s.out)
-        orig_budget, orig_cached = s.budget, s.cached
+        orig_budget = s.budget
+        # a chunked-prefill continuation already carries the ORIGINAL
+        # request's cache-hit length — preempting one must keep it
+        orig_cached = (req.chunk_cont[0] if req.chunk_cont is not None
+                       else s.cached)
         blocks = list(s.blocks)
         # the parked entry inherits the slot's pool references — no decref
         s.blocks, s.alloc = [], 0
@@ -1074,6 +1246,7 @@ class ContinuousEngine:
             tenant=req.tenant,
             t_kv_alloc=req.t_kv_alloc,
             priority=req.priority,
+            chunk_cont=req.chunk_cont,
         )
         self._parked.append(parked)
         self._preempted += 1
@@ -1140,6 +1313,7 @@ class ContinuousEngine:
         self._parked = []
         self._preempted = 0
         self._resumed = 0
+        self._prefill_chunks = 0
         self._retired_tokens = 0  # per-run total, counted at _retire
         self._spec_drafted = self._spec_accepted = 0
         self._spec_dispatches = self._plain_steps = 0
@@ -1263,6 +1437,11 @@ class ContinuousEngine:
                 "kernel_gather_dispatches": self._gather_dispatches,
                 "kernel_paged_flash_dispatches": self._flash_dispatches,
             })
+            if self._chunk_tokens > 0:
+                # only when chunked prefill is armed — the key must be
+                # ABSENT with the knob off so perfsig signature keys do
+                # not change under the bisection contract
+                stats["prefill_chunks"] = self._prefill_chunks
         if self.spec is not None:
             stats.update({
                 "spec_drafted_tokens": self._spec_drafted,
@@ -1290,6 +1469,12 @@ class ContinuousEngine:
                     jnp.asarray(self._bt), state["keys"],
                     state["temp"], state["topk"], state["greedy"],
                     self.chunk, flash=self.paged_flash)
+                # keep the runtime's arrays reference CURRENT (donation
+                # rotated the buffers): the host-tier spill path reads
+                # blocks through it between dispatches, and cached prefix
+                # blocks are immutable post-prefill — so the freshest
+                # buffer generation always holds their right bytes
+                self.paged.arrays = state["pool"]
                 if self.paged_flash:
                     self._flash_dispatches += 1
                 else:
@@ -1469,7 +1654,10 @@ class ContinuousEngine:
             self._maybe_preempt(slots)
             self._flush_park(state)
             admit_free()
-            if self._live(slots) == 0:
+            if self._live(slots) == 0 and not self._parked:
+                # NOT while anything is parked: a chunked-prefill
+                # continuation re-parks synchronously inside admit_free's
+                # dispatch, so live can read 0 with work still queued
                 break
             # deliver first tokens the moment the device has them (non-
             # blocking) — streaming clients see them before the next chunk
@@ -1589,6 +1777,7 @@ class ContinuousEngine:
                 state["pool"], jnp.asarray(self._bt), state["keys"],
                 state["temp"], state["topk"], state["greedy"], K,
                 flash=self.paged_flash)
+            self.paged.arrays = state["pool"]  # see _fill_chain
             if self.paged_flash:
                 self._flash_dispatches += 1
             else:
@@ -1686,8 +1875,10 @@ class ContinuousEngine:
             self._maybe_preempt(slots)
             self._flush_park(state)
             admit_free()
+            if self._live(slots) == 0 and not self._parked:
+                break  # see _run_loop: parked continuations still queue
             if self._live(slots) == 0:
-                break
+                continue  # only parked chunk continuations — admit again
             self._resolve_pending(state, slots, only_ready=True)
             plan = None
             if not chain:

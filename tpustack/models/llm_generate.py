@@ -1147,6 +1147,44 @@ class Generator:
             cur, active, first, temp, topk, greedy, keys, slot_ids,
             length, firsts, temp_r, topk_r, greedy_r, next_keys)
 
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _restore_blocks_paged(self, pool, ids, payloads):
+        """Host-tier restore: write ``R_pad`` spilled blocks' KV bytes
+        back into the pool at block ids ``ids [R_pad]`` — ONE dispatch
+        however many blocks a hit restores.  ``payloads`` mirrors the
+        pool's per-layer dict layout with arrays ``[R_pad, blk, *tail]``
+        (host-stacked from the tier's claimed copies).  The id vector is
+        padded to a power of two by REPEATING the last real id with its
+        own payload row, so duplicate writes land identical bytes and
+        the jit signature count stays bounded in the restore width."""
+        def st(dst, src):
+            return dst.at[ids].set(src.astype(dst.dtype))
+
+        return [{k: st(layer[k], srcl[k]) for k in layer}
+                for layer, srcl in zip(pool, payloads)]
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _prefill_chunk_paged(self, params, pool, bt_rows, tokens, base,
+                             limits):
+        """One CHUNKED-prefill step for parked long-prompt rows: gather
+        the rows' lines out of the pool (earlier chunks' KV sits in their
+        already-allocated blocks) → masked attention over ``[0, base +
+        s)`` — the same traced body every warm suffix runs, so resuming
+        a chunked prefill is byte-identical to a monolithic one → scatter
+        the new span back through the block tables.  No sample, no
+        activation: the slot stays PARKED between chunks (PR 14's
+        preemption contract) and only the final chunk goes through the
+        ordinary ``_admit_prefix_paged`` warm start for its first
+        token."""
+        caches = self._pool_gather_body(pool, bt_rows)
+        # ``limits`` ([B]) is exactly the post-chunk length ``base + step``
+        # — reuse it as the masked body's per-row true length (the sampled
+        # logits are discarded, but ``logits_at`` still gathers per row)
+        _, caches = self._prefill_masked_body(params, tokens, base, limits,
+                                              caches)
+        return self._insert_span_body(pool, bt_rows, caches, base,
+                                      tokens.shape[1], limits)
+
     @staticmethod
     def _splice_rows(slot_caches, row_caches, slot_ids, n: int, bucket: int):
         """Traced body: copy positions ``[0, bucket)`` of an n-row prefill

@@ -114,6 +114,36 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "(block-size rounding): 0 = tight fit, rises with larger "
                "TPUSTACK_KV_BLOCK against short requests.", unit="ratio"),
 
+    # ---- LLM host KV tier (kv_host_tier.py: refcount-0 prefix blocks
+    # spill device→host at eviction instead of dying; a warm match
+    # restores them with ONE fused host→HBM dispatch.  All series absent
+    # at TPUSTACK_KV_HOST_TIER_MB=0 — the tier's bisection contract.
+    # Conservation invariant the sanitizer asserts at quiesce:
+    # spilled == restored + expired + resident_blocks) ----
+    MetricSpec("tpustack_llm_kv_host_spilled_blocks_total", "counter",
+               "Prefix blocks copied device→host at eviction time (the "
+               "block's HBM is freed; its bytes live on in the host "
+               "arena).", unit="total"),
+    MetricSpec("tpustack_llm_kv_host_restored_blocks_total", "counter",
+               "Host-tier blocks copied back into fresh pool blocks on a "
+               "warm prefix match — each one is a block of prefill FLOPs "
+               "the engine did NOT pay for.", unit="total"),
+    MetricSpec("tpustack_llm_kv_host_expired_blocks_total", "counter",
+               "Host-tier blocks dropped under the arena's byte cap (LRU) "
+               "or retired with their trie subtree — their next reuse is "
+               "a full recompute.", unit="total"),
+    MetricSpec("tpustack_llm_kv_host_resident_bytes", "gauge",
+               "Bytes resident in the host KV arena (≤ "
+               "TPUSTACK_KV_HOST_TIER_MB).", unit="bytes"),
+
+    # ---- LLM chunked prefill (long prompts split into block-aligned
+    # chunks at wave boundaries; absent at TPUSTACK_PREFILL_CHUNK_TOKENS=0)
+    MetricSpec("tpustack_llm_prefill_chunks_total", "counter",
+               "Non-final chunked-prefill dispatches (each parks its slot "
+               "again instead of monopolising the wave — decode latency "
+               "for seated rows stays bounded by the chunk size).",
+               unit="total"),
+
     # ---- KV working-set observatory (tpustack.obs.kvprof; SHARDS-style
     # sampled stack distances over prefix-chunk keys.  Gauges refresh at
     # scrape time via the profiler's collector; histograms observe at
@@ -131,8 +161,10 @@ CATALOG: Tuple[MetricSpec, ...] = (
                ("capacity",), unit="ratio"),
     MetricSpec("tpustack_llm_kv_block_lifetime_seconds", "histogram",
                "Alloc→release age of pool blocks by release outcome "
-               "(retired | evicted_warm | evicted_cold | died_queued | "
-               "other) — how long KV actually lives, and why it dies.",
+               "(retired | evicted_warm | evicted_cold | spilled | "
+               "died_queued | other) — how long KV actually lives, and "
+               "why it dies.  'spilled' frees the HBM but keeps the bytes "
+               "in the host tier.",
                ("outcome",), buckets=SAVE_BUCKETS, unit="seconds"),
     MetricSpec("tpustack_llm_kv_eviction_age_seconds", "histogram",
                "Seconds since last hit for evicted prefix-cache entries "

@@ -357,7 +357,26 @@ class KVProfiler:
             r = self._hit_ratio_locked(dists, capacity * s, total_accesses)
             out.append({"scale": s, "capacity_blocks": int(capacity * s),
                         "hit_ratio": r})
+        cap = self._host_tier_capacity(capacity)
+        if cap is not None:
+            # the host tier extends the effective prefix working set:
+            # its what-if point sits at pool + arena capacity — what the
+            # configured TPUSTACK_KV_HOST_TIER_MB should buy, against
+            # which the measured host-hit rate is judged
+            r = self._hit_ratio_locked(dists, cap, total_accesses)
+            out.append({"scale": round(cap / capacity, 3) if capacity
+                        else 0.0,
+                        "capacity_blocks": int(cap), "hit_ratio": r,
+                        "label": "host_tier"})
         return out
+
+    def _host_tier_capacity(self, capacity: int) -> Optional[int]:
+        """Pool + host-arena capacity in blocks, or None when no tier is
+        attached (the curve then keeps its pre-tier shape exactly)."""
+        tier = getattr(self.cache, "host_tier", None)
+        if tier is None:
+            return None
+        return capacity + tier.capacity_blocks
 
     def snapshot(self) -> Dict[str, object]:
         """The ``GET /debug/kvcache`` payload: curve points, working set,
@@ -416,10 +435,17 @@ class KVProfiler:
                 "pool_events": {"alloc_blocks": self._counts["allocs"],
                                 "freed_blocks": self._counts["frees"]},
             }
+            host_cap = self._host_tier_capacity(capacity)
+            if host_cap is not None:
+                snap["counterfactual_hit_ratio"]["host_tier"] = (
+                    self._hit_ratio_locked(self._dists, host_cap, total))
         # pool/cache stats OUTSIDE the profiler lock (they take their own)
         snap["pool"] = self.pool.stats()
         if self.cache is not None:
             snap["prefix_cache"] = self.cache.stats()
+        tier = getattr(self.cache, "host_tier", None)
+        if tier is not None:
+            snap["host_tier"] = tier.stats()
         return snap
 
     def tenant_working_sets(self) -> Dict[str, Dict[str, object]]:
@@ -458,6 +484,10 @@ class KVProfiler:
             ratios = {f"{s:g}x": self._hit_ratio_locked(self._dists,
                                                         capacity * s, total)
                       for s in CAPACITY_SCALES}
+            host_cap = self._host_tier_capacity(capacity)
+            if host_cap is not None:
+                ratios["host_tier"] = self._hit_ratio_locked(
+                    self._dists, host_cap, total)
         self._m["tpustack_llm_kv_working_set_blocks"].set(ws)
         g = self._m["tpustack_llm_kv_counterfactual_hit_ratio"]
         for label, r in ratios.items():

@@ -80,7 +80,10 @@ def check_kv_conservation(pool, where: str = "") -> None:
 
 def _cache_resident(cache) -> List[int]:
     with cache._lock:
-        return [n.block_id for n in cache._walk()]
+        # host-tier nodes hold NO pool block (block_id -1: payload in the
+        # host arena, or a payload-less stub) — only HBM entries count
+        # toward pool accounting
+        return [n.block_id for n in cache._walk() if n.tier == "hbm"]
 
 
 def check_kv_quiesce(runtime, external_refs: int = 0,
@@ -121,6 +124,28 @@ def check_kv_quiesce(runtime, external_refs: int = 0,
             f"pool quiesce{at}: cache-resident block(s) {over_refd[:16]} "
             "hold extra references with no slot alive — a retire decref "
             "went missing for a prefix-shared block")
+        return
+    tier = getattr(runtime.cache, "host_tier", None) \
+        if runtime.cache is not None else None
+    if tier is not None:
+        st = tier.stats()
+        if st["spilled_total"] != (st["restored_total"] + st["expired_total"]
+                                   + st["resident_blocks"]):
+            sanitize.violation(
+                "kv_leak",
+                f"host-tier conservation broken{at}: {st['spilled_total']} "
+                f"spilled != {st['restored_total']} restored + "
+                f"{st['expired_total']} expired + {st['resident_blocks']} "
+                "resident — a spilled block left the arena without being "
+                "restored, expired, or abandoned (host bytes leak until "
+                "restart)")
+        elif st["resident_bytes"] > st["capacity_bytes"]:
+            sanitize.violation(
+                "kv_leak",
+                f"host-tier over cap{at}: {st['resident_bytes']} resident "
+                f"bytes > {st['capacity_bytes']} capacity "
+                "(TPUSTACK_KV_HOST_TIER_MB) — LRU expiry under-counted an "
+                "entry's bytes")
 
 
 def check_span_leaks(tracer, where: str = "pytest teardown") -> List[str]:

@@ -77,6 +77,16 @@ GUARDED: Dict[Tuple[str, str], Tuple[GuardedSpec, ...]] = {
         _s("_root", "_lock", writes_only=True),
         _s("_tick", "_lock", writes_only=True),
     ),
+    ("tpustack.serving.kv_host_tier", "HostKVTier"): (
+        _s("_entries", "_lock", writes_only=True),
+        _s("_bytes", "_lock", writes_only=True),
+        _s("spilled_total", "_lock", writes_only=True),
+        _s("restored_total", "_lock", writes_only=True),
+        _s("expired_total", "_lock", writes_only=True),
+        _s("spill_declined_total", "_lock", writes_only=True),
+        _s("_copy_s_ema", "_lock", writes_only=True),
+        _s("_prefill_s_ema", "_lock", writes_only=True),
+    ),
     ("tpustack.serving.sd_server", "SDServer"): (
         _s("_inflight", "_lock"),
     ),

@@ -1,0 +1,325 @@
+"""Host-RAM second tier for the paged KV block pool.
+
+ROADMAP item 4: the paged pool caps the RESIDENT prefix cache at HBM
+size, and the PR 16 working-set observatory measures what that costs —
+the counterfactual miss-ratio curve routinely shows 2x-4x capacity
+recovering most misses, and every ``evicted_warm`` block is prefill we
+paid for and then threw away.  This module is the fix's host half: an
+LRU arena of spilled blocks in host RAM, sized by
+``TPUSTACK_KV_HOST_TIER_MB`` (0 = off — the bisection contract: nothing
+constructs, the trie and pool hot paths are byte-for-byte the tier-free
+ones).
+
+Mechanics (all driven by ``PagedPrefixCache`` — the tier never walks the
+trie itself):
+
+- **Spill** — ``evict()`` offers each refcount-0 victim to the tier
+  BEFORE the block dies.  ``snapshot_block`` copies the block's KV bytes
+  device→host (per-layer ``k``/``v`` and, under int8 KV, the
+  ``k_scale``/``v_scale`` tensors — the arena mirrors whatever layout
+  ``init_kv_pool`` built), ``offer`` records the payload against the
+  trie node, and the HBM block is decref'd with ``outcome="spilled"``.
+  A tier at capacity expires its LRU entries to make room; a copy that
+  fails (pool buffers donated mid-run, OOM) declines, and the victim
+  dies through the normal warm/cold path — the tier is best-effort by
+  construction, never load-bearing for correctness.
+- **Restore** — a ``match`` that walks past the HBM frontier into
+  host-tier nodes ``claim``s their payloads (the nodes stay in the trie
+  as payload-less stubs; a concurrent identical prompt misses there and
+  recomputes, and the winning insert re-promotes the stubs).  The
+  server allocates fresh pool blocks for them and the engine scatters
+  the payloads host→HBM in ONE dispatch immediately before the existing
+  ``_admit_prefix_paged`` warm start — a host hit costs one copy
+  dispatch, not prefill FLOPs.  The resolved insert then re-records the
+  chunks as ordinary HBM nodes.
+- **Crossover guard** — restoring only wins while the measured
+  per-block copy cost is below the measured per-block recompute
+  (prefill) cost.  The tier EMAs both (spill copies are timed
+  synchronously; the engine feeds prefill wall per block at resolve)
+  and ``should_restore`` answers the match walk.  No measurements yet
+  → restore (copies are orders of magnitude cheaper than prefill on
+  every profiled shape; the guard exists for the degenerate ones).
+
+Accounting contract (the sanitizer's cross-tier conservation check):
+``spilled_total == restored_total + expired_total + resident_blocks``
+at any quiesce point, and ``resident_bytes <= capacity_bytes`` always.
+
+Thread-safe: the tier's lock nests INSIDE the trie lock (every mutation
+is initiated by the cache with ``cache._lock`` held); stats/gauge reads
+take only the tier lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from tpustack import sanitize
+from tpustack.utils import get_logger, knobs
+
+log = get_logger("serving.kv_host_tier")
+
+__all__ = ["HostKVTier", "block_nbytes"]
+
+
+def block_nbytes(arrays) -> int:
+    """Host bytes one spilled block occupies: every layer's per-block
+    slice of every pool tensor (k/v + int8 scales when present)."""
+    total = 0
+    for layer in arrays:
+        for v in layer.values():
+            # pool tensors are [n_blocks, block, *tail]
+            per = int(np.prod(v.shape[1:])) * np.dtype(v.dtype).itemsize
+            total += per
+    return total
+
+
+class _Entry:
+    __slots__ = ("node", "payload", "nbytes")
+
+    def __init__(self, node, payload, nbytes: int):
+        self.node = node
+        self.payload = payload
+        self.nbytes = nbytes
+
+
+class HostKVTier:
+    """LRU host-RAM arena for spilled prefix-cache blocks (one pool).
+
+    ``arrays_fn`` returns the CURRENT device pool tensors (the runtime's
+    ``arrays`` reference, refreshed by the engine after every paged
+    dispatch) — ``snapshot_block`` reads a block's rows through it.
+    ``metrics`` is the server's catalog dict (optional): spill/restore/
+    expire counters increment at event time; bench paths stay
+    metrics-free.
+    """
+
+    def __init__(self, capacity_bytes: int, pool,
+                 arrays_fn: Optional[Callable[[], list]] = None,
+                 metrics=None, crossover: Optional[bool] = None):
+        self.pool = pool
+        self.capacity_bytes = max(0, int(capacity_bytes))
+        self.arrays_fn = arrays_fn
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # spilled entries, coldest -> hottest (keyed by trie-node uid)
+        self._entries: "OrderedDict[int, _Entry]" = OrderedDict()  # guarded-by: _lock (writes)
+        self._bytes = 0  # guarded-by: _lock (writes)
+        self._block_nbytes = 0  # lazily measured at first spill
+        # monotonic counters (the conservation identity's terms)
+        self.spilled_total = 0  # guarded-by: _lock (writes)
+        self.restored_total = 0  # guarded-by: _lock (writes)
+        self.expired_total = 0  # guarded-by: _lock (writes)
+        self.spill_declined_total = 0  # guarded-by: _lock (writes)
+        # crossover EMAs: measured per-block copy seconds (spill-time,
+        # synchronous) vs per-block recompute seconds (prefill wall the
+        # engine reports at resolve)
+        self._copy_s_ema: Optional[float] = None  # guarded-by: _lock (writes)
+        self._prefill_s_ema: Optional[float] = None  # guarded-by: _lock (writes)
+        # crossover guard resolved at construction (boot-time typo check,
+        # like every other knob): off = restore unconditionally, for
+        # tiny/CPU shapes where both EMAs measure dispatch noise.  The
+        # ``crossover`` parameter overrides the knob for in-process
+        # constructions (bench modes) that must not mutate global env
+        self._crossover = (knobs.get_bool("TPUSTACK_KV_HOST_TIER_CROSSOVER")
+                           if crossover is None else bool(crossover))
+        sanitize.install_guards(self)
+
+    # ----------------------------------------------------------- capacity
+    @property
+    def resident_blocks(self) -> int:
+        return len(self._entries)
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    @property
+    def capacity_blocks(self) -> int:
+        """How many blocks the byte cap holds (0 until sized — the first
+        spill measures the per-block layout; callers wanting an estimate
+        before any spill get one from the pool arrays via
+        :func:`block_nbytes`)."""
+        bn = self._block_nbytes
+        if not bn and self.arrays_fn is not None:
+            try:
+                bn = block_nbytes(self.arrays_fn())
+            except Exception:
+                log.debug("host-tier capacity estimate unavailable "
+                          "(arrays provider raised)", exc_info=True)
+                return 0
+        return (self.capacity_bytes // bn) if bn else 0
+
+    # -------------------------------------------------------------- spill
+    def snapshot_block(self, block_id: int) -> Optional[Dict]:
+        """Copy block ``block_id``'s KV device→host; None when the copy
+        cannot be made (no arrays provider, buffers donated/deleted
+        mid-dispatch).  Cached prefix blocks are immutable after their
+        prefill, so any buffer generation at or past that prefill holds
+        the right bytes — the engine refreshes the provider's reference
+        after every paged dispatch, and a deleted stale buffer raises
+        here and declines cleanly."""
+        if self.arrays_fn is None:
+            return None
+        t0 = time.time()
+        try:
+            arrays = self.arrays_fn()
+            payload = [{k: np.asarray(v[block_id])  # tpulint: disable=TPL101
+                        for k, v in layer.items()}  # — spill IS a D2H copy
+                       for layer in arrays]
+        except Exception:
+            log.debug("host-tier spill copy declined", exc_info=True)
+            return None
+        dt = time.time() - t0
+        with self._lock:
+            self._copy_s_ema = (dt if self._copy_s_ema is None
+                                else 0.8 * self._copy_s_ema + 0.2 * dt)
+        return payload
+
+    def offer(self, node, payload) -> bool:
+        """Record ``payload`` (from :meth:`snapshot_block`) against trie
+        ``node``.  A tier at capacity expires its LRU entries to make
+        room — the expired entries' trie nodes become payload-less stubs
+        (the cache treats a stub as a miss and re-promotes it on the
+        next insert of that chunk).  An offer that cannot fit at all
+        (payload bigger than the whole cap) is declined: returns False
+        and the victim should die through the normal warm/cold path."""
+        nbytes = sum(int(a.nbytes) for layer in payload
+                     for a in layer.values())
+        n_expired = 0
+        with self._lock:
+            if not self._block_nbytes:
+                self._block_nbytes = nbytes
+            if nbytes > self.capacity_bytes:
+                self.spill_declined_total += 1
+                return False
+            while self._bytes + nbytes > self.capacity_bytes and self._entries:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old.nbytes
+                self.expired_total += 1
+                n_expired += 1
+            self._entries[node.uid] = _Entry(node, payload, nbytes)
+            self._bytes += nbytes
+            self.spilled_total += 1
+            resident = self._bytes
+        m = self.metrics
+        if m is not None:
+            m["tpustack_llm_kv_host_spilled_blocks_total"].inc()
+            if n_expired:
+                m["tpustack_llm_kv_host_expired_blocks_total"].inc(n_expired)
+            m["tpustack_llm_kv_host_resident_bytes"].set(resident)
+        return True
+
+    def decline(self) -> None:
+        """A spill was not attempted (copy failed / no provider) — count
+        it so the observatory can see best-effort losses."""
+        with self._lock:
+            self.spill_declined_total += 1
+
+    # ------------------------------------------------------------ restore
+    def claim(self, node) -> Optional[List[Dict]]:
+        """Pop ``node``'s payload for a pool-side restore (the caller
+        detaches the node from the trie under the same cache lock).
+        None when the entry already expired."""
+        with self._lock:
+            e = self._entries.pop(node.uid, None)
+            if e is None:
+                return None
+            self._bytes -= e.nbytes
+            self.restored_total += 1
+        m = self.metrics
+        if m is not None:
+            m["tpustack_llm_kv_host_restored_blocks_total"].inc()
+            m["tpustack_llm_kv_host_resident_bytes"].set(self._bytes)
+        return e.payload
+
+    def drop(self, node, expired: bool = True) -> None:
+        """Discard ``node``'s entry without restoring (its trie subtree
+        was removed, or its chunk got re-prefilled and re-inserted as an
+        HBM node) — counted as expired: the spilled bytes never made it
+        back."""
+        with self._lock:
+            e = self._entries.pop(node.uid, None)
+            if e is None:
+                return
+            self._bytes -= e.nbytes
+            if expired:
+                self.expired_total += 1
+        m = self.metrics
+        if m is not None:
+            if expired:
+                m["tpustack_llm_kv_host_expired_blocks_total"].inc()
+            m["tpustack_llm_kv_host_resident_bytes"].set(self._bytes)
+
+    def abandon(self, n: int) -> None:
+        """``n`` claimed payloads were dropped before reaching HBM (the
+        restore allocation lost the race for free blocks): move them
+        restored→expired so the conservation identity stays exact."""
+        with self._lock:
+            self.restored_total -= n
+            self.expired_total += n
+        m = self.metrics
+        if m is not None:
+            m["tpustack_llm_kv_host_expired_blocks_total"].inc(n)
+
+    # ---------------------------------------------------------- crossover
+    def note_prefill(self, n_blocks: int, wall_s: float) -> None:
+        """The engine resolved a prefill covering ``n_blocks`` fresh
+        blocks in ``wall_s`` — feed the recompute-cost EMA the crossover
+        guard compares the copy cost against."""
+        if n_blocks <= 0 or wall_s <= 0:
+            return
+        per = wall_s / n_blocks
+        with self._lock:
+            self._prefill_s_ema = (per if self._prefill_s_ema is None
+                                   else 0.8 * self._prefill_s_ema + 0.2 * per)
+
+    def should_restore(self, n_blocks: int) -> bool:
+        """Restore-vs-recompute crossover: copy unless the measured
+        per-block copy cost exceeds the measured per-block prefill cost.
+        Unmeasured either way → restore (see module docstring)."""
+        del n_blocks  # both costs scale linearly in blocks today
+        if not self._crossover:
+            return True  # TPUSTACK_KV_HOST_TIER_CROSSOVER=0
+        with self._lock:
+            copy_s, prefill_s = self._copy_s_ema, self._prefill_s_ema
+        if copy_s is None or prefill_s is None:
+            return True
+        return copy_s <= prefill_s
+
+    # -------------------------------------------------------------- admin
+    def clear(self) -> int:
+        """Drop every resident entry (counted expired); returns how many."""
+        with self._lock:
+            n = len(self._entries)
+            self.expired_total += n
+            self._entries.clear()
+            self._bytes = 0
+        m = self.metrics
+        if m is not None:
+            if n:
+                m["tpustack_llm_kv_host_expired_blocks_total"].inc(n)
+            m["tpustack_llm_kv_host_resident_bytes"].set(0)
+        return n
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "capacity_bytes": self.capacity_bytes,
+                "capacity_blocks": ((self.capacity_bytes
+                                     // self._block_nbytes)
+                                    if self._block_nbytes else 0),
+                "resident_blocks": len(self._entries),
+                "resident_bytes": self._bytes,
+                "block_bytes": self._block_nbytes,
+                "spilled_total": self.spilled_total,
+                "restored_total": self.restored_total,
+                "expired_total": self.expired_total,
+                "spill_declined_total": self.spill_declined_total,
+                "copy_s_per_block": self._copy_s_ema,
+                "prefill_s_per_block": self._prefill_s_ema,
+            }

@@ -265,13 +265,22 @@ class PagedMatch:
     on a miss) and the matched ``block_ids``.  The caller OWNS one
     reference per matched block (taken under the trie lock) — the engine
     folds them into the slot's block list so a single retire-time decref
-    releases hit and fresh blocks alike."""
+    releases hit and fresh blocks alike.
 
-    __slots__ = ("length", "block_ids")
+    ``host_payloads`` (host-tier caches only) are claimed host-RAM KV
+    payloads for the blocks immediately FOLLOWING the HBM match — one
+    per block, in prefix order.  The caller owns them outright (they
+    left the tier at claim time): it allocates fresh pool blocks and the
+    engine scatters the payloads back before the warm start, or drops
+    them (``HostKVTier.abandon``) when allocation fails."""
 
-    def __init__(self, length: int, block_ids: List[int]):
+    __slots__ = ("length", "block_ids", "host_payloads")
+
+    def __init__(self, length: int, block_ids: List[int],
+                 host_payloads: Optional[list] = None):
         self.length = length
         self.block_ids = block_ids
+        self.host_payloads = host_payloads or []
 
 
 _NODE_UIDS = itertools.count(1)
@@ -282,7 +291,7 @@ class _Node:
     the physical block id (the cache holds one pool reference on it)."""
 
     __slots__ = ("key", "parent", "children", "block_id", "last_used",
-                 "last_hit_wall", "uid")
+                 "last_hit_wall", "uid", "tier")
 
     def __init__(self, key: Tuple[int, ...], parent: Optional["_Node"],
                  block_id: int):
@@ -296,6 +305,12 @@ class _Node:
         # cold one, and what the reuse-gap histogram measures between
         self.last_hit_wall = 0.0
         self.uid = next(_NODE_UIDS)
+        # which tier holds this chunk's KV bytes: "hbm" (block_id is a
+        # live pool block the cache holds one reference on) or "host"
+        # (block_id is -1; the bytes live in the HostKVTier arena — or
+        # nowhere, if the tier entry was claimed/expired, in which case
+        # the node is a reusable stub that a later insert re-promotes)
+        self.tier = "hbm"
 
 
 class PagedPrefixCache:
@@ -339,6 +354,13 @@ class PagedPrefixCache:
         #: optional observer (tpustack.obs.kvprof.KVProfiler) fed lookup
         #: and eviction events OUTSIDE the trie lock; None = profiler off
         self.profiler = None
+        #: optional second-chance tier (tpustack.serving.kv_host_tier
+        #: .HostKVTier) — when set, evict() offers each victim's KV bytes
+        #: to host RAM instead of dropping them, and match() extends hits
+        #: through spilled chunks (returning claimed payloads for the
+        #: caller to restore pool-side).  None = spill disabled; every
+        #: path below degrades to the exact pre-tier behaviour.
+        self.host_tier = None
         self._root = _Node((), None, -1)  # guarded-by: _lock (writes)
         self._lock = threading.Lock()
         self._tick = 0  # guarded-by: _lock (writes)
@@ -352,16 +374,29 @@ class PagedPrefixCache:
         self.inserted_tokens = 0
         self.evicted_warm_total = 0
         self.evicted_cold_total = 0
+        self.host_hits = 0
+        self.host_hit_tokens = 0
         sanitize.install_guards(self)
 
     # ------------------------------------------------------------- lookup
     def match(self, ids: List[int]) -> PagedMatch:
         """Longest cached prefix of ``ids`` (whole blocks, capped at
         ``len(ids) - 1`` tokens).  Increfs every matched block before
-        returning — the caller owns those references (see PagedMatch)."""
+        returning — the caller owns those references (see PagedMatch).
+
+        With a host tier attached, the walk continues past the HBM
+        frontier through contiguous ``tier=host`` chunks: if the
+        restore-vs-recompute crossover says copying beats recomputing,
+        each chunk's payload is CLAIMED out of the tier (it now belongs
+        to the caller, who restores it into freshly allocated pool
+        blocks — or abandons it if allocation fails).  Claimed nodes stay
+        in the trie as payload-less stubs; the restoring request's
+        ``insert`` re-promotes them to HBM, keeping any deeper spilled
+        descendants reachable."""
         max_blocks = max(0, (len(ids) - 1) // self.block)
         now = time.time()
         prev_hit = 0.0
+        host_payloads: list = []
         with self._lock:
             self._tick += 1
             self.lookups += 1
@@ -369,21 +404,48 @@ class PagedPrefixCache:
             while depth < max_blocks:
                 key = tuple(ids[depth * self.block:(depth + 1) * self.block])
                 child = node.children.get(key)
-                if child is None:
+                if child is None or child.tier != "hbm":
                     break
                 child.last_used = self._tick
                 prev_hit = child.last_hit_wall
                 child.last_hit_wall = now
                 blocks.append(child.block_id)
                 node, depth = child, depth + 1
-            if not blocks:
+            tier = self.host_tier
+            if tier is not None and depth < max_blocks:
+                # probe the contiguous host chain first, then consult the
+                # crossover with the full restorable length
+                hnode, hdepth, chain = node, depth, []
+                while hdepth < max_blocks:
+                    key = tuple(
+                        ids[hdepth * self.block:(hdepth + 1) * self.block])
+                    c = hnode.children.get(key)
+                    if c is None or c.tier != "host":
+                        break
+                    chain.append(c)
+                    hnode, hdepth = c, hdepth + 1
+                if chain and tier.should_restore(len(chain)):
+                    for c in chain:
+                        payload = tier.claim(c)
+                        if payload is None:
+                            # stub (already claimed / LRU-expired): the
+                            # chunk's bytes are gone — hit ends here
+                            break
+                        host_payloads.append(payload)
+                        c.last_used = self._tick
+                        c.last_hit_wall = now
+            if not blocks and not host_payloads:
                 self.misses += 1
                 res = PagedMatch(0, [])
             else:
-                self.pool.incref(blocks)
+                if blocks:
+                    self.pool.incref(blocks)
                 self.hits += 1
                 self.hit_tokens += depth * self.block
-                res = PagedMatch(depth * self.block, blocks)
+                if host_payloads:
+                    self.host_hits += 1
+                    self.host_hit_tokens += len(host_payloads) * self.block
+                res = PagedMatch(depth * self.block, blocks, host_payloads)
         prof = self.profiler
         if prof is not None:
             # reuse gap = time since the DEEPEST matched node's previous
@@ -420,6 +482,18 @@ class PagedPrefixCache:
                     node.children[key] = child
                     self.entries += 1
                     new_tokens += self.block
+                elif child.tier != "hbm":
+                    # re-promote a spilled chunk: the caller holds fresh
+                    # HBM bytes for it (a restored host hit, or a plain
+                    # recompute of a claimed/expired stub) — adopt the new
+                    # block and retire any stale host copy
+                    self.pool.incref([bid])
+                    child.block_id = bid
+                    child.tier = "hbm"
+                    if self.host_tier is not None:
+                        self.host_tier.drop(child)
+                    self.entries += 1
+                    new_tokens += self.block
                 child.last_used = self._tick
                 child.last_hit_wall = now
                 node = child
@@ -427,13 +501,22 @@ class PagedPrefixCache:
         return new_tokens
 
     # ----------------------------------------------------------- eviction
+    @staticmethod
+    def _hbm_children(node: "_Node") -> bool:
+        """True when any direct child still holds a pool block.  Host
+        stubs are TRANSPARENT for eviction: a node whose children all
+        spilled is as evictable as a leaf (spilled descendants hold no
+        pool reference and survive in the host arena regardless)."""
+        return any(c.tier == "hbm" for c in node.children.values())
+
     def evictable_blocks(self) -> int:
         """Blocks the cache could release right now: resident nodes whose
         block only the cache references (no slot is decoding against it).
         This is what capacity-true admission adds to the free count."""
         with self._lock:
             return sum(1 for n in self._walk()
-                       if self.pool.refcount(n.block_id) == 1)
+                       if n.tier == "hbm"
+                       and self.pool.refcount(n.block_id) == 1)
 
     def evict(self, need_blocks: int) -> int:
         """Release up to ``need_blocks`` blocks, LRU leaves first (interior
@@ -443,50 +526,82 @@ class PagedPrefixCache:
         referenced; the block frees later when the slot retires and its
         decref reaches 0.  One trie walk total (a heap orders candidates),
         not one per freed block — this runs on the serving thread under
-        admission pressure.  Returns blocks actually freed."""
+        admission pressure.  Returns blocks actually freed.
+
+        With a host tier attached, each victim's KV bytes are offered to
+        host RAM before the block dies: on acceptance the node is
+        retagged ``tier=host`` (it stays in the trie; the payload lives
+        in the tier's arena) and the block frees with outcome
+        ``spilled``; on decline (copy failed, or the tier can never hold
+        a block) the node is removed exactly as before with outcome
+        ``evicted_warm``/``evicted_cold``.  EVERY victim takes exactly
+        one ``pool.decref(outcome=...)`` — the single path kvprof's
+        lifetime histogram and the tier counters both hang off, so a
+        declined spill can never double-count."""
         import heapq
 
         freed = 0
         warm = 0
+        spilled = 0
         now = time.time()
         hit_ages: List[float] = []
+        tier = self.host_tier
         with self._lock:
             heap = [(n.last_used, n.uid, n) for n in self._walk()
-                    if not n.children
+                    if n.tier == "hbm" and not self._hbm_children(n)
                     and self.pool.refcount(n.block_id) == 1]
             heapq.heapify(heap)
             while heap and freed < need_blocks:
                 _, _, leaf = heapq.heappop(heap)
                 # a promoted parent may have been re-checked stale; guard
-                if (leaf.children
+                if (leaf.tier != "hbm" or self._hbm_children(leaf)
                         or leaf.parent.children.get(leaf.key) is not leaf
                         or self.pool.refcount(leaf.block_id) != 1):
                     continue
-                leaf.parent.children.pop(leaf.key)
-                self.entries -= 1
-                self.evictions += 1
+                bid = leaf.block_id
                 # warm = the entry was hit recently enough that a bigger
                 # pool would plausibly have kept it (avoidable eviction)
                 age = ((now - leaf.last_hit_wall)
                        if leaf.last_hit_wall else -1.0)
-                if 0.0 <= age <= self.warm_s:
-                    warm += 1
-                    self.evicted_warm_total += 1
-                    outcome = "evicted_warm"
+                kept = False
+                if tier is not None:
+                    payload = tier.snapshot_block(bid)
+                    if payload is None:
+                        tier.decline()
+                    else:
+                        kept = tier.offer(leaf, payload)
+                if kept:
+                    outcome = "spilled"
+                    spilled += 1
+                    leaf.block_id = -1
+                    leaf.tier = "host"
                 else:
-                    self.evicted_cold_total += 1
-                    outcome = "evicted_cold"
+                    leaf.parent.children.pop(leaf.key)
+                    # spilled descendants of a dying node would become
+                    # unreachable — retire their arena entries with it
+                    self._drop_host_subtree(leaf)
+                    if 0.0 <= age <= self.warm_s:
+                        warm += 1
+                        self.evicted_warm_total += 1
+                        outcome = "evicted_warm"
+                    else:
+                        self.evicted_cold_total += 1
+                        outcome = "evicted_cold"
+                self.entries -= 1
+                self.evictions += 1
                 if age >= 0.0:
                     hit_ages.append(age)
-                freed += self.pool.decref([leaf.block_id], outcome=outcome)
+                freed += self.pool.decref([bid], outcome=outcome)
                 parent = leaf.parent
-                if (parent is not self._root and not parent.children
+                if (parent is not self._root and parent.tier == "hbm"
+                        and not self._hbm_children(parent)
                         and self.pool.refcount(parent.block_id) == 1):
                     heapq.heappush(heap,
                                    (parent.last_used, parent.uid, parent))
         if freed:
             log.info("paged prefix cache evicted %d block(s) "
-                     "(%d tokens, %d warm)", freed, freed * self.block, warm)
+                     "(%d tokens, %d warm, %d spilled to host)",
+                     freed, freed * self.block, warm, spilled)
             if self.on_evict is not None:
                 self.on_evict(freed)
             if warm and self.on_evict_warm is not None:
@@ -495,6 +610,22 @@ class PagedPrefixCache:
             if prof is not None:
                 prof.on_evictions(hit_ages, warm)
         return freed
+
+    def _drop_host_subtree(self, node: "_Node") -> None:
+        """Retire the tier entries of every host node under ``node``
+        (inclusive) — called when a node leaves the trie, so the arena
+        never holds bytes no lookup can reach.  Caller holds ``_lock``.
+        By construction the subtree of an eviction victim is host-only
+        (a candidate has no HBM children, and insert promotes ancestors
+        before descendants), but this walks everything to be safe."""
+        if self.host_tier is None:
+            return
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.tier == "host":
+                self.host_tier.drop(n)
 
     def _walk(self):
         stack = list(self._root.children.values())
@@ -507,15 +638,17 @@ class PagedPrefixCache:
     def clear(self) -> int:
         """Drop every resident node (decref all) — returns blocks freed."""
         with self._lock:
-            ids = [n.block_id for n in self._walk()]
+            ids = [n.block_id for n in self._walk() if n.tier == "hbm"]
             self._root = _Node((), None, -1)
             self.entries = 0
+            if self.host_tier is not None:
+                self.host_tier.clear()
             return self.pool.decref(ids) if ids else 0
 
     def stats(self) -> Dict[str, object]:
         with self._lock:
             lookups = self.hits + self.misses
-            return {
+            out = {
                 "enabled": True,
                 "paged": True,
                 "block_tokens": self.block,
@@ -529,6 +662,12 @@ class PagedPrefixCache:
                 "cached_tokens_served": self.hit_tokens,
                 "inserted_tokens": self.inserted_tokens,
             }
+        tier = self.host_tier
+        if tier is not None:
+            out["host_hits"] = self.host_hits
+            out["host_hit_tokens"] = self.host_hit_tokens
+            out["host_tier"] = tier.stats()
+        return out
 
 
 class PagedKVRuntime:

@@ -234,12 +234,12 @@ class _PendingCompletion:
                  "stream_put", "seed", "prefix", "kv_extract", "on_prefill_kv",
                  "phase", "span_ctx", "queue_span", "kv_blocks",
                  "on_prefill_blocks", "speculative", "tenant", "t_enqueue",
-                 "t_kv_alloc", "priority")
+                 "t_kv_alloc", "priority", "host_restore")
 
     def __init__(self, ids, n_predict, sample, future, stream_put=None,
                  seed=None, prefix=None, kv_extract=None, on_prefill_kv=None,
                  kv_blocks=None, on_prefill_blocks=None, speculative=True,
-                 t_kv_alloc=None):
+                 t_kv_alloc=None, host_restore=None):
         self.ids = ids
         self.n_predict = n_predict
         self.sample = sample
@@ -264,6 +264,12 @@ class _PendingCompletion:
         # ownership to the engine.
         self.kv_blocks = kv_blocks
         self.on_prefill_blocks = on_prefill_blocks
+        # host-tier warm start: (restore block ids, claimed payloads) —
+        # the restore ids also ride at the tail of prefix[1], so the
+        # refcount lifecycle is the ordinary prefix one; the PAYLOADS are
+        # this request's to deliver (or abandon back to the tier's
+        # conservation ledger if it dies queued)
+        self.host_restore = host_restore
         # per-request speculation opt-out (body `"speculative": false`)
         self.speculative = speculative
         # distributed tracing: the request's HTTP root-span context (engine
@@ -402,6 +408,12 @@ class LLMServer:
             self.paged.cache.on_evict_warm = (
                 lambda n: self.metrics[
                     "tpustack_llm_prefix_evicted_warm_total"].inc(n))
+            tier = getattr(self.paged.cache, "host_tier", None)
+            if tier is not None and tier.metrics is None:
+                # _build_paged is static (and tests hand-build runtimes):
+                # the spill/restore/expire counters attach here, once the
+                # server's metric set exists
+                tier.metrics = self.metrics
         # KV working-set observatory (tpustack.obs.kvprof): SHARDS-sampled
         # online miss-ratio curve, block-lifetime telemetry, Retry-After
         # calibration — observer hooks on the pool/trie, gauges refreshed
@@ -660,6 +672,18 @@ class LLMServer:
         arrays = init_kv_pool(gen.cfg, n_blocks + 1, block,
                               dtype=gen.cache_dtype, mesh=gen.kv_mesh)
         rt = PagedKVRuntime(arrays, pool, max_seq, cache)
+        tier_mb = knobs.get_float("TPUSTACK_KV_HOST_TIER_MB")
+        if cache is not None and tier_mb > 0:
+            from tpustack.serving.kv_host_tier import HostKVTier
+
+            # arrays_fn, not arrays: decode dispatches donate the pool
+            # buffers, so the tier must re-read the runtime's CURRENT
+            # reference at every spill
+            cache.host_tier = HostKVTier(
+                int(tier_mb * 1024 * 1024), pool,
+                arrays_fn=lambda: rt.arrays)
+            log.info("host KV tier on: %.0f MB arena behind the %d-block "
+                     "pool", tier_mb, n_blocks)
         log.info("paged KV pool: %d blocks x %d tokens (ctx %d, %d-slot "
                  "dense parity), %.2f GB total / %.2f GB per chip "
                  "(%d shard%s), prefix cache %s", n_blocks, block, max_seq,
@@ -783,22 +807,45 @@ class LLMServer:
 
         rt = self.paged
         prefix = None
+        host_restore = None
         if rt.cache is not None and cache_prompt:
             m = rt.cache.match(ids)
+            hit = bool(m.length or m.host_payloads)
             self.metrics["tpustack_llm_prefix_cache_lookups_total"].labels(
-                result="hit" if m.length else "miss").inc()
-            self.metrics["tpustack_llm_prefix_cached_tokens"].observe(
-                m.length)
-            span = obs_trace.current_span.get()
-            if span is not None:
-                span.add_event("prefix_cache",
-                               result="hit" if m.length else "miss",
-                               cached_tokens=m.length)
+                result="hit" if hit else "miss").inc()
             if m.length:
                 self.metrics[
                     "tpustack_llm_kv_copy_avoided_tokens_total"].inc(
                     m.length)
                 prefix = (m.length, m.block_ids)
+            host_tokens = 0
+            if m.host_payloads:
+                # host-tier warm start: seat the claimed payloads in fresh
+                # pool blocks riding the PREFIX refcount lifecycle (the
+                # engine fuses the host→HBM copy with the warm start).  A
+                # full pool downgrades to the HBM hit alone — abandon()
+                # keeps the tier's conservation ledger exact
+                tier = rt.cache.host_tier
+                n_host = len(m.host_payloads)
+                try:
+                    rt.ensure_free(n_host)
+                    restore_ids = rt.pool.alloc_tokens(n_host * rt.block)
+                except OutOfBlocks:
+                    tier.abandon(n_host)
+                else:
+                    prefix = (m.length + n_host * rt.block,
+                              m.block_ids + list(restore_ids))
+                    host_restore = (restore_ids, m.host_payloads)
+                    host_tokens = n_host * rt.block
+            self.metrics["tpustack_llm_prefix_cached_tokens"].observe(
+                m.length + host_tokens)
+            span = obs_trace.current_span.get()
+            if span is not None:
+                extra = ({"host_restored_tokens": host_tokens}
+                         if host_tokens else {})  # tier off: event shape
+                span.add_event("prefix_cache",  # identical to pre-tier
+                               result="hit" if hit else "miss",
+                               cached_tokens=m.length, **extra)
         n_shared = len(prefix[1]) if prefix else 0
         fresh_tokens = (rt.need_tokens(len(ids), max(0, n_predict))
                         - n_shared * rt.block)
@@ -806,6 +853,9 @@ class LLMServer:
         if n_shared + need_fresh > rt.pool.capacity_blocks:
             if prefix:
                 rt.pool.decref(prefix[1])
+            if host_restore:
+                # claimed payloads die unwritten: restored → expired
+                rt.cache.host_tier.abandon(len(host_restore[1]))
             raise ValueError(
                 f"request needs {n_shared + need_fresh} KV blocks; the "
                 f"pool holds {rt.pool.capacity_blocks} "
@@ -816,13 +866,20 @@ class LLMServer:
         except OutOfBlocks:
             if prefix:
                 rt.pool.decref(prefix[1])
+            if host_restore:
+                rt.cache.host_tier.abandon(len(host_restore[1]))
             self.metrics["tpustack_requests_shed_total"].labels(
                 server="llm", reason="out_of_kv_blocks").inc()
             shortfall = need_fresh - rt.pool.n_free
             raise OutOfKVBlocks(self._paged_retry_after(shortfall)) from None
         on_insert = None
         if (rt.cache is not None and cache_prompt
-                and len(ids) // rt.block > n_shared):
+                and (len(ids) // rt.block > n_shared
+                     or host_restore is not None)):
+            # host_restore forces the insert even with zero fresh full
+            # blocks: it is what RE-PROMOTES the claimed stubs onto their
+            # freshly-seated pool blocks (skipping it would free them at
+            # retire and strand the trie path)
             ids_copy = list(ids)
 
             def on_insert(bids):
@@ -834,7 +891,7 @@ class LLMServer:
                         "tpustack_llm_kv_copy_avoided_tokens_total"].inc(
                         new_toks)
         self._paged_gauges()
-        return prefix, kv_blocks, on_insert
+        return prefix, kv_blocks, on_insert, host_restore
 
     def _paged_release(self, r: "_PendingCompletion") -> None:
         """Release a QUEUED request's pool references (pre-allocated fresh
@@ -847,6 +904,14 @@ class LLMServer:
         if r.prefix:
             ids += list(r.prefix[1])
         r.kv_blocks, r.prefix = None, None
+        if r.host_restore is not None:
+            # died queued before the engine seated the payloads: their
+            # restore blocks free with the prefix refs above; the claims
+            # go back to the tier's ledger as expired
+            tier = getattr(self.paged.cache, "host_tier", None)
+            if tier is not None:
+                tier.abandon(len(r.host_restore[1]))
+            r.host_restore = None
         if ids:
             if r.tenant is not None and r.t_kv_alloc:
                 # the request died queued but its blocks were resident
@@ -972,10 +1037,11 @@ class LLMServer:
         ValueError) or the dense prefix-cache lookup.  Returns
         _PendingCompletion/SlotRequest kwargs."""
         if self.paged is not None and self._batchable():
-            prefix, kv_blocks, on_insert = self._paged_admit(
+            prefix, kv_blocks, on_insert, host_restore = self._paged_admit(
                 ids, n_predict, cache_prompt)
             return {"prefix": prefix, "kv_blocks": kv_blocks,
                     "on_prefill_blocks": on_insert,
+                    "host_restore": host_restore,
                     # admission IS allocation: KV-block-seconds run from
                     # this wall clock, queued time included
                     "t_kv_alloc": time.time()}
@@ -1041,7 +1107,8 @@ class LLMServer:
                            span_ctx=r.span_ctx, kv_blocks=r.kv_blocks,
                            on_prefill_blocks=r.on_prefill_blocks,
                            speculative=r.speculative, tenant=r.tenant,
-                           t_kv_alloc=r.t_kv_alloc, priority=r.priority)
+                           t_kv_alloc=r.t_kv_alloc, priority=r.priority,
+                           host_restore=r.host_restore)
 
     # -------------------------------------------------- QoS queue helpers
     def _pop_queued(self) -> "_PendingCompletion":
@@ -1198,6 +1265,9 @@ class LLMServer:
                     # re-enter after the lock's FIFO queue services it
                     self._wake.set()
             self._sanitize_quiesce()
+            if stats.get("prefill_chunks"):
+                self.metrics["tpustack_llm_prefill_chunks_total"].inc(
+                    stats["prefill_chunks"])
             if stats["requests"]:
                 self.metrics["tpustack_llm_batch_occupancy_slots"].observe(
                     stats["requests"])

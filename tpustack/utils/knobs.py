@@ -144,6 +144,30 @@ _declare("TPUSTACK_PREFIX_CACHE_MB", float, 512.0,
          "Resident host-byte cap for the DENSE prefix cache store.")
 _declare("TPUSTACK_PREFIX_CACHE_CHUNK", int, 256,
          "Snap granularity in tokens for the dense prefix cache.")
+_declare("TPUSTACK_KV_HOST_TIER_MB", float, 0.0,
+         "Host-RAM second tier for the paged prefix cache: evicted "
+         "refcount-0 prefix blocks spill device->host into an LRU arena "
+         "of this many megabytes instead of dying, and a warm match "
+         "restores them pool-side in one dispatch (no prefill FLOPs).  "
+         "0 is the bisection flag — no tier constructs, eviction and "
+         "match are byte-for-byte the tier-free paths.")
+_declare("TPUSTACK_KV_HOST_TIER_CROSSOVER", bool, True,
+         "Restore-vs-recompute crossover guard for the host KV tier: "
+         "when on (default), a warm host-tier match only restores if the "
+         "measured per-block copy cost undercuts the measured per-block "
+         "prefill cost (otherwise recompute wins and the chain is left "
+         "resident).  0 restores unconditionally — for tiny/CPU shapes "
+         "where both EMAs are dispatch noise (CI smokes, bench tiny "
+         "presets); HBM-scale deployments keep the guard.")
+_declare("TPUSTACK_PREFILL_CHUNK_TOKENS", int, 0,
+         "Chunked prefill for the paged continuous engine: a prompt "
+         "whose uncached remainder exceeds this many tokens prefills in "
+         "block-aligned chunks of (at most) this size, parking between "
+         "chunks so decode waves of other slots interleave — long "
+         "prompts stop monopolising the device.  Admission still "
+         "charges the full block footprint up front.  0 disables "
+         "(bisection: admission is byte-for-byte the monolithic "
+         "prefill).")
 
 # -------------------------------------------------------------- speculative
 _declare("TPUSTACK_SPEC_TOKENS", int, 4,
