@@ -67,6 +67,12 @@ def test_llm_extras_schema(monkeypatch):
                               "counterfactual_hit_ratio": {"2x": 0.8}},
                    "server_kvcache": {"enabled": True,
                                       "working_set_blocks": 9.0},
+                   # L7 router view when the replay drove through
+                   # tpustack.serving.router (--url at the router)
+                   "server_router": {
+                       "requests": {"ok": 50},
+                       "failovers": {"connect_error": 1},
+                       "affinity": {"hit": 22, "hit_ratio": 0.85}},
                    # provenance + exact-counter signature (PR 13): every
                    # tool artifact carries them and the driver keeps them
                    "meta": {"schema_version": 1, "git_sha": "cafe",
@@ -108,6 +114,9 @@ def test_llm_extras_schema(monkeypatch):
     assert out["paged"]["kvprof"]["working_set_blocks"] == 12.0
     assert out["paged"]["kvprof"]["counterfactual_hit_ratio"]["2x"] == 0.8
     assert out["replay"]["server_kvcache"]["working_set_blocks"] == 9.0
+    # the router's health/failover/affinity view rides the replay cell
+    assert out["replay"]["server_router"]["affinity"]["hit_ratio"] == 0.85
+    assert out["replay"]["server_router"]["failovers"]["connect_error"] == 1
     # the host-tier ledger + off/on tables ride the host_tier cell, the
     # chunk tables ride chunked_prefill
     assert out["host_tier"]["host_tier"]["spilled_total"] == 23

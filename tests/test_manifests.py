@@ -518,3 +518,119 @@ def test_llm_prefix_cache_knobs_declared():
         assert env.get("TPUSTACK_PREFIX_CACHE") == "1"
         assert float(env["TPUSTACK_PREFIX_CACHE_MB"]) > 0
         assert int(env["TPUSTACK_PREFIX_CACHE_CHUNK"]) > 0
+
+
+def test_router_fronts_scaled_out_llm_replicas():
+    """The scale-out pairing: >1 llm replica, a headless per-pod Service
+    the router discovers backends through (dns://), and a stable VIP
+    Service clients point at."""
+    docs = _load_all(CLUSTER / "apps" / "llm" / "router-deployment.yaml")
+    headless = next(d for d in docs if d.get("kind") == "Service"
+                    and d["metadata"]["name"] == "coder-llm-pods")
+    assert str(headless["spec"]["clusterIP"]) == "None"  # headless
+    assert headless["spec"]["selector"] == {"app": "coder-llm"}
+    assert headless["spec"]["publishNotReadyAddresses"] is True
+
+    router = next(d for d in docs if d.get("kind") == "Deployment")
+    srv = router["spec"]["template"]["spec"]["containers"][0]
+    assert "tpustack.serving.router" in " ".join(srv["command"])
+    env = {e["name"]: e.get("value") for e in srv["env"]}
+    assert env["TPUSTACK_ROUTER_BACKENDS"].startswith(
+        "dns://coder-llm-pods.")
+    assert srv["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    assert srv["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert "google.com/tpu" not in (srv["resources"].get("limits") or {})
+
+    vip = next(d for d in docs if d.get("kind") == "Service"
+               and d["metadata"]["name"] == "coder-llm-router")
+    assert vip["spec"]["selector"] == {"app": "coder-llm-router"}
+
+    llm = next(d for d in _load_all(CLUSTER / "apps" / "llm"
+                                    / "deployment.yaml")
+               if d.get("kind") == "Deployment")
+    assert llm["spec"]["replicas"] > 1
+
+
+def test_manifest_lint_catches_router_violations(tmp_path):
+    """The TPL601 router pairing rule: scaled-out llm replicas without a
+    router, a router with no backends, a dns:// spec pointing at a
+    missing or non-headless Service."""
+    lint = _import_lint_manifests().lint
+    llm = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "llm", "namespace": "x"},
+        "spec": {"replicas": 3, "template": {
+            "metadata": {"labels": {"app": "llm"}},
+            "spec": {"terminationGracePeriodSeconds": 45, "containers": [{
+                "name": "srv",
+                "command": ["python", "-m", "tpustack.serving.llm_server"],
+                "resources": {"requests": {"cpu": 1, "memory": "1Gi"},
+                              "limits": {"cpu": 1, "memory": "1Gi"}},
+                "readinessProbe": {"httpGet": {"path": "/readyz"}},
+                "livenessProbe": {"httpGet": {"path": "/healthz"}},
+            }]},
+        }}}
+
+    (tmp_path / "llm.yaml").write_text(yaml.safe_dump(llm))
+    errors = "\n".join(lint(root=tmp_path))
+    assert "no router Deployment" in errors
+
+    def router(backends):
+        env = ([{"name": "TPUSTACK_ROUTER_BACKENDS", "value": backends}]
+               if backends else [])
+        return {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "router", "namespace": "x"},
+            "spec": {"template": {
+                "metadata": {"labels": {"app": "router"}},
+                "spec": {"terminationGracePeriodSeconds": 45,
+                         "containers": [{
+                             "name": "router",
+                             "command": ["python", "-m",
+                                         "tpustack.serving.router"],
+                             "env": env,
+                             "resources": {
+                                 "requests": {"cpu": 1, "memory": "1Gi"},
+                                 "limits": {"cpu": 1, "memory": "1Gi"}},
+                             "readinessProbe": {
+                                 "httpGet": {"path": "/readyz"}},
+                             "livenessProbe": {
+                                 "httpGet": {"path": "/healthz"}},
+                         }]},
+            }}}
+
+    (tmp_path / "router.yaml").write_text(yaml.safe_dump(router(None)))
+    errors = "\n".join(lint(root=tmp_path))
+    assert "constructs nothing" in errors
+    assert "no router Deployment" not in errors  # pairing satisfied
+
+    (tmp_path / "router.yaml").write_text(yaml.safe_dump(
+        router("dns://llm-pods.x.svc.cluster.local:8080")))
+    errors = "\n".join(lint(root=tmp_path))
+    assert "no manifest defines" in errors
+
+    svc = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "llm-pods", "namespace": "x"},
+        "spec": {"clusterIP": "10.0.0.1", "selector": {"app": "llm"},
+                 "ports": [{"port": 8080, "targetPort": 8080}]},
+    }
+    (tmp_path / "svc.yaml").write_text(yaml.safe_dump(svc))
+    errors = "\n".join(lint(root=tmp_path))
+    assert "not headless" in errors
+
+    svc["spec"]["clusterIP"] = None
+    svc["spec"]["selector"] = {"app": "nothing-has-this-label"}
+    (tmp_path / "svc.yaml").write_text(yaml.safe_dump(svc))
+    errors = "\n".join(lint(root=tmp_path))
+    assert "matches no Deployment" in errors
+
+    svc["spec"]["selector"] = {"app": "llm"}
+    svc["spec"]["ports"] = [{"port": 80, "targetPort": 9999}]
+    (tmp_path / "svc.yaml").write_text(yaml.safe_dump(svc))
+    errors = "\n".join(lint(root=tmp_path))
+    assert "port 8080 is not served" in errors
+
+    svc["spec"]["ports"] = [{"port": 80, "targetPort": 8080}]
+    (tmp_path / "svc.yaml").write_text(yaml.safe_dump(svc))
+    assert lint(root=tmp_path) == []

@@ -1,0 +1,962 @@
+"""The L7 router (tpustack.serving.router): registry parsing, rendezvous
+affinity, the circuit-breaker state machine, shed-aware steering against
+stub replicas, streaming failover semantics, the debug/ready surfaces,
+and the knob-family bisection contract (unset = nothing constructed).
+
+The steering tests run the REAL router app against real aiohttp stub
+backends on loopback ports — the spill/relay decisions are exercised
+through actual HTTP, not by calling private helpers.  The end-to-end
+byte-identity test puts a tiny LLMServer behind the router and checks
+the routed greedy completion matches the direct one.
+"""
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpustack.obs import Registry
+from tpustack.serving.resilience import SHED_REASONS
+from tpustack.serving.router import (HEALTHY, OPEN, SPILL_REASONS,
+                                     WORK_PATHS, Router, _normalize_url,
+                                     maybe_from_env, parse_backend_spec,
+                                     rendezvous_rank)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+#: quiet unit-test knobs: the health thread sleeps 30 s before its first
+#: tick (tests drive probes/steering directly), jitter off for determinism
+_QUIET = {
+    "TPUSTACK_ROUTER_HEALTH_INTERVAL_S": "30",
+    "TPUSTACK_ROUTER_EJECT_AFTER": "2",
+    "TPUSTACK_ROUTER_HALF_OPEN_S": "60",
+    "TPUSTACK_ROUTER_RETRY_BUDGET": "2",
+    "TPUSTACK_ROUTER_RETRY_JITTER_S": "0",
+    "TPUSTACK_ROUTER_AFFINITY_CHUNK": "8",
+    "TPUSTACK_ROUTER_UPSTREAM_TIMEOUT_S": "10",
+}
+
+
+def make_router(spec, **overrides):
+    env = dict(_QUIET)
+    env.update(overrides)
+    return Router(spec, registry=Registry(), env=env)
+
+
+def _free_port() -> int:
+    """A port that was just free — connecting to it is refused fast."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------ pure helpers
+def test_parse_backend_spec_forms():
+    assert parse_backend_spec("http://a:1,http://b:2") == {
+        "mode": "static", "urls": "http://a:1,http://b:2"}
+    assert parse_backend_spec("@/etc/backends") == {
+        "mode": "file", "path": "/etc/backends"}
+    assert parse_backend_spec("dns://svc.ns.svc.cluster.local:8080") == {
+        "mode": "dns", "host": "svc.ns.svc.cluster.local", "port": "8080"}
+    with pytest.raises(ValueError):
+        parse_backend_spec("dns://no-port")
+    with pytest.raises(ValueError):
+        parse_backend_spec("dns://:8080")
+
+
+def test_normalize_url():
+    assert _normalize_url(" host:8080/ ") == "http://host:8080"
+    assert _normalize_url("https://x/") == "https://x"
+    assert _normalize_url("") == ""
+
+
+def test_rendezvous_deterministic_and_minimal_reshuffle():
+    backends = [f"http://10.0.0.{i}:8080" for i in range(5)]
+    keys = [f"key-{i}" for i in range(200)]
+    first = {k: rendezvous_rank(k, backends)[0] for k in keys}
+    # deterministic: same inputs, same ranking (order of list irrelevant)
+    assert all(rendezvous_rank(k, list(reversed(backends)))[0] == first[k]
+               for k in keys)
+    # minimal reshuffle: removing one backend moves ONLY its keys
+    gone = backends[2]
+    survivors = [b for b in backends if b != gone]
+    for k in keys:
+        now = rendezvous_rank(k, survivors)[0]
+        if first[k] != gone:
+            assert now == first[k], "key moved although its owner survived"
+        else:
+            assert now in survivors
+
+
+# --------------------------------------------------- registry + circuit
+def test_registry_static_and_file_reload(tmp_path):
+    r = make_router("http://127.0.0.1:1001, http://127.0.0.1:1002,")
+    try:
+        # registry order is the spec order (deterministic debug output)
+        assert r.backends() == ["http://127.0.0.1:1001",
+                                "http://127.0.0.1:1002"]
+        assert r.healthy_backends() == r.backends()
+    finally:
+        r.close()
+
+    path = tmp_path / "backends"
+    path.write_text("http://127.0.0.1:2001\nhttp://127.0.0.1:2002\n")
+    r = make_router(f"@{path}")
+    try:
+        assert len(r.backends()) == 2
+        # eject one, then reload the file: the persisting backend KEEPS
+        # its circuit state, the removed one is gone, the new one is fresh
+        r._apply_probe("http://127.0.0.1:2001", "unready")
+        os.utime(path, (0, 0))  # force an mtime change
+        path.write_text("http://127.0.0.1:2001\nhttp://127.0.0.1:2003\n")
+        r._apply_registry(r._resolve_spec())
+        assert set(r.backends()) == {"http://127.0.0.1:2001",
+                                     "http://127.0.0.1:2003"}
+        assert r.healthy_backends() == ["http://127.0.0.1:2003"]
+    finally:
+        r.close()
+
+
+def test_backend_removal_drops_metric_series(tmp_path):
+    """dns:// pod churn replaces pod IPs on every restart — a removed
+    backend's healthy_state/ejections series must disappear from the
+    scrape, not linger as zeros growing label cardinality forever."""
+    a, b = "http://127.0.0.1:6001", "http://127.0.0.1:6002"
+    path = tmp_path / "backends"
+    path.write_text(f"{a}\n{b}\n")
+    reg = Registry()
+    r = Router(f"@{path}", registry=reg, env=_QUIET)
+    try:
+        r._apply_probe(a, "unready")  # mints a's ejections series too
+        text = reg.render()
+        assert f'backend="{a}"' in text
+        os.utime(path, (0, 0))  # force an mtime change
+        path.write_text(f"{b}\n")
+        r._apply_registry(r._resolve_spec())
+        text = reg.render()
+        assert f'backend="{a}"' not in text
+        assert f'backend="{b}"' in text
+    finally:
+        r.close()
+
+
+def test_circuit_breaker_state_machine():
+    a, b = "http://127.0.0.1:3001", "http://127.0.0.1:3002"
+    r = make_router(f"{a},{b}")  # eject_after=2
+    try:
+        # one "down" is flapping tolerance, two is an open circuit
+        r._apply_probe(a, "down")
+        assert r.healthy_backends() == [a, b]
+        r._apply_probe(a, "down")
+        assert r.healthy_backends() == [b]
+        with r._lock:
+            assert r._backends[a]["state"] == OPEN
+            assert r._backends[a]["ejections"] == 1
+        # re-eject while open does NOT double-count ejections
+        r._apply_probe(a, "down")
+        with r._lock:
+            assert r._backends[a]["ejections"] == 1
+        # half-open probe ok -> re-admitted with a clean slate
+        r._apply_probe(a, "ok")
+        assert set(r.healthy_backends()) == {a, b}
+        with r._lock:
+            assert r._backends[a] == {"state": HEALTHY, "fails": 0,
+                                      "opened_at": r._backends[a]["opened_at"],
+                                      "ejections": 1}
+        # "unready" (the server ANSWERED no, e.g. draining) is
+        # authoritative: immediate ejection, no flapping tolerance
+        r._apply_probe(b, "unready")
+        assert r.healthy_backends() == [a]
+    finally:
+        r.close()
+
+
+def test_passive_outlier_ejection_and_success_reset():
+    a, b = "http://127.0.0.1:3003", "http://127.0.0.1:3004"
+    r = make_router(f"{a},{b}")
+    try:
+        r.note_failure(a, "connect_error")
+        assert a in r.healthy_backends()
+        r.note_success(a)  # a real success resets the strike count
+        r.note_failure(a, "connect_error")
+        assert a in r.healthy_backends()
+        r.note_failure(a, "connect_error")
+        assert r.healthy_backends() == [b]
+    finally:
+        r.close()
+
+
+def test_half_open_gating_in_health_tick():
+    # a freshly-opened circuit is NOT probed until half_open_s elapses;
+    # once it is, the (dead) probe re-arms the open timer
+    a = f"http://127.0.0.1:{_free_port()}"
+    r = make_router(a, TPUSTACK_ROUTER_HALF_OPEN_S="60",
+                    TPUSTACK_ROUTER_HEALTH_INTERVAL_S="0.05")
+    try:
+        r._stop.set()  # freeze the background thread; tick manually
+        r._apply_probe(a, "unready")
+        with r._lock:
+            opened = r._backends[a]["opened_at"]
+        r._health_tick()  # within half_open_s: skipped, timer untouched
+        with r._lock:
+            assert r._backends[a]["opened_at"] == opened
+        with r._lock:
+            r._backends[a]["opened_at"] -= 120  # age past half_open_s
+        r._health_tick()  # half-open probe fires, fails, re-arms
+        with r._lock:
+            assert r._backends[a]["state"] == OPEN
+            assert r._backends[a]["opened_at"] > opened - 1
+    finally:
+        r.close()
+
+
+# ------------------------------------------------------------- affinity
+def test_affinity_key_block_aligned_chunking():
+    r = make_router("http://127.0.0.1:4001")  # chunk=8
+    try:
+        # the key is the LARGEST block-aligned prefix: prompts agreeing
+        # on it share a key regardless of the (sub-chunk) tail
+        assert r.affinity_key("abcdefgh-tail1") == \
+            r.affinity_key("abcdefgh-tail2")  # both floor to "abcdefgh"
+        assert r.affinity_key("abcdefgX-tail") != \
+            r.affinity_key("abcdefgh-tail1")
+        # shorter than one chunk: the whole prompt is the key
+        assert r.affinity_key("ab") == r.affinity_key("ab")
+        assert r.affinity_key("ab") != r.affinity_key("ac")
+    finally:
+        r.close()
+
+
+def test_affinity_ledger_hit_cold_move_new_and_lru_bound():
+    r = make_router("http://127.0.0.1:4002",
+                    TPUSTACK_ROUTER_AFFINITY_KEYS="16")
+    try:
+        assert r.note_affinity("k1", "a") == "new"
+        assert r.note_affinity("k1", "a") == "hit"
+        assert r.note_affinity("k1", "b") == "cold_move"
+        for i in range(20):  # evicts k1 (bound is 16)
+            r.note_affinity(f"bulk-{i}", "a")
+        with r._lock:
+            assert len(r._affinity) == 16
+        assert r.note_affinity("k1", "b") == "new"
+    finally:
+        r.close()
+
+
+# ----------------------------------------------- steering (stub replicas)
+class StubReplica:
+    """A scripted /completion backend: ``script`` maps the 1-based call
+    number to a response factory; the last entry repeats."""
+
+    def __init__(self, *script):
+        self.script = list(script)
+        self.calls = []
+
+    def build_app(self):
+        async def completion(request):
+            self.calls.append({"headers": dict(request.headers),
+                               "body": await request.json()})
+            factory = self.script[min(len(self.calls), len(self.script)) - 1]
+            return factory(request)
+
+        async def readyz(request):
+            return web.json_response({"ready": True})
+
+        app = web.Application()
+        app.router.add_post("/completion", completion)
+        app.router.add_get("/readyz", readyz)
+        return app
+
+
+def ok_json(request):
+    return web.json_response({"content": "served"})
+
+
+def shed(reason, retry_after="0"):
+    def factory(request):
+        return web.json_response(
+            {"error": reason},
+            status=429 if reason == "quota" else 503,
+            headers={"X-Shed-Reason": reason, "Retry-After": retry_after})
+    return factory
+
+
+def deadline_504(request):
+    return web.json_response({"error": "deadline"}, status=504,
+                             headers={"X-Shed-Reason": "deadline"})
+
+
+def bare_500(request):
+    return web.json_response({"error": "boom"}, status=500)
+
+
+def _order(router_or_urls, urls, prompt):
+    key = (router_or_urls.affinity_key(prompt)
+           if isinstance(router_or_urls, Router) else router_or_urls)
+    return rendezvous_rank(key, urls)
+
+
+async def _scripted_pair(prompt, winner_script, loser_script, overrides=None):
+    """Two stub replicas with the affinity winner/loser scripted
+    explicitly (the rendezvous order depends only on key + urls, so a
+    throwaway router learns it before the real one routes); returns
+    (client_resp, roles, router, cleanup)."""
+    stubs = [StubReplica(ok_json), StubReplica(ok_json)]
+    servers = [TestServer(s.build_app()) for s in stubs]
+    for s in servers:
+        await s.start_server()
+    urls = [str(s.make_url("/")).rstrip("/") for s in servers]
+    probe = Router(",".join(urls), registry=Registry(), env=_QUIET)
+    order = _order(probe, urls, prompt)
+    probe.close()
+    winner = stubs[urls.index(order[0])]
+    loser = stubs[urls.index(order[1])]
+    winner.script = list(winner_script)
+    loser.script = list(loser_script)
+    router = Router(",".join(urls), registry=Registry(),
+                    env={**_QUIET, **(overrides or {})})
+    client = TestClient(TestServer(router.build_app()))
+    await client.start_server()
+
+    async def cleanup():
+        await client.close()
+        for s in servers:
+            await s.close()
+        router.close()
+
+    resp = await client.post("/completion",
+                             json={"prompt": prompt, "n_predict": 1})
+    return resp, {"winner": winner, "loser": loser, "order": order}, \
+        router, cleanup
+
+
+def test_steering_affinity_winner_serves():
+    async def scenario():
+        resp, roles, router, cleanup = await _scripted_pair(
+            "affinity-prompt", [ok_json], [ok_json])
+        try:
+            assert resp.status == 200
+            assert (await resp.json())["content"] == "served"
+            assert resp.headers["X-Router-Backend"] == roles["order"][0]
+            assert len(roles["winner"].calls) == 1
+            assert len(roles["loser"].calls) == 0
+            # a second request with the same prefix chunk is an affinity hit
+            assert router.note_affinity(
+                router.affinity_key("affinity-prompt"),
+                roles["order"][0]) == "hit"
+        finally:
+            await cleanup()
+    _run(scenario())
+
+
+def test_steering_spillable_shed_fails_over():
+    async def scenario():
+        resp, roles, router, cleanup = await _scripted_pair(
+            "spill-me-please", [shed("out_of_kv_blocks")], [ok_json])
+        try:
+            assert resp.status == 200
+            assert (await resp.json())["content"] == "served"
+            # spilled: winner shed, loser served, header names the server
+            assert resp.headers["X-Router-Backend"] == roles["order"][1]
+            assert len(roles["winner"].calls) == 1
+            assert len(roles["loser"].calls) == 1
+            with router._lock:
+                assert router._failovers == {"out_of_kv_blocks": 1}
+                assert router._outcomes == {"ok": 1}
+        finally:
+            await cleanup()
+    _run(scenario())
+
+
+def test_steering_quota_is_relayed_never_spilled():
+    async def scenario():
+        resp, roles, router, cleanup = await _scripted_pair(
+            "quota-prompt", [shed("quota", "7")], [ok_json])
+        try:
+            assert resp.status == 429
+            assert resp.headers["X-Shed-Reason"] == "quota"
+            assert resp.headers["Retry-After"] == "7"
+            assert resp.headers["X-Router-Backend"] == roles["order"][0]
+            assert len(roles["winner"].calls) == 1
+            assert len(roles["loser"].calls) == 0  # policy, not capacity
+            with router._lock:
+                assert router._failovers == {}
+                assert router._outcomes == {"shed": 1}
+        finally:
+            await cleanup()
+    _run(scenario())
+
+
+def test_steering_deadline_relayed_honestly():
+    async def scenario():
+        resp, roles, router, cleanup = await _scripted_pair(
+            "deadline-prompt", [deadline_504], [ok_json])
+        try:
+            assert resp.status == 504
+            assert len(roles["loser"].calls) == 0  # budget already spent
+            with router._lock:
+                assert router._outcomes == {"deadline": 1}
+        finally:
+            await cleanup()
+    _run(scenario())
+
+
+def test_steering_bare_500_spills_and_strikes():
+    async def scenario():
+        resp, roles, router, cleanup = await _scripted_pair(
+            "boom-prompt", [bare_500], [ok_json],
+            overrides={"TPUSTACK_ROUTER_EJECT_AFTER": "1"})
+        try:
+            assert resp.status == 200
+            assert len(roles["winner"].calls) == 1
+            assert len(roles["loser"].calls) == 1
+            with router._lock:
+                assert router._failovers == {"http_5xx": 1}
+                # bare 5xx counted toward passive ejection (eject_after=1)
+                assert router._backends[roles["order"][0]]["state"] == OPEN
+        finally:
+            await cleanup()
+    _run(scenario())
+
+
+def test_relayed_4xx_counts_client_error_not_ok():
+    """A relayed client error (400 malformed body, no shed header) must
+    not inflate tpustack_router_requests_total{outcome="ok"} — the
+    catalog documents ok as successful proxying."""
+    def bad_request(request):
+        return web.json_response({"error": "malformed"}, status=400)
+
+    async def scenario():
+        resp, roles, router, cleanup = await _scripted_pair(
+            "bad-body-prompt", [bad_request], [ok_json])
+        try:
+            assert resp.status == 400
+            assert len(roles["loser"].calls) == 0  # follows the client
+            with router._lock:
+                assert router._outcomes == {"client_error": 1}
+                assert router._failovers == {}
+        finally:
+            await cleanup()
+    _run(scenario())
+
+
+def test_waited_retry_single_backend_recovers():
+    """All healthy backends tried + budget left → a short Retry-After
+    wait and a second pass over the SAME set (a failover surge filling
+    the survivor's KV pool clears within a service time)."""
+    async def scenario():
+        stub = StubReplica(shed("out_of_kv_blocks", "0"), ok_json)
+        server = TestServer(stub.build_app())
+        await server.start_server()
+        url = str(server.make_url("/")).rstrip("/")
+        router = Router(url, registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion",
+                                  json={"prompt": "retry", "n_predict": 1})
+            assert r.status == 200
+            assert (await r.json())["content"] == "served"
+            assert len(stub.calls) == 2  # shed once, then served
+        finally:
+            await client.close()
+            await server.close()
+            router.close()
+    _run(scenario())
+
+
+def test_retry_budget_bounds_attempts_then_relays_last_shed():
+    async def scenario():
+        stub = StubReplica(shed("out_of_kv_blocks", "0"))  # always sheds
+        server = TestServer(stub.build_app())
+        await server.start_server()
+        url = str(server.make_url("/")).rstrip("/")
+        router = Router(url, registry=Registry(),
+                        env={**_QUIET, "TPUSTACK_ROUTER_RETRY_BUDGET": "2"})
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion",
+                                  json={"prompt": "hopeless", "n_predict": 1})
+            assert r.status == 503
+            assert r.headers["X-Shed-Reason"] == "out_of_kv_blocks"
+            # budget=2 bounds TOTAL attempts at 1 + 2 retries... the
+            # budget buys exactly budget extra attempts
+            assert len(stub.calls) == 2
+        finally:
+            await client.close()
+            await server.close()
+            router.close()
+    _run(scenario())
+
+
+def test_retry_wait_honors_capped_retry_after():
+    r = make_router("http://127.0.0.1:4003")
+    try:
+        assert r._retry_wait_s(None) == 0.0  # jitter off in _QUIET
+        assert r._retry_wait_s({"headers": {"Retry-After": "0.3"}}) == \
+            pytest.approx(0.3)
+        # a mis-set header can't stall an interactive request: cap 1 s
+        assert r._retry_wait_s({"headers": {"Retry-After": "3600"}}) == 1.0
+        assert r._retry_wait_s({"headers": {"Retry-After": "nope"}}) == 0.0
+        assert r._retry_wait_s({"kind": "conn_error"}) == 0.0
+    finally:
+        r.close()
+
+
+def test_connect_error_fails_over_then_502_when_alone():
+    async def scenario():
+        dead = f"http://127.0.0.1:{_free_port()}"
+        stub = StubReplica(ok_json)
+        server = TestServer(stub.build_app())
+        await server.start_server()
+        live = str(server.make_url("/")).rstrip("/")
+        # dead + live: whatever the rendezvous order, the request ends up
+        # served (connect errors spill) and the dead backend took a strike
+        router = Router(f"{dead},{live}", registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion",
+                                  json={"prompt": "x" * 64, "n_predict": 1})
+            assert r.status == 200
+            assert r.headers["X-Router-Backend"] == live
+        finally:
+            await client.close()
+            await server.close()
+            router.close()
+
+        # alone and dead: the client gets an honest 502, not a hang
+        router = Router(dead, registry=Registry(),
+                        env={**_QUIET, "TPUSTACK_ROUTER_RETRY_BUDGET": "0"})
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion",
+                                  json={"prompt": "y", "n_predict": 1})
+            assert r.status == 502
+            assert "connect_error" in (await r.json())["error"]
+        finally:
+            await client.close()
+            router.close()
+    _run(scenario())
+
+
+# ------------------------------------------------------------- streaming
+class StreamReplica:
+    def __init__(self, chunks):
+        self.chunks = chunks
+        self.calls = 0
+
+    def build_app(self):
+        async def completion(request):
+            self.calls += 1
+            await request.read()
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            for c in self.chunks:
+                await resp.write(c)
+            await resp.write_eof()
+            return resp
+
+        async def readyz(request):
+            return web.json_response({"ready": True})
+
+        app = web.Application()
+        app.router.add_post("/completion", completion)
+        app.router.add_get("/readyz", readyz)
+        return app
+
+
+def test_streaming_relay_and_pre_first_byte_failover():
+    async def scenario():
+        chunks = [b"data: tok1\n\n", b"data: tok2\n\n", b"data: [DONE]\n\n"]
+        stub = StreamReplica(chunks)
+        server = TestServer(stub.build_app())
+        await server.start_server()
+        live = str(server.make_url("/")).rstrip("/")
+        dead = f"http://127.0.0.1:{_free_port()}"
+        # dead backend in the set: a connect failure happens BEFORE the
+        # first byte, so the stream fails over and arrives intact
+        router = Router(f"{dead},{live}", registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion", json={
+                "prompt": "s" * 64, "n_predict": 3, "stream": True})
+            body = await r.read()
+            assert r.status == 200
+            assert r.headers["X-Router-Backend"] == live
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            assert body == b"".join(chunks)
+            with router._lock:
+                assert router._outcomes.get("ok") == 1
+        finally:
+            await client.close()
+            await server.close()
+            router.close()
+    _run(scenario())
+
+
+def test_streaming_without_middleware_body_parse():
+    """The obs middleware only parses POST application/json bodies up to
+    its size bound — a content type it skips (standing in for the >1 MB
+    long-context case) must still stream: the router parses the raw
+    bytes itself, so stream:true takes the chunked relay path and the
+    affinity key comes from the prompt field, not a raw-body hash."""
+    async def scenario():
+        chunks = [b"data: tok\n\n", b"data: [DONE]\n\n"]
+        stub = StreamReplica(chunks)
+        server = TestServer(stub.build_app())
+        await server.start_server()
+        live = str(server.make_url("/")).rstrip("/")
+        router = Router(live, registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            payload = json.dumps({"prompt": "p" * 64, "stream": True})
+            r = await client.post(
+                "/completion", data=payload.encode(),
+                headers={"Content-Type": "application/octet-stream"})
+            body = await r.read()
+            assert r.status == 200
+            assert body == b"".join(chunks)
+            # chunked relay, not a buffered whole-response replay
+            assert "Content-Length" not in r.headers
+            # the affinity key is the PROMPT's prefix digest — the same
+            # request sent as application/json lands on the same key
+            key = router.affinity_key("p" * 64)
+            with router._lock:
+                assert router._affinity.get(key) == live
+        finally:
+            await client.close()
+            await server.close()
+            router.close()
+    _run(scenario())
+
+
+def test_upstream_event_stream_relayed_chunked_without_stream_flag():
+    """Defence in depth: an upstream that answers text/event-stream even
+    though the request never said stream:true is relayed chunk by chunk
+    (bounded by the total timeout), not buffered into memory first."""
+    async def scenario():
+        chunks = [b"data: a\n\n", b"data: b\n\n"]
+        stub = StreamReplica(chunks)
+        server = TestServer(stub.build_app())
+        await server.start_server()
+        live = str(server.make_url("/")).rstrip("/")
+        router = Router(live, registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion",
+                                  json={"prompt": "x" * 16, "n_predict": 2})
+            body = await r.read()
+            assert r.status == 200
+            assert body == b"".join(chunks)
+            assert "Content-Length" not in r.headers
+            with router._lock:
+                assert router._outcomes == {"ok": 1}
+        finally:
+            await client.close()
+            await server.close()
+            router.close()
+    _run(scenario())
+
+
+# ------------------------------------------------------- app-level views
+def test_readyz_and_debug_router_surfaces():
+    async def scenario():
+        dead = f"http://127.0.0.1:{_free_port()}"
+        router = Router(dead, registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            # backend registered but not yet ejected: ready
+            r = await client.get("/readyz")
+            assert r.status == 200
+            r = await client.get("/healthz")
+            assert r.status == 200
+            assert (await r.json())["backends"] == 1
+
+            # empty healthy set: the router must leave Service rotation,
+            # with the machine-readable reason on the 503
+            router._apply_probe(dead, "unready")
+            r = await client.get("/readyz")
+            assert r.status == 503
+            assert r.headers["X-Shed-Reason"] == "no_backend"
+            assert "Retry-After" in r.headers
+            # healthz stays 200: the process itself is alive
+            r = await client.get("/healthz")
+            assert r.status == 200
+
+            r = await client.get("/debug/router")
+            assert r.status == 200
+            dbg = await r.json()
+            assert dbg["spec"]["mode"] == "static"
+            assert dbg["backends"][dead]["state"] == OPEN
+            assert dbg["backends"][dead]["open_age_s"] >= 0
+            assert dbg["healthy"] == 0
+            assert set(dbg["affinity"]) == {"hit", "cold_move", "new",
+                                            "hit_ratio", "entries", "chunk"}
+            assert set(dbg["config"]) == {
+                "health_interval_s", "eject_after", "half_open_s",
+                "retry_budget", "retry_jitter_s", "upstream_timeout_s"}
+
+            # work paths 503 no_backend instead of hanging
+            r = await client.post("/completion", json={"prompt": "x"})
+            assert r.status == 503
+            assert r.headers["X-Shed-Reason"] == "no_backend"
+        finally:
+            await client.close()
+            router.close()
+    _run(scenario())
+
+
+def test_work_paths_routed():
+    assert WORK_PATHS == {"/completion", "/v1/chat/completions"}
+    async def scenario():
+        stub = StubReplica(ok_json)
+        async def chat(request):
+            return web.json_response({"choices": []})
+        app = stub.build_app()
+        app.router.add_post("/v1/chat/completions", chat)
+        server = TestServer(app)
+        await server.start_server()
+        url = str(server.make_url("/")).rstrip("/")
+        router = Router(url, registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status == 200
+            assert (await r.json()) == {"choices": []}
+        finally:
+            await client.close()
+            await server.close()
+            router.close()
+    _run(scenario())
+
+
+def test_traceparent_and_request_id_propagate():
+    async def scenario():
+        stub = StubReplica(ok_json)
+        server = TestServer(stub.build_app())
+        await server.start_server()
+        url = str(server.make_url("/")).rstrip("/")
+        router = Router(url, registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+            r = await client.post(
+                "/completion", json={"prompt": "t", "n_predict": 1},
+                headers={"traceparent": tp, "X-Tenant-Id": "acme"})
+            assert r.status == 200
+            fwd = stub.calls[0]["headers"]
+            # one trace spans router -> replica: the router's span rides
+            # the SAME trace id the client sent
+            assert fwd["traceparent"].split("-")[1] == "ab" * 16
+            assert len(fwd["X-Request-Id"]) == 12
+            # X-Tenant-Id is the header the replicas' obs middleware
+            # reads — it must survive the hop or quota/accounting break
+            assert fwd["X-Tenant-Id"] == "acme"
+        finally:
+            await client.close()
+            await server.close()
+            router.close()
+    _run(scenario())
+
+
+# ------------------------------------------- end-to-end byte identity
+@pytest.fixture(scope="module")
+def llm_server():
+    import jax.numpy as jnp
+
+    from tpustack.models.llama import LlamaConfig
+    from tpustack.models.llm_generate import Generator
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    gen = Generator(LlamaConfig.tiny(max_seq=64), dtype=jnp.float32, seed=3)
+    return LLMServer(generator=gen, tokenizer=ByteTokenizer(512),
+                     model_name="tiny-test", max_batch=2,
+                     registry=Registry())
+
+
+def test_routed_greedy_identical_to_direct(llm_server):
+    """The router is a pure relay: a greedy completion through it is
+    byte-identical to the same request sent straight at the replica."""
+    payload = {"prompt": "the quick brown", "n_predict": 8, "temperature": 0}
+
+    async def scenario():
+        backend = TestServer(llm_server.build_app())
+        await backend.start_server()
+        url = str(backend.make_url("/")).rstrip("/")
+
+        direct_client = TestClient(backend)
+        r = await direct_client.post("/completion", json=payload)
+        assert r.status == 200
+        direct = await r.json()
+
+        router = Router(url, registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            r = await client.post("/completion", json=payload)
+            assert r.status == 200
+            assert r.headers["X-Router-Backend"] == url
+            routed = await r.json()
+            assert routed["content"] == direct["content"]
+            assert routed["tokens_predicted"] == direct["tokens_predicted"]
+        finally:
+            await client.close()
+            router.close()
+            await backend.close()
+    _run(scenario())
+
+
+def test_routed_quota_follows_tenant_e2e(llm_server, monkeypatch):
+    """ACCEPTANCE: per-tenant quota works THROUGH the gateway.  The
+    router forwards X-Tenant-Id, so the replica's QoS bucket charges the
+    right tenant; once that tenant is in debt its 429 quota shed is
+    relayed verbatim — never spilled.  If the router dropped the header,
+    every routed request would land on the default tenant and the second
+    request would 200."""
+    from tpustack.serving.llm_server import LLMServer
+
+    monkeypatch.setenv("TPUSTACK_QOS_POLICY", json.dumps({
+        "tenants": {"bulk": {"priority": "batch", "tokens_per_s": 1.0,
+                             "burst_tokens": 4.0}}}))
+    replica = LLMServer(generator=llm_server.gen, tokenizer=llm_server.tok,
+                        model_name="tiny-test", max_batch=2,
+                        registry=Registry())
+
+    async def scenario():
+        backend = TestServer(replica.build_app())
+        await backend.start_server()
+        url = str(backend.make_url("/")).rstrip("/")
+        router = Router(url, registry=Registry(), env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        direct = TestClient(backend)
+        await direct.start_server()
+        try:
+            r1 = await client.post(
+                "/completion",
+                json={"prompt": "hello", "n_predict": 8, "temperature": 0},
+                headers={"X-Tenant-Id": "bulk"})
+            assert r1.status == 200
+            r2 = await client.post(
+                "/completion",
+                json={"prompt": "again", "n_predict": 8, "temperature": 0},
+                headers={"X-Tenant-Id": "bulk"})
+            assert r2.status == 429
+            assert r2.headers["X-Shed-Reason"] == "quota"
+            assert "Retry-After" in r2.headers
+            with router._lock:
+                # quota is policy, not capacity: relayed, never a failover
+                assert router._failovers == {}
+                assert router._outcomes == {"ok": 1, "shed": 1}
+            # the replica charged the RIGHT tenant: the header survived
+            dbg = await (await direct.get("/debug/tenants")).json()
+            assert "bulk" in dbg["tenants"]
+            assert dbg["qos"]["counters"]["quota_throttle"] == {"batch": 1}
+        finally:
+            await client.close()
+            router.close()
+            await direct.close()
+    _run(scenario())
+
+
+# ------------------------------------------------- bisection + contracts
+def test_maybe_from_env_unset_constructs_nothing():
+    assert maybe_from_env(env={}) is None
+    assert maybe_from_env(env={"TPUSTACK_ROUTER_BACKENDS": "  "}) is None
+    r = maybe_from_env(env={**_QUIET,
+                            "TPUSTACK_ROUTER_BACKENDS": "http://h:1"})
+    try:
+        assert isinstance(r, Router)
+        assert r.backends() == ["http://h:1"]
+    finally:
+        r.close()
+
+
+_BISECT = """
+import sys, threading
+sys.path.insert(0, ".")
+before = set(threading.enumerate())
+from tpustack.serving import router
+assert router.maybe_from_env() is None, "unset must construct NOTHING"
+leaked = [t.name for t in threading.enumerate() if t not in before]
+assert not leaked, f"threads leaked: {leaked}"
+print("BISECT-OK")
+"""
+
+
+def test_router_env_bisection_subprocess():
+    """ACCEPTANCE: a fresh interpreter with TPUSTACK_ROUTER_BACKENDS
+    unset constructs no router — no thread, no state, no side effects."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "TPUSTACK_ROUTER_BACKENDS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", _BISECT], env=env,
+                          capture_output=True, text=True, timeout=120,
+                          cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "BISECT-OK" in proc.stdout
+
+
+def test_spill_reasons_subset_of_shed_reasons():
+    """Steering contract: every spillable reason is a declared shed
+    reason, and the two deliberate non-spills stay out of the set."""
+    assert SPILL_REASONS <= set(SHED_REASONS)
+    assert "quota" not in SPILL_REASONS  # policy follows the tenant
+    assert "deadline" not in SPILL_REASONS  # time budget already spent
+    assert "no_backend" not in SPILL_REASONS  # the router's OWN shed
+
+
+# ========================================================== the chaos bar
+def test_chaos_serving_fast_cli(tmp_path):
+    """Shell ``tools/chaos_serving.py --fast`` — 2 replicas + router,
+    SIGKILL one + SIGTERM-drain the other mid-load, goodput >= 0.9 and
+    zero leaks/violations enforced on every PR."""
+    out_path = tmp_path / "chaos-serving.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_serving.py"),
+         "--fast", "--out", str(out_path)],
+        capture_output=True, text=True, cwd=REPO, timeout=420)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    artifact = json.loads(out_path.read_text())
+    assert artifact["ok"] and artifact["problems"] == []
+    assert artifact["kill"]["drain_exit"] == 0
+    assert artifact["summary"]["tenants"]["interactive"][
+        "goodput_ratio"] >= 0.9
+    assert sum(artifact["server_router"]["failovers"].values()) > 0
+
+
+def test_close_stops_health_thread():
+    r = make_router("http://127.0.0.1:5001",
+                    TPUSTACK_ROUTER_HEALTH_INTERVAL_S="0.05")
+    thread = r._health_thread
+    assert thread.is_alive()
+    r.close()
+    assert not thread.is_alive()
+    assert not any(t.name == "tpustack-router-health"
+                   for t in threading.enumerate())

@@ -245,6 +245,49 @@ def test_probe_all_green_and_metrics():
     assert any(h and "traceparent" in h for _, _, h in fetch.calls)
 
 
+def test_probe_router_target():
+    """The router kind: completion routes through a backend AND
+    /debug/router proves the target is the gateway with a populated
+    registry — metrics land under target="router"."""
+    probe = _tool("probe")
+    from tpustack.obs import Registry
+    from tpustack.obs import catalog
+
+    reg = Registry()
+    fetch = _fake_fetch({
+        ("GET", "/healthz"): (200, b"{}"),
+        ("GET", "/readyz"): (200, b"{}"),
+        ("POST", "/completion"): (200, b'{"content": "pong"}'),
+        ("GET", "/debug/router"): (200, json.dumps(
+            {"backends": {"http://r0:8080": {"state": "healthy"}}}).encode()),
+    })
+    out = probe.run_round({"router": "http://router"},
+                          metrics=catalog.build(reg), fetch=fetch, timeout=5)
+    assert out["up"] == {"router": True}
+    checks = out["targets"]["router"]
+    assert checks["inference"]["ok"] and checks["debug_router"]["ok"]
+    assert reg.get_sample_value("tpustack_probe_up_state",
+                                {"target": "router"}) == 1
+    assert reg.get_sample_value(
+        "tpustack_probe_attempts_total",
+        {"target": "router", "check": "debug_router", "outcome": "ok"}) == 1
+
+    # a router whose healthy set is empty (backends key missing) fails
+    # the debug check, and the round reports the router down
+    reg2 = Registry()
+    fetch2 = _fake_fetch({
+        ("GET", "/healthz"): (200, b"{}"),
+        ("GET", "/readyz"): (503, b"{}"),
+        ("POST", "/completion"): (503, b'{"error": "no healthy backend"}'),
+        ("GET", "/debug/router"): (200, b"{}"),
+    })
+    out2 = probe.run_round({"router": "http://router"},
+                           metrics=catalog.build(reg2), fetch=fetch2,
+                           timeout=5)
+    assert out2["up"] == {"router": False}
+    assert out2["targets"]["router"]["debug_router"]["ok"] is False
+
+
 def test_probe_failure_modes():
     probe = _tool("probe")
     from tpustack.obs import Registry

@@ -59,6 +59,10 @@ LLM_EXTRA_KEEP = (
     # (miss-ratio curve, working set, block lifetimes, Retry-After
     # calibration) — the sizing evidence ROADMAP item 4 reads
     "kvprof", "server_kvcache",
+    # L7 router view when --url pointed at tpustack.serving.router:
+    # backend health/circuit states, failover + affinity counters — the
+    # scale-out evidence chaos_serving's goodput bar is judged with
+    "server_router",
     # provenance + the machine-exact perf signature (tpustack.obs.perfsig)
     # ride each cell into the driver artifact: BENCH_r*.json rounds carry
     # the exact counters the perf gate ratchets on, per measurement

@@ -172,6 +172,18 @@ def probe_target(kind: str, base: str, fetch: Fetch = _urllib_fetch,
             body={"prompt": "ping", "n_predict": 1, "temperature": 0},
             headers=hdrs, timeout=timeout,
             validate=_validate_json_key("content"))
+    elif kind == "router":
+        # end-to-end through the L7 gateway: the completion exercises
+        # affinity + steering + one backend; /debug/router proves the
+        # target really is the router and its registry is populated
+        res = _http_check(
+            fetch, "POST", base + "/completion",
+            body={"prompt": "ping", "n_predict": 1, "temperature": 0},
+            headers=hdrs, timeout=timeout,
+            validate=_validate_json_key("content"))
+        checks["debug_router"] = _http_check(
+            fetch, "GET", base + "/debug/router", timeout=10,
+            validate=_validate_json_key("backends"))
     elif kind == "sd":
         res = _http_check(
             fetch, "POST", base + "/generate",
@@ -224,6 +236,8 @@ def main(argv: List[str] = None) -> int:
     p.add_argument("--llm", help="LLM server base URL")
     p.add_argument("--sd", help="SD server base URL")
     p.add_argument("--graph", help="graph server base URL")
+    p.add_argument("--router", help="L7 router base URL (the scale-out "
+                                    "gateway fronting the llm replicas)")
     p.add_argument("--count", type=int, default=1,
                    help="probe rounds to run (default 1; the CronJob runs "
                         "several per invocation so the sidecar is "
@@ -237,10 +251,11 @@ def main(argv: List[str] = None) -> int:
     args = p.parse_args(argv)
 
     targets = {k: v for k, v in
-               (("llm", args.llm), ("sd", args.sd), ("graph", args.graph))
+               (("llm", args.llm), ("sd", args.sd), ("graph", args.graph),
+                ("router", args.router))
                if v}
     if not targets:
-        p.error("give at least one of --llm/--sd/--graph")
+        p.error("give at least one of --llm/--sd/--graph/--router")
 
     # metrics through the shared catalog + the stdlib sidecar — the same
     # exposition path every batch/train Job uses (TPUSTACK_METRICS_PORT)

@@ -587,6 +587,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             **reduce_results(schedule, results, args.duration, wall_s),
         }
         artifact["value"] = artifact["goodput_rps"]
+        # when --url points at the L7 router (tpustack.serving.router),
+        # its /debug/router snapshot rides along: backend health/circuit
+        # states plus failover and prefix-affinity counters — the
+        # scale-out run's server-side evidence
+        try:
+            with urllib.request.urlopen(
+                    url.rstrip("/") + "/debug/router", timeout=5) as r:
+                artifact["server_router"] = json.loads(r.read().decode())
+        except Exception:
+            log("no /debug/router on target (driving a backend directly)")
         if host is not None:
             # the server-side ledger view of the same run — what the
             # conservation tests cross-check the client artifact against

@@ -362,11 +362,87 @@ def _check_tpu_parallelism(where: str, doc, errors: List[str]) -> None:
                         f"{tpu - 1} chips")
 
 
+def _is_router(container) -> bool:
+    argv = [str(a) for a in ((container.get("command") or [])
+                             + (container.get("args") or []))]
+    return any("tpustack.serving.router" in a for a in argv)
+
+
+def _is_llm_server(container) -> bool:
+    argv = [str(a) for a in ((container.get("command") or [])
+                             + (container.get("args") or []))]
+    return any("tpustack.serving.llm_server" in a for a in argv)
+
+
+#: the static/dns backend spec forms tpustack.serving.router accepts
+_DNS_BACKENDS_RE = re.compile(r"^dns://([^:/]+):(\d+)$")
+
+
+def _check_router_contract(errors: List[str], routers, services,
+                           deployments) -> None:
+    """Cross-file router pairing (the scale-out contract):
+
+    - a router container must point TPUSTACK_ROUTER_BACKENDS somewhere
+      (unset constructs nothing — a router pod that routes to no one);
+    - a ``dns://`` backends host must resolve to a HEADLESS Service in
+      this config (per-pod A records; a ClusterIP VIP would hide the
+      replicas and defeat affinity + per-replica health), on a port that
+      Service actually serves, selecting pods some Deployment creates;
+    - any llm serving Deployment with ``replicas > 1`` must be fronted
+      by a router Deployment: the plain Service round-robins blindly,
+      so warm-prefix traffic would land on cold replicas and a draining
+      pod would keep eating new requests for a readiness period.
+    """
+    by_name = {s["name"]: s for s in services}
+    for where, container in routers:
+        spec = _env_value(container, "TPUSTACK_ROUTER_BACKENDS")
+        if not spec:
+            errors.append(
+                f"{where}: router container sets no "
+                "TPUSTACK_ROUTER_BACKENDS — with the knob unset the "
+                "router constructs nothing and serves 503s")
+            continue
+        m = _DNS_BACKENDS_RE.match(str(spec))
+        if not m:
+            continue  # static host list / @file: nothing to cross-check
+        host, port = m.group(1).split(".")[0], int(m.group(2))
+        svc = by_name.get(host)
+        if svc is None:
+            errors.append(
+                f"{where}: TPUSTACK_ROUTER_BACKENDS references Service "
+                f"{host!r}, which no manifest defines")
+            continue
+        if svc["clusterIP"] != "None":
+            errors.append(
+                f"{where}: backends Service {host!r} is not headless "
+                "(spec.clusterIP: None) — one VIP A record instead of "
+                "per-pod records defeats affinity and per-replica health")
+        if port not in svc["ports"]:
+            errors.append(
+                f"{where}: TPUSTACK_ROUTER_BACKENDS port {port} is not "
+                f"served by Service {host!r} (ports: "
+                f"{sorted(svc['ports'])})")
+        sel = svc["selector"]
+        if sel and not any(sel.items() <= d["labels"].items()
+                           for d in deployments):
+            errors.append(
+                f"{where}: backends Service {host!r} selector {sel} "
+                "matches no Deployment pod template in cluster-config")
+    for d in deployments:
+        if d["replicas"] > 1 and d["serves_llm"] and not routers:
+            errors.append(
+                f"{d['where']}: {d['replicas']} llm replicas but no "
+                "router Deployment (tpustack.serving.router) in "
+                "cluster-config — scaled-out replicas must sit behind "
+                "the prefix-affinity router (router-deployment.yaml)")
+
+
 def lint(root: Path = None) -> List[str]:
     """Return a list of violation strings (empty = clean)."""
     root = Path(root) if root is not None else REPO / "cluster-config"
     errors: List[str] = []
     catalog = _catalog_metric_names()
+    routers, services, deployments = [], [], []
     for path in sorted(root.rglob("*.yaml")):
         rel = path.relative_to(root).as_posix()
         if rel in SKIP_FILES:
@@ -385,6 +461,16 @@ def lint(root: Path = None) -> List[str]:
                 where = f"{rel}/{kind}/{doc['metadata'].get('name')}"
                 _check_monitoring_rules(where, doc, errors, catalog)
                 continue
+            if kind == "Service":
+                spec = doc.get("spec") or {}
+                services.append({
+                    "name": (doc.get("metadata") or {}).get("name"),
+                    "clusterIP": str(spec.get("clusterIP")),
+                    "selector": spec.get("selector") or {},
+                    "ports": {p.get("targetPort", p.get("port"))
+                              for p in spec.get("ports", []) or []},
+                })
+                continue
             if kind not in WORKLOAD_KINDS:
                 continue
             where = f"{rel}/{kind}/{doc['metadata'].get('name')}"
@@ -392,12 +478,25 @@ def lint(root: Path = None) -> List[str]:
                 for container in (tmpl.get("spec", {}).get("containers")
                                   or []):
                     _check_resources(where, container, errors)
+                    if _is_router(container):
+                        routers.append((where, container))
             if kind == "Deployment":
                 _check_deployment(where, doc, errors)
+                tmpl = doc["spec"]["template"]
+                deployments.append({
+                    "where": where,
+                    "replicas": int(doc["spec"].get("replicas", 1)),
+                    "labels": (tmpl.get("metadata") or {}).get("labels")
+                    or {},
+                    "serves_llm": any(
+                        _is_llm_server(c) for c in
+                        (tmpl.get("spec", {}).get("containers") or [])),
+                })
             _check_drain_consistency(where, doc, errors)
             _check_train_ckpt_contract(where, doc, errors)
             _check_prober_contract(where, doc, errors)
             _check_tpu_parallelism(where, doc, errors)
+    _check_router_contract(errors, routers, services, deployments)
     return errors
 
 

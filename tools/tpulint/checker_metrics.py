@@ -54,6 +54,8 @@ UNIT_SUFFIXES = (
     # index gauges (value identifies a position, e.g. the last-saved
     # training step — a resumed run continues FROM this number)
     "step",
+    # budget gauges (remaining router failover attempts, router.py)
+    "retries",
 )
 _RESERVED_LABELS = {"le", "quantile"}
 

@@ -488,6 +488,50 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "store (0 = no baseline store shipped with this deploy).",
                unit="entries"),
 
+    # ---- L7 router (tpustack.serving.router; constructed only when
+    # TPUSTACK_ROUTER_BACKENDS is set) ----
+    MetricSpec("tpustack_router_requests_total", "counter",
+               "Requests proxied through the router, by final outcome "
+               "(ok | shed = upstream 429/503 surfaced to the client | "
+               "deadline = upstream 504 | client_error = relayed 4xx "
+               "without a shed header (the request's fault, not the "
+               "proxy's) | error = connect/5xx after the retry budget | "
+               "no_backend = healthy set empty).",
+               ("outcome",), unit="total"),
+    MetricSpec("tpustack_router_failover_total", "counter",
+               "Failover attempts to a next-preference replica, by the "
+               "reason the first choice was abandoned (connect_error | "
+               "timeout | http_5xx | out_of_kv_blocks | queue_depth | "
+               "draining).  quota sheds never appear here — quota is "
+               "policy, not capacity.", ("reason",), unit="total"),
+    MetricSpec("tpustack_router_backend_healthy_state", "gauge",
+               "1 while the backend is in the routable healthy set, 0 "
+               "while its circuit is open (ejected) or half-open.  The "
+               "series is removed when the backend leaves the registry "
+               "(dns:// pod churn must not grow label cardinality).",
+               ("backend",), unit="state"),
+    MetricSpec("tpustack_router_backend_ejections_total", "counter",
+               "Circuit-open events per backend (consecutive passive "
+               "failures reached TPUSTACK_ROUTER_EJECT_AFTER, or the "
+               "active /readyz poll failed).", ("backend",), unit="total"),
+    MetricSpec("tpustack_router_affinity_total", "counter",
+               "Affinity-table lookups, by result (hit = rendezvous "
+               "choice matches the prefix's last backend | cold_move = "
+               "the prefix moved replicas, its KV there is cold | new = "
+               "first sighting of this prefix).", ("result",),
+               unit="total"),
+    MetricSpec("tpustack_router_affinity_hit_ratio", "gauge",
+               "hit / (hit + cold_move) over the router's lifetime — "
+               "the fraction of repeat prefixes that landed on the "
+               "replica already holding their KV.  Drops after an "
+               "ejection, recovers as rendezvous re-converges.",
+               unit="ratio"),
+    MetricSpec("tpustack_router_retry_budget_retries", "gauge",
+               "Remaining failover budget of the most recent request "
+               "that needed at least one failover (budget exhausted at "
+               "0 — the client saw the last upstream error honestly).",
+               unit="retries"),
+
     # ---- black-box prober (tools/probe.py, the prober CronJob sidecar) ----
     MetricSpec("tpustack_probe_attempts_total", "counter",
                "Prober checks run, by target (llm|sd|graph), check "

@@ -55,7 +55,7 @@ from tpustack.obs.trace import bind_request_id
 UNTRACED_ENDPOINTS = frozenset({
     "/metrics", "/health", "/healthz", "/readyz",
     "/debug/traces", "/debug/traces/{trace_id}", "/debug/flight",
-    "/debug/tenants", "/debug/kvcache",
+    "/debug/tenants", "/debug/kvcache", "/debug/router",
     "__unmatched__",
     # poll loops (the wan client hits /history every few seconds for
     # minutes per prompt) — the prompt's real work is traced via its

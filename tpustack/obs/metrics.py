@@ -84,7 +84,7 @@ class _Family:
     def _make_child(self):
         raise NotImplementedError
 
-    def labels(self, *values, **kw):
+    def _label_values(self, values, kw) -> Tuple[str, ...]:
         if kw:
             if values:
                 raise ValueError("pass label values positionally OR by name")
@@ -99,11 +99,24 @@ class _Family:
         if len(values) != len(self.labelnames):
             raise ValueError(
                 f"{self.name} takes labels {self.labelnames}, got {values}")
+        return values
+
+    def labels(self, *values, **kw):
+        values = self._label_values(values, kw)
         with self._lock:
             child = self._children.get(values)
             if child is None:
                 child = self._children[values] = self._make_child()
             return child
+
+    def remove(self, *values, **kw) -> None:
+        """Drop one labelled series (a backend that left the registry, an
+        expired tenant) so label cardinality tracks current membership,
+        not lifetime history.  Removing an absent series is a no-op."""
+        if not self.labelnames:
+            raise ValueError(f"{self.name} has no labelled series to remove")
+        with self._lock:
+            self._children.pop(self._label_values(values, kw), None)
 
     def _iter_children(self) -> List[Tuple[Tuple[str, ...], object]]:
         with self._lock:

@@ -87,6 +87,15 @@ GUARDED: Dict[Tuple[str, str], Tuple[GuardedSpec, ...]] = {
         _s("_copy_s_ema", "_lock", writes_only=True),
         _s("_prefill_s_ema", "_lock", writes_only=True),
     ),
+    ("tpustack.serving.router", "Router"): (
+        _s("_backends", "_lock"),
+        _s("_affinity", "_lock"),
+        _s("_aff_hits", "_lock", writes_only=True),
+        _s("_aff_cold", "_lock", writes_only=True),
+        _s("_aff_new", "_lock", writes_only=True),
+        _s("_outcomes", "_lock"),
+        _s("_failovers", "_lock"),
+    ),
     ("tpustack.serving.sd_server", "SDServer"): (
         _s("_inflight", "_lock"),
     ),

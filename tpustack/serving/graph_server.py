@@ -63,7 +63,7 @@ from tpustack.obs import flight as obs_flight
 from tpustack.obs import http as obs_http
 from tpustack.obs import profile as obs_profile
 from tpustack.obs import trace as obs_trace
-from tpustack.serving.resilience import ResilienceManager
+from tpustack.serving.resilience import ResilienceManager, shed_headers
 from tpustack.utils import get_logger
 from tpustack.utils.image import array_to_png
 
@@ -1205,11 +1205,13 @@ class GraphServer:
             "running": running,
             "pending": pending,
         })
-        return web.json_response(payload, status=status)
+        return web.json_response(payload, status=status,
+                                 headers=self.resilience.health_headers(status))
 
     async def readyz(self, request: web.Request) -> web.Response:
         status, payload = self.resilience.ready_payload()
-        return web.json_response(payload, status=status)
+        return web.json_response(payload, status=status,
+                                 headers=self.resilience.ready_headers(status))
 
     async def profile(self, request: web.Request) -> web.Response:
         """Capture an XLA/TPU profile (xplane) around one graph execution
@@ -1260,8 +1262,8 @@ class GraphServer:
             return web.json_response(
                 {"detail": "worker busy — retry when accepted prompts "
                            "have published"}, status=409,
-                headers={"Retry-After":
-                         str(self.resilience.retry_after_s())})
+                headers=shed_headers("busy",
+                                     self.resilience.retry_after_s()))
         try:
             out = await asyncio.get_running_loop().run_in_executor(
                 None, capture_exclusive)
@@ -1271,8 +1273,8 @@ class GraphServer:
             return web.json_response(
                 {"detail": "worker busy — retry when accepted prompts "
                            "have published"}, status=409,
-                headers={"Retry-After":
-                         str(self.resilience.retry_after_s())})
+                headers=shed_headers("busy",
+                                     self.resilience.retry_after_s()))
         return web.json_response(out)
 
     def build_app(self) -> web.Application:

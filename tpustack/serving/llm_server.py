@@ -104,7 +104,7 @@ from tpustack.obs import trace as obs_trace
 from tpustack.serving import qos as qos_mod
 from tpustack.serving.resilience import (DeadlineExceeded,
                                          InjectedDeviceError,
-                                         ResilienceManager)
+                                         ResilienceManager, shed_headers)
 from tpustack.utils import get_logger, knobs
 
 log = get_logger("serving.llm_server")
@@ -1480,7 +1480,7 @@ class LLMServer:
                        else {"error": str(e)})
             return web.json_response(
                 payload, status=429,
-                headers={"Retry-After": str(e.retry_after_s)})
+                headers=shed_headers("out_of_kv_blocks", e.retry_after_s))
         except ValueError as e:
             payload = ({"error": {"message": str(e)}} if fmt == "openai"
                        else {"error": str(e)})
@@ -1728,13 +1728,15 @@ class LLMServer:
                                  and self.paged.cache is not None)),
             "paged_kv": self.paged is not None,
         }})
-        return web.json_response(payload, status=status)
+        return web.json_response(payload, status=status,
+                                 headers=self.resilience.health_headers(status))
 
     async def readyz(self, request: web.Request) -> web.Response:
         """Readiness: 503 from the moment drain begins, so the endpoint
         leaves Service rotation while in-flight completions finish."""
         status, payload = self.resilience.ready_payload()
-        return web.json_response(payload, status=status)
+        return web.json_response(payload, status=status,
+                                 headers=self.resilience.ready_headers(status))
 
     async def props(self, request: web.Request) -> web.Response:
         """Server properties + live KV-cache config/stats, so operators can
@@ -1868,11 +1870,12 @@ class LLMServer:
         except OutOfKVBlocks as e:
             return web.json_response(
                 {"error": str(e)}, status=429,
-                headers={"Retry-After": str(e.retry_after_s)})
+                headers=shed_headers("out_of_kv_blocks", e.retry_after_s))
         except DeadlineExceeded as e:
             self.resilience.note_deadline(e.phase)
             return web.json_response({"error": str(e), "phase": e.phase},
-                                     status=504)
+                                     status=504,
+                                     headers=shed_headers("deadline"))
         except InjectedDeviceError as e:
             return self.resilience.transient_error_response(e)
         log.info("completion: %d prompt tok, %d gen tok, %.2fs",
@@ -1927,11 +1930,12 @@ class LLMServer:
         except OutOfKVBlocks as e:
             return web.json_response(
                 {"error": {"message": str(e)}}, status=429,
-                headers={"Retry-After": str(e.retry_after_s)})
+                headers=shed_headers("out_of_kv_blocks", e.retry_after_s))
         except DeadlineExceeded as e:
             self.resilience.note_deadline(e.phase)
             return web.json_response(
-                {"error": {"message": str(e)}, "phase": e.phase}, status=504)
+                {"error": {"message": str(e)}, "phase": e.phase}, status=504,
+                headers=shed_headers("deadline"))
         except InjectedDeviceError as e:
             return self.resilience.transient_error_response(e)
         return web.json_response({

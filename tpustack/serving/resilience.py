@@ -79,6 +79,30 @@ SERVING, DRAINING, DRAINED = 0, 1, 2
 _STATE_NAMES = {SERVING: "serving", DRAINING: "draining", DRAINED: "drained"}
 
 
+#: every reason a serving process refuses/fails work with a non-2xx, as
+#: carried in the machine-readable ``X-Shed-Reason`` header the router
+#: steers on.  ``quota`` is policy (the tenant's own budget — never
+#: spilled to another replica); the rest are capacity/health signals a
+#: router may route around.
+SHED_REASONS = ("draining", "quota", "queue_depth", "out_of_kv_blocks",
+                "deadline", "device_error", "watchdog", "busy",
+                "no_backend")
+
+
+def shed_headers(reason: str, retry_after=None) -> Dict[str, str]:
+    """Headers for a shed/refusal response: the machine-readable
+    ``X-Shed-Reason`` (one of :data:`SHED_REASONS`) plus ``Retry-After``
+    when the caller has a hint.  EVERY non-2xx shed path on the three
+    servers builds its headers here — the router's steering table reads
+    this header, so a bare status is a contract violation (audited by
+    tests/test_router.py)."""
+    assert reason in SHED_REASONS, f"undeclared shed reason {reason!r}"
+    headers = {"X-Shed-Reason": reason}
+    if retry_after is not None:
+        headers["Retry-After"] = str(retry_after)
+    return headers
+
+
 class InjectedDeviceError(RuntimeError):
     """The transient device error the fault injector raises at a dispatch
     point.  Handlers map it to 503 + ``Retry-After`` so clients retry —
@@ -455,7 +479,7 @@ class ResilienceManager:
             self._shed_event("draining", ra)
             return web.json_response(
                 {"error": "server draining (shutting down)"}, status=503,
-                headers={"Retry-After": str(ra)})
+                headers=shed_headers("draining", ra))
         if self.qos is not None and tenant is not None:
             eta = self.qos.quota_check(tenant)
             if eta is not None:
@@ -469,8 +493,7 @@ class ResilienceManager:
                 return web.json_response(
                     {"error": f"tenant {tenant!r} over quota",
                      "reason": "quota"}, status=429,
-                    headers={"Retry-After": str(ra),
-                             "X-Shed-Reason": "quota"})
+                    headers=shed_headers("quota", ra))
         depth_limit = self.max_queue_depth
         if (depth_limit and self.qos is not None
                 and priority == "batch"):
@@ -487,7 +510,7 @@ class ResilienceManager:
             self._shed_event("backpressure", ra)
             return web.json_response(
                 {"error": "queue full, retry later"}, status=429,
-                headers={"Retry-After": str(ra)})
+                headers=shed_headers("queue_depth", ra))
         return None
 
     def middleware(self, work_paths):
@@ -580,7 +603,7 @@ class ResilienceManager:
 
         return web.json_response(
             {"error": f"transient device error: {exc}"}, status=503,
-            headers={"Retry-After": str(self.retry_after_s())})
+            headers=shed_headers("device_error", self.retry_after_s()))
 
     # ---------------------------------------------------------- health views
     def health_payload(self, extra: Optional[Dict] = None) -> Tuple[int, Dict]:
@@ -612,3 +635,17 @@ class ResilienceManager:
         ready = not self.draining and not self._hung
         return (200 if ready else 503), {"ready": ready,
                                          "state": self.state_name}
+
+    def health_headers(self, status: int) -> Dict[str, str]:
+        """Shed headers for a liveness response: a 503 here is always the
+        watchdog (drain keeps liveness green on purpose)."""
+        return shed_headers("watchdog") if status != 200 else {}
+
+    def ready_headers(self, status: int) -> Dict[str, str]:
+        """Shed headers for a readiness response: drain (with a real
+        Retry-After) or a watchdog hang."""
+        if status == 200:
+            return {}
+        if self.draining:
+            return shed_headers("draining", self.retry_after_s())
+        return shed_headers("watchdog")

@@ -1,0 +1,761 @@
+"""Cache-aware L7 router: prefix-affinity + health-aware failover.
+
+One replica behind a NodePort is a full outage the moment its pod dies.
+This module is the thin gateway that gives the llm serving path a
+horizontal axis without giving up the KV-cache wins the stack is built
+around:
+
+- **Replica registry** — ``TPUSTACK_ROUTER_BACKENDS``: a comma list of
+  base URLs, ``@/path/to/file`` (one URL per line, hot-reloaded on mtime
+  change), or ``dns://host:port`` (A records re-resolved every health
+  tick — the headless-Service shape the k8s manifests use).  Unset means
+  NOTHING is constructed (the knob-family bisection contract).
+- **Health** — an active ``/readyz`` poll per backend each
+  ``TPUSTACK_ROUTER_HEALTH_INTERVAL_S``, plus passive outlier ejection
+  after ``TPUSTACK_ROUTER_EJECT_AFTER`` consecutive connect/timeout/5xx
+  failures.  An ejected backend's circuit stays open for
+  ``TPUSTACK_ROUTER_HALF_OPEN_S``; then the next poll is its half-open
+  probe — one success re-admits, one failure re-arms the open timer.
+  A backend that *says* it is unready (HTTP != 200 on ``/readyz``, e.g.
+  a draining pod) is ejected immediately: that signal is authoritative,
+  not noise.
+- **Prefix affinity** — rendezvous (highest-random-weight) hashing of
+  the block-aligned prompt prefix over the HEALTHY set.  Every healthy
+  replica scores every key, so ejecting one replica re-rendezvouses
+  only ITS keys — deterministically — and the rest keep their warm
+  paged/host-tier KV.  Hit / cold-move counters expose the cache cost
+  of each failover.
+- **Shed-aware steering** — replicas shed with machine-readable
+  ``X-Shed-Reason`` headers (:data:`tpustack.serving.resilience.
+  SHED_REASONS`).  ``quota`` is policy, not capacity: the tenant's own
+  429 + Retry-After is relayed verbatim and never spilled.
+  ``out_of_kv_blocks`` / ``queue_depth`` / ``draining`` / ``busy`` /
+  ``device_error`` are capacity signals: the request spills to the
+  next-preference replica under a bounded per-request retry budget with
+  jitter.  ``deadline`` (504) is relayed honestly — the budget the
+  request had is already spent.  Streaming requests fail over only
+  BEFORE the first body byte; after that the error propagates honestly
+  (a half-stream retold from zero is a lie).
+
+The router is itself a tpustack serving app: it reuses the shared
+resilience layer (SIGTERM drain, admission, shed headers), the obs
+middleware (request ids, tenant accounting, one trace spanning
+router→replica via ``traceparent``), the catalog metrics, and
+``GET /debug/router`` for the live steering state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import random
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from aiohttp import web
+
+from tpustack import sanitize
+from tpustack.obs import accounting as obs_accounting
+from tpustack.obs import catalog as obs_catalog
+from tpustack.obs import http as obs_http
+from tpustack.obs import trace as obs_trace
+from tpustack.serving.resilience import ResilienceManager, shed_headers
+from tpustack.utils import get_logger, knobs
+
+log = get_logger("serving.router")
+
+#: the work endpoints the router steers (everything else is served by the
+#: router itself: health, metrics, debug)
+WORK_PATHS = frozenset({"/completion", "/v1/chat/completions"})
+
+#: X-Shed-Reason values that mean "this replica cannot take the work but
+#: another one might" — the spill set.  quota is deliberately absent
+#: (policy follows the tenant, not the replica) and so is deadline (the
+#: request's time budget is already spent).
+SPILL_REASONS = frozenset({"out_of_kv_blocks", "queue_depth", "draining",
+                           "busy", "device_error", "watchdog"})
+
+#: request headers forwarded verbatim to the chosen replica.
+#: ``X-Tenant-Id`` is the name the whole stack reads (obs middleware,
+#: replay, the batch clients) — it MUST survive the hop or the replicas
+#: charge every routed request to the default tenant and per-tenant
+#: quota/QoS dies at the gateway.
+_FORWARD_HEADERS = ("Content-Type", "Accept", "Authorization",
+                    "X-Tenant-Id", "X-Priority")
+
+#: response headers relayed back to the client on a proxied reply
+_RELAY_HEADERS = ("Content-Type", "Retry-After", "X-Shed-Reason")
+
+# circuit states (per backend)
+HEALTHY, OPEN = "healthy", "open"
+
+
+def parse_backend_spec(spec: str) -> Dict[str, str]:
+    """``TPUSTACK_ROUTER_BACKENDS`` → ``{"mode": ..., ...}``.
+
+    ``@/path`` → file mode, ``dns://host:port`` → DNS mode, anything
+    else → a static comma list of base URLs."""
+    spec = spec.strip()
+    if spec.startswith("@"):
+        return {"mode": "file", "path": spec[1:]}
+    if spec.startswith("dns://"):
+        hostport = spec[len("dns://"):]
+        host, _, port = hostport.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"dns backend spec needs host:port, got {spec!r}")
+        return {"mode": "dns", "host": host, "port": port}
+    return {"mode": "static", "urls": spec}
+
+
+def _normalize_url(u: str) -> str:
+    u = u.strip().rstrip("/")
+    if u and "://" not in u:
+        u = "http://" + u
+    return u
+
+
+def rendezvous_rank(key: str, backends: List[str]) -> List[str]:
+    """Highest-random-weight ranking of ``backends`` for ``key``: every
+    backend scores independently, so removing one reshuffles only the
+    keys it owned — the property that keeps the survivors' prefix caches
+    warm through an ejection."""
+    return sorted(
+        backends,
+        key=lambda b: hashlib.sha256(f"{key}|{b}".encode()).hexdigest(),
+        reverse=True)
+
+
+class Router:
+    """The gateway: registry + health + affinity + steering + app."""
+
+    def __init__(self, spec: str, registry=None, tracer=None, env=None):
+        self.spec = parse_backend_spec(spec)
+        self.health_interval_s = max(0.05, knobs.get_float(
+            "TPUSTACK_ROUTER_HEALTH_INTERVAL_S", env=env))
+        self.eject_after = max(1, knobs.get_int(
+            "TPUSTACK_ROUTER_EJECT_AFTER", env=env))
+        self.half_open_s = max(0.0, knobs.get_float(
+            "TPUSTACK_ROUTER_HALF_OPEN_S", env=env))
+        self.retry_budget = max(0, knobs.get_int(
+            "TPUSTACK_ROUTER_RETRY_BUDGET", env=env))
+        self.retry_jitter_s = max(0.0, knobs.get_float(
+            "TPUSTACK_ROUTER_RETRY_JITTER_S", env=env))
+        self.affinity_chunk = max(1, knobs.get_int(
+            "TPUSTACK_ROUTER_AFFINITY_CHUNK", env=env))
+        self.affinity_keys = max(16, knobs.get_int(
+            "TPUSTACK_ROUTER_AFFINITY_KEYS", env=env))
+        self.upstream_timeout_s = knobs.get_float(
+            "TPUSTACK_ROUTER_UPSTREAM_TIMEOUT_S", env=env)
+        self._registry = registry
+        self.metrics = obs_catalog.build(registry)
+        self.tracer = tracer if tracer is not None else obs_trace.TRACER
+        self.ledger = obs_accounting.for_registry(registry)
+        # the shared resilience layer: SIGTERM drain (readiness 503 +
+        # X-Shed-Reason: draining — the NEXT router tier up steers on it
+        # the same way we steer on the replicas'), admission, watchdog
+        self.resilience = ResilienceManager("router", registry,
+                                            concurrency=64, env=env,
+                                            expected_service_s=0.5)
+        self._session = None  # aiohttp.ClientSession, created on the loop
+        self._lock = threading.Lock()
+        # url -> {"state", "fails", "opened_at", "ejections"}; mutated by
+        # the health thread AND the event loop (passive outlier notes)
+        self._backends: Dict[str, dict] = {}  # guarded-by: _lock
+        # prefix-key -> last backend (bounded LRU, plain dict: insertion
+        # order IS the LRU order via pop/reinsert)
+        self._affinity: Dict[str, str] = {}  # guarded-by: _lock
+        self._aff_hits = 0  # guarded-by: _lock (writes)
+        self._aff_cold = 0  # guarded-by: _lock (writes)
+        self._aff_new = 0  # guarded-by: _lock (writes)
+        # /debug/router counter views (the metric families are write-only)
+        self._outcomes: Dict[str, int] = {}  # guarded-by: _lock
+        self._failovers: Dict[str, int] = {}  # guarded-by: _lock
+        self._file_mtime = -1.0  # health thread only
+        self._stop = threading.Event()
+        sanitize.install_guards(self)
+        self._apply_registry(self._resolve_spec())
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True,
+            name="tpustack-router-health")
+        self._health_thread.start()
+        log.info("router up: %d backend(s), spec mode=%s",
+                 len(self.backends()), self.spec["mode"])
+
+    # ------------------------------------------------------------ registry
+    def _resolve_spec(self) -> List[str]:
+        """The CURRENT desired backend set (file re-read on mtime change,
+        DNS re-resolved every call).  Called from __init__ and the health
+        thread only — never the event loop (blocking I/O)."""
+        mode = self.spec["mode"]
+        if mode == "static":
+            urls = self.spec["urls"].split(",")
+        elif mode == "file":
+            path = self.spec["path"]
+            try:
+                mtime = os.stat(path).st_mtime
+            except OSError:
+                log.warning("backend file %s unreadable; keeping current "
+                            "set", path)
+                return list(self.backends())
+            if mtime == self._file_mtime:
+                return list(self.backends())
+            self._file_mtime = mtime
+            with open(path) as f:
+                urls = f.read().splitlines()
+        else:  # dns
+            host, port = self.spec["host"], self.spec["port"]
+            try:
+                infos = socket.getaddrinfo(host, int(port),
+                                           type=socket.SOCK_STREAM)
+            except OSError as e:
+                log.warning("dns resolve %s failed (%s); keeping current "
+                            "set", host, e)
+                return list(self.backends())
+            urls = sorted({f"http://{i[4][0]}:{port}" for i in infos})
+        return [u for u in (_normalize_url(x) for x in urls) if u]
+
+    def _apply_registry(self, urls: List[str]) -> None:
+        """Reconcile the live backend table against the desired set,
+        keeping circuit state for backends that persist."""
+        desired = dict.fromkeys(urls)  # dedup, spec order preserved
+        gauge = self.metrics["tpustack_router_backend_healthy_state"]
+        with self._lock:
+            for url in desired:
+                if url not in self._backends:
+                    self._backends[url] = {"state": HEALTHY, "fails": 0,
+                                           "opened_at": 0.0, "ejections": 0}
+                    gauge.labels(backend=url).set(1)
+                    log.info("backend added: %s", url)
+            for url in [u for u in self._backends if u not in desired]:
+                del self._backends[url]
+                # drop the per-backend series outright: dns:// pod churn
+                # mints a fresh IP every restart, and stale zero-series
+                # would grow label cardinality for the router's lifetime
+                gauge.remove(backend=url)
+                self.metrics[
+                    "tpustack_router_backend_ejections_total"].remove(
+                        backend=url)
+                log.info("backend removed: %s", url)
+
+    def backends(self) -> List[str]:
+        with self._lock:
+            return list(self._backends)
+
+    def healthy_backends(self) -> List[str]:
+        with self._lock:
+            return [u for u, st in self._backends.items()
+                    if st["state"] == HEALTHY]
+
+    # -------------------------------------------------------------- health
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self._health_tick()
+            except Exception:
+                log.warning("health tick failed", exc_info=True)
+
+    def _health_tick(self) -> None:
+        self._apply_registry(self._resolve_spec())
+        now = time.monotonic()
+        with self._lock:
+            snapshot = {u: dict(st) for u, st in self._backends.items()}
+        for url, st in snapshot.items():
+            if (st["state"] == OPEN
+                    and now - st["opened_at"] < self.half_open_s):
+                continue  # circuit open; not yet half-open probe time
+            self._apply_probe(url, self._probe(url))
+
+    def _probe(self, url: str) -> str:
+        """One blocking /readyz check: ``ok`` | ``unready`` (the server
+        answered and said no — authoritative) | ``down`` (no answer)."""
+        timeout = max(0.2, min(2.0, self.health_interval_s))
+        try:
+            with urllib.request.urlopen(url + "/readyz",
+                                        timeout=timeout) as r:
+                return "ok" if r.status == 200 else "unready"
+        except urllib.error.HTTPError:
+            return "unready"
+        except Exception as e:
+            log.debug("probe %s down: %s", url, e)
+            return "down"
+
+    def _apply_probe(self, url: str, result: str) -> None:
+        with self._lock:
+            st = self._backends.get(url)
+            if st is None:
+                return
+            if result == "ok":
+                if st["state"] != HEALTHY:
+                    log.info("backend %s re-admitted (half-open probe ok)",
+                             url)
+                st["state"] = HEALTHY
+                st["fails"] = 0
+                self.metrics["tpustack_router_backend_healthy_state"].labels(
+                    backend=url).set(1)
+            elif result == "unready":
+                self._eject_locked(url, st)
+            else:  # down: tolerate flapping up to the ejection threshold
+                st["fails"] += 1
+                if st["fails"] >= self.eject_after or st["state"] == OPEN:
+                    self._eject_locked(url, st)
+
+    def _eject_locked(self, url: str, st: dict) -> None:
+        if st["state"] != OPEN:
+            st["ejections"] += 1
+            self.metrics["tpustack_router_backend_ejections_total"].labels(
+                backend=url).inc()
+            self.metrics["tpustack_router_backend_healthy_state"].labels(
+                backend=url).set(0)
+            log.warning("backend %s ejected (circuit open, half-open probe "
+                        "in %.1fs)", url, self.half_open_s)
+        st["state"] = OPEN
+        st["opened_at"] = time.monotonic()
+        st["fails"] = 0
+
+    def note_failure(self, url: str, reason: str) -> None:
+        """Passive outlier detection: a proxied request hit a connect
+        error / timeout / 5xx on this backend."""
+        with self._lock:
+            st = self._backends.get(url)
+            if st is None:
+                return
+            st["fails"] += 1
+            if st["fails"] >= self.eject_after and st["state"] == HEALTHY:
+                self._eject_locked(url, st)
+
+    def note_success(self, url: str) -> None:
+        """A real proxied request succeeded — as authoritative as a probe."""
+        with self._lock:
+            st = self._backends.get(url)
+            if st is None:
+                return
+            st["fails"] = 0
+            if st["state"] != HEALTHY:
+                st["state"] = HEALTHY
+                self.metrics["tpustack_router_backend_healthy_state"].labels(
+                    backend=url).set(1)
+
+    # ------------------------------------------------------------ affinity
+    def affinity_key(self, prompt: str) -> str:
+        """Digest of the block-aligned prompt prefix: prompts sharing a
+        prefix chunk land on the same replica (whose paged prefix cache /
+        host tier already holds those blocks)."""
+        n = (len(prompt) // self.affinity_chunk) * self.affinity_chunk
+        prefix = prompt[:n] if n else prompt
+        return hashlib.sha256(prefix.encode("utf-8", "replace")).hexdigest()
+
+    def note_affinity(self, key: str, chosen: str) -> str:
+        """Record where ``key`` landed; returns hit | cold_move | new."""
+        with self._lock:
+            prev = self._affinity.pop(key, None)
+            self._affinity[key] = chosen  # reinsert = LRU move-to-end
+            if len(self._affinity) > self.affinity_keys:
+                self._affinity.pop(next(iter(self._affinity)))
+            if prev is None:
+                self._aff_new += 1
+                result = "new"
+            elif prev == chosen:
+                self._aff_hits += 1
+                result = "hit"
+            else:
+                self._aff_cold += 1
+                result = "cold_move"
+            hits, cold = self._aff_hits, self._aff_cold
+        self.metrics["tpustack_router_affinity_total"].labels(
+            result=result).inc()
+        if hits + cold:
+            self.metrics["tpustack_router_affinity_hit_ratio"].set(
+                hits / (hits + cold))
+        return result
+
+    # ------------------------------------------------------------- proxying
+    def _client(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    def _upstream_headers(self, request) -> Dict[str, str]:
+        hdrs = {}
+        for name in _FORWARD_HEADERS:
+            v = request.headers.get(name)
+            if v is not None:
+                hdrs[name] = v
+        # one trace spans router -> replica: the replica's obs middleware
+        # parses this and parents its root span under ours
+        span = obs_trace.current_span.get()
+        if span is not None:
+            hdrs["traceparent"] = obs_trace.format_traceparent(span.context)
+        elif request.headers.get("traceparent"):
+            hdrs["traceparent"] = request.headers["traceparent"]
+        rid = request.get("request_id")
+        if rid:
+            hdrs["X-Request-Id"] = rid
+        return hdrs
+
+    async def _attempt(self, request, raw: bytes, target: str,
+                       stream: bool) -> dict:
+        """One upstream try.  Returns ``{"kind": "response", ...}`` (a
+        complete upstream reply, relayable or spillable), ``{"kind":
+        "stream", ...}`` (a 2xx ``text/event-stream`` reply with its
+        first chunk pre-read — the failover point of no return), or
+        ``{"kind": "conn_error", ...}``.
+
+        A streaming reply is recognised from the upstream's OWN
+        Content-Type, not just the request's predicted ``stream`` flag:
+        a mispredicted stream is still relayed chunk by chunk (bounded
+        by the total timeout) rather than buffered whole."""
+        import aiohttp
+
+        url = target + request.path
+        hdrs = self._upstream_headers(request)
+        if stream:
+            timeout = aiohttp.ClientTimeout(
+                total=None, sock_connect=min(10.0, self.upstream_timeout_s),
+                sock_read=self.upstream_timeout_s)
+        else:
+            timeout = aiohttp.ClientTimeout(total=self.upstream_timeout_s)
+        try:
+            up = await self._client().post(url, data=raw, headers=hdrs,
+                                           timeout=timeout)
+            if up.status < 400 and str(
+                    up.headers.get("Content-Type", "")).startswith(
+                        "text/event-stream"):
+                try:
+                    first = await up.content.readany()
+                except Exception as e:
+                    up.close()
+                    log.warning("stream from %s died before first byte: %s",
+                                target, e)
+                    return {"kind": "conn_error", "reason": "connect_error",
+                            "error": f"stream died before first byte: {e}"}
+                return {"kind": "stream", "up": up, "first": first}
+            try:
+                return {"kind": "response", "status": up.status,
+                        "payload": await up.read(),
+                        "headers": dict(up.headers)}
+            finally:
+                up.release()
+        except asyncio.TimeoutError:
+            return {"kind": "conn_error", "reason": "timeout",
+                    "error": f"upstream timeout after "
+                             f"{self.upstream_timeout_s:.0f}s"}
+        except (aiohttp.ClientError, OSError) as e:
+            return {"kind": "conn_error", "reason": "connect_error",
+                    "error": str(e) or type(e).__name__}
+
+    def _retry_wait_s(self, rec: Optional[dict]) -> float:
+        """How long to sit out before re-trying an already-tried set:
+        the upstream's own Retry-After (capped at 1 s so an interactive
+        request never stalls long on a mis-set header) plus jitter."""
+        wait = (random.uniform(0, self.retry_jitter_s)
+                if self.retry_jitter_s > 0 else 0.0)
+        try:
+            ra = float((rec or {}).get("headers", {}).get("Retry-After"))
+        except (TypeError, ValueError):
+            ra = 0.0
+        return wait + min(max(ra, 0.0), 1.0)
+
+    def _spill_reason(self, rec: dict) -> Optional[str]:
+        """Why this upstream reply should spill to the next replica, or
+        None when it must be relayed honestly."""
+        if rec["kind"] == "conn_error":
+            return rec["reason"]
+        status = rec["status"]
+        shed = rec["headers"].get("X-Shed-Reason")
+        if status < 400:
+            return None
+        if shed == "quota":
+            return None  # policy, not capacity: never spill
+        if shed in SPILL_REASONS:
+            return shed
+        if status in (500, 502, 503):
+            return "http_5xx"  # bare 5xx: treat the replica as sick
+        return None  # 4xx client errors, 504 deadline: relay honestly
+
+    def _note_outcome(self, outcome: str) -> None:
+        self.metrics["tpustack_router_requests_total"].labels(
+            outcome=outcome).inc()
+        with self._lock:
+            self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
+
+    def _note_failover(self, reason: str, budget_left: int) -> None:
+        self.metrics["tpustack_router_failover_total"].labels(
+            reason=reason).inc()
+        self.metrics["tpustack_router_retry_budget_retries"].set(budget_left)
+        with self._lock:
+            self._failovers[reason] = self._failovers.get(reason, 0) + 1
+
+    @staticmethod
+    def _relay_headers(rec: dict, target: str) -> Dict[str, str]:
+        out = {"X-Router-Backend": target}
+        for name in _RELAY_HEADERS:
+            v = rec["headers"].get(name)
+            if v is not None:
+                out[name] = v
+        return out
+
+    @staticmethod
+    def _outcome_of(status: int, shed: Optional[str]) -> str:
+        if status < 400:
+            return "ok"
+        if status == 504:
+            return "deadline"
+        if shed is not None:
+            return "shed"
+        # a relayed 4xx is the REQUEST's fault, not successful proxying —
+        # counting it "ok" would inflate the success rate
+        return "client_error" if status < 500 else "error"
+
+    async def handle_work(self, request: web.Request) -> web.StreamResponse:
+        raw = await request.read()
+        body = request.get("json_body")
+        if body is None and raw:
+            # the obs middleware only parses POST application/json bodies
+            # up to its size bound — a long-context prompt or an odd
+            # content type arrives unparsed.  Stream detection and the
+            # prefix-affinity key both need the real fields, so parse the
+            # (already-read) bytes here; non-JSON stays None and the raw
+            # bytes remain the affinity fallback.
+            try:
+                body = json.loads(raw)
+            except ValueError:
+                body = None  # non-JSON: raw bytes stay the affinity input
+        prompt = self._prompt_of(body, raw)
+        stream = bool(body.get("stream")) if isinstance(body, dict) else False
+        key = self.affinity_key(prompt)
+
+        budget = self.retry_budget
+        tried: set = set()
+        last: Optional[dict] = None
+        last_target = ""
+        while True:
+            candidates = [u for u in self.healthy_backends()
+                          if u not in tried]
+            if not candidates and tried:
+                # every healthy backend already shed/erred this request.
+                # Remaining budget buys a short Retry-After wait and a
+                # second pass over the same set: transient exhaustion
+                # (a failover surge filling the survivor's KV pool)
+                # clears within a service time, and the budget still
+                # bounds total attempts.
+                if budget <= 0:
+                    break
+                await asyncio.sleep(self._retry_wait_s(last))
+                tried.clear()
+                continue  # re-read health: the set may have changed
+            if not candidates:
+                break
+            target = rendezvous_rank(key, candidates)[0]
+            self.note_affinity(key, target)
+            rec = await self._attempt(request, raw, target, stream)
+
+            if rec["kind"] == "stream":
+                return await self._relay_stream(request, rec, target)
+
+            if rec["kind"] == "conn_error":
+                self.note_failure(target, rec["reason"])
+            elif rec["status"] in (500, 502) or (
+                    rec["status"] == 503
+                    and rec["headers"].get("X-Shed-Reason") is None):
+                # bare 5xx counts toward passive ejection; an explicit
+                # shed (has X-Shed-Reason) is load, not sickness
+                self.note_failure(target, "http_5xx")
+            elif rec["status"] < 500:
+                self.note_success(target)
+
+            spill = self._spill_reason(rec)
+            last, last_target = rec, target
+            if spill is None or budget <= 0:
+                break
+            budget -= 1
+            tried.add(target)
+            self._note_failover(spill, budget)
+            if self.retry_jitter_s > 0:
+                await asyncio.sleep(random.uniform(0, self.retry_jitter_s))
+
+        if last is None:
+            self._note_outcome("no_backend")
+            return web.json_response(
+                {"error": "no healthy backend"}, status=503,
+                headers=shed_headers("no_backend",
+                                     max(1, int(self.half_open_s))))
+        if last["kind"] == "conn_error":
+            self._note_outcome("error")
+            return web.json_response(
+                {"error": f"upstream {last['reason']}: {last['error']}"},
+                status=502,
+                headers={"X-Router-Backend": last_target})
+        shed = last["headers"].get("X-Shed-Reason")
+        self._note_outcome(self._outcome_of(last["status"], shed))
+        return web.Response(body=last["payload"], status=last["status"],
+                            headers=self._relay_headers(last, last_target))
+
+    async def _relay_stream(self, request, rec: dict,
+                            target: str) -> web.StreamResponse:
+        """Relay an upstream SSE stream.  ``rec['first']`` was read before
+        we committed — from here on errors propagate honestly (the client
+        already saw bytes; a silent retry would replay the world)."""
+        up = rec["up"]
+        resp = web.StreamResponse(status=up.status)
+        ct = up.headers.get("Content-Type")
+        if ct:
+            resp.headers["Content-Type"] = ct
+        resp.headers["X-Router-Backend"] = target
+        await resp.prepare(request)
+        try:
+            if rec["first"]:
+                await resp.write(rec["first"])
+            while True:
+                chunk = await up.content.readany()
+                if not chunk:
+                    break
+                await resp.write(chunk)
+            await resp.write_eof()
+        except Exception as e:
+            self.note_failure(target, "stream")
+            self._note_outcome("error")
+            log.warning("stream from %s died mid-flight: %s", target, e)
+            return resp
+        finally:
+            up.release()
+        self.note_success(target)
+        self._note_outcome("ok")
+        return resp
+
+    @staticmethod
+    def _prompt_of(body, raw: bytes) -> str:
+        if isinstance(body, dict):
+            p = body.get("prompt")
+            if isinstance(p, str):
+                return p
+            msgs = body.get("messages")
+            if isinstance(msgs, list):
+                return "\n".join(str(m.get("content", ""))
+                                 for m in msgs if isinstance(m, dict))
+        return raw.decode("utf-8", "replace")
+
+    # ------------------------------------------------------------ app/views
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        status, payload = self.resilience.health_payload(
+            extra={"backends": len(self.backends()),
+                   "healthy_backends": len(self.healthy_backends())})
+        return web.json_response(payload, status=status,
+                                 headers=self.resilience.health_headers(status))
+
+    async def readyz(self, request: web.Request) -> web.Response:
+        """Ready iff not draining AND at least one backend is routable —
+        a router with an empty healthy set must leave Service rotation."""
+        status, payload = self.resilience.ready_payload()
+        healthy = len(self.healthy_backends())
+        payload["healthy_backends"] = healthy
+        headers = self.resilience.ready_headers(status)
+        if status == 200 and healthy == 0:
+            status = 503
+            payload["ready"] = False
+            headers = shed_headers("no_backend",
+                                   max(1, int(self.half_open_s)))
+        return web.json_response(payload, status=status, headers=headers)
+
+    async def debug_router(self, request: web.Request) -> web.Response:
+        now = time.monotonic()
+        with self._lock:
+            backends = {
+                u: {"state": st["state"], "fails": st["fails"],
+                    "ejections": st["ejections"],
+                    "open_age_s": (round(now - st["opened_at"], 3)
+                                   if st["state"] == OPEN else None)}
+                for u, st in self._backends.items()}
+            hits, cold, new = self._aff_hits, self._aff_cold, self._aff_new
+            affinity_entries = len(self._affinity)
+            outcomes = dict(self._outcomes)
+            failovers = dict(self._failovers)
+        return web.json_response({
+            "spec": self.spec,
+            "backends": backends,
+            "healthy": sum(1 for b in backends.values()
+                           if b["state"] == HEALTHY),
+            "requests": outcomes,
+            "failovers": failovers,
+            "affinity": {
+                "hit": hits, "cold_move": cold, "new": new,
+                "hit_ratio": (hits / (hits + cold)) if hits + cold else None,
+                "entries": affinity_entries,
+                "chunk": self.affinity_chunk,
+            },
+            "config": {
+                "health_interval_s": self.health_interval_s,
+                "eject_after": self.eject_after,
+                "half_open_s": self.half_open_s,
+                "retry_budget": self.retry_budget,
+                "retry_jitter_s": self.retry_jitter_s,
+                "upstream_timeout_s": self.upstream_timeout_s,
+            },
+        })
+
+    def build_app(self) -> web.Application:
+        work = set(WORK_PATHS)
+        app = web.Application(
+            middlewares=[obs_http.instrument("router", self._registry,
+                                             tracer=self.tracer,
+                                             ledger=self.ledger,
+                                             work_endpoints=work),
+                         self.resilience.middleware(work)])
+        obs_http.add_debug_trace_routes(app, self.tracer)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/readyz", self.readyz)
+        app.router.add_get("/metrics",
+                           obs_http.make_metrics_handler(self._registry))
+        app.router.add_get("/debug/router", self.debug_router)
+        for path in sorted(WORK_PATHS):
+            app.router.add_post(path, self.handle_work)
+        return app
+
+    def close(self) -> None:
+        """Stop the health thread (tests construct many routers)."""
+        self._stop.set()
+        self._health_thread.join(timeout=2)
+        self.resilience.close()
+        if self._session is not None and not self._session.closed:
+            try:
+                loop = asyncio.get_event_loop()
+                if not loop.is_closed():
+                    loop.create_task(self._session.close())
+            except RuntimeError:
+                pass
+
+
+def maybe_from_env(registry=None, tracer=None, env=None) -> Optional[Router]:
+    """The bisection contract: ``TPUSTACK_ROUTER_BACKENDS`` unset/empty
+    constructs NOTHING — no thread, no metrics, no state."""
+    spec = knobs.get_str("TPUSTACK_ROUTER_BACKENDS", env=env).strip()
+    if not spec:
+        return None
+    return Router(spec, registry=registry, tracer=tracer, env=env)
+
+
+def main() -> None:
+    router = maybe_from_env()
+    if router is None:
+        raise SystemExit("TPUSTACK_ROUTER_BACKENDS is not set — nothing "
+                         "to route")
+    port = int(os.environ.get("PORT", "8090"))
+    router.resilience.install_signal_handlers()
+    obs_http.maybe_start_metrics_sidecar()
+    web.run_app(router.build_app(), port=port, access_log=None,
+                handle_signals=False)
+
+
+if __name__ == "__main__":
+    main()

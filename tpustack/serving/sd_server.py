@@ -62,7 +62,7 @@ from tpustack.obs import profile as obs_profile
 from tpustack.obs import trace as obs_trace
 from tpustack.serving.resilience import (DeadlineExceeded,
                                          InjectedDeviceError,
-                                         ResilienceManager)
+                                         ResilienceManager, shed_headers)
 from tpustack.utils import get_logger
 from tpustack.utils.image import array_to_png
 
@@ -287,11 +287,13 @@ class SDServer:
             "batch_window_ms": self.batch_window_s * 1e3,
             "dp": self._mesh_data_size() or 1,
         })
-        return web.json_response(payload, status=status)
+        return web.json_response(payload, status=status,
+                                 headers=self.resilience.health_headers(status))
 
     async def readyz(self, request: web.Request) -> web.Response:
         status, payload = self.resilience.ready_payload()
-        return web.json_response(payload, status=status)
+        return web.json_response(payload, status=status,
+                                 headers=self.resilience.ready_headers(status))
 
     async def index(self, request: web.Request) -> web.Response:
         if self._last_image is None:
@@ -370,7 +372,8 @@ class SDServer:
             self.resilience.note_deadline(phase)
             return web.json_response(
                 {"detail": f"request deadline exceeded (phase={phase})",
-                 "phase": phase}, status=504)
+                 "phase": phase}, status=504,
+                headers=shed_headers("deadline"))
         except InjectedDeviceError as e:
             return self.resilience.transient_error_response(e)
         from tpustack.obs import Trace

@@ -200,6 +200,42 @@ _declare("TPUSTACK_WATCHDOG_S", float, 0.0,
          "No-progress seconds before liveness flips 503 (0 disables; set "
          "above the worst cold-compile dispatch).")
 
+# ------------------------------------------------------------------- router
+_declare("TPUSTACK_ROUTER_BACKENDS", str, "",
+         "Replica set for the L7 router: comma list of base URLs "
+         "(http://host:port), @/path/to/file (one URL per line, "
+         "hot-reloaded on mtime change), or dns://host:port (A records "
+         "re-resolved each health tick).  Empty is the bisection flag — "
+         "no router constructs.")
+_declare("TPUSTACK_ROUTER_HEALTH_INTERVAL_S", float, 2.0,
+         "Seconds between active /readyz polls of every backend (also "
+         "the file/DNS re-resolution cadence).")
+_declare("TPUSTACK_ROUTER_EJECT_AFTER", int, 3,
+         "Consecutive passive failures (connect error / timeout / 5xx) "
+         "before a backend is ejected from the healthy set (circuit "
+         "opens).")
+_declare("TPUSTACK_ROUTER_HALF_OPEN_S", float, 5.0,
+         "Seconds an ejected backend stays open before a half-open "
+         "/readyz probe may re-admit it.")
+_declare("TPUSTACK_ROUTER_RETRY_BUDGET", int, 2,
+         "Max failover attempts per request beyond the first try "
+         "(connect errors and spillable sheds only; quota sheds never "
+         "spill).")
+_declare("TPUSTACK_ROUTER_RETRY_JITTER_S", float, 0.05,
+         "Upper bound of the uniform jitter slept before each failover "
+         "attempt (decorrelates retry stampedes after an ejection).")
+_declare("TPUSTACK_ROUTER_AFFINITY_CHUNK", int, 256,
+         "Prompt-prefix alignment in characters for the rendezvous "
+         "affinity key — mirror of the replicas' prefix-cache chunking "
+         "so one replica keeps a given prefix hot.")
+_declare("TPUSTACK_ROUTER_AFFINITY_KEYS", int, 4096,
+         "LRU capacity of the router's affinity table (prefix-key -> "
+         "last backend), used only for hit/cold-move accounting.")
+_declare("TPUSTACK_ROUTER_UPSTREAM_TIMEOUT_S", float, 600.0,
+         "Total per-attempt upstream timeout in seconds (covers connect "
+         "+ full response; streaming responses are exempt after the "
+         "first byte).")
+
 # ------------------------------------------------------------ fault injection
 _declare("TPUSTACK_FAULT_SLOW_PREFILL_S", float, 0.0,
          "Sleep injected before every device dispatch (deterministic "
