@@ -38,6 +38,17 @@ if TPU_MODE:
 else:
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from tpustack.utils import enable_compile_cache
+
+    # the CPU tier pays real XLA compiles too (tiny-model fixtures, and
+    # every subprocess drill re-compiles the same programs the in-process
+    # fixtures just built); the persistent cache (<repo>/.cache/xla,
+    # gitignored — the same dir llm_server.main() already uses) makes
+    # them cross-process and cross-run hits.  Recompile signatures count
+    # python retraces, so cache hits change wall-clock only, never a
+    # perf signature.
+    enable_compile_cache()
 
 # Repo root on sys.path so `import tpustack` works without installation.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,691 @@
+"""The elastic capacity controller (tpustack.serving.autoscaler): the
+damped policy (hysteresis walls, cooldowns, flap suppression, the
+unhealthy hard floor), victim selection by affinity share, both scale
+executors, the authenticated reversible ``POST /admin/drain`` lever it
+choreographs scale-down through, and the ``/debug/autoscaler`` surface.
+
+Policy tests drive ``decide()`` with synthetic signal snapshots; the
+loop test runs ``tick()`` against a stdlib stub fleet over real HTTP;
+the executor tests spawn real subprocesses (a tiny stub replica) and
+assert the registry-file + drain choreography.  The admin-drain tests
+run against a REAL tiny LLMServer, including the router observing the
+authoritative unready within one health tick."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpustack.obs import Registry
+from tpustack.serving.autoscaler import (Autoscaler, KubernetesExecutor,
+                                         LocalSubprocessExecutor,
+                                         ScaleExecutor, executor_from_env,
+                                         maybe_from_env)
+from tpustack.serving.router import Router
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(coro):
+    import asyncio
+
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+#: deterministic unit-test knobs: no cooldowns unless a test sets them
+_FAST = {
+    "TPUSTACK_AUTOSCALER_MIN": "1",
+    "TPUSTACK_AUTOSCALER_MAX": "4",
+    "TPUSTACK_AUTOSCALER_TARGET_LOAD": "2.0",
+    "TPUSTACK_AUTOSCALER_HYSTERESIS": "0.25",
+    "TPUSTACK_AUTOSCALER_INTERVAL_S": "30",
+    "TPUSTACK_AUTOSCALER_UP_COOLDOWN_S": "0",
+    "TPUSTACK_AUTOSCALER_DOWN_COOLDOWN_S": "0",
+    "TPUSTACK_AUTOSCALER_DOWN_STABLE_TICKS": "1",
+    "TPUSTACK_AUTOSCALER_KV_FREE_MIN": "0.05",
+}
+
+
+class FakeExecutor(ScaleExecutor):
+    def __init__(self, n=1):
+        self.n = n
+        self.calls = []
+
+    def actual(self):
+        return self.n
+
+    def scale_to(self, desired, victims):
+        self.calls.append((desired, list(victims)))
+        events = []
+        while self.n < desired:
+            self.n += 1
+            events.append({"direction": "up", "url": f"http://new:{self.n}",
+                           "ready": True})
+        while self.n > desired:
+            self.n -= 1
+            url = victims[len(events)] if len(victims) > len(events) else "?"
+            events.append({"direction": "down", "url": url, "drained": True,
+                           "exit_code": 0, "inflight_at_term": 0,
+                           "drain_wait_s": 0.01})
+        return events
+
+
+def make_scaler(executor=None, router_url="http://127.0.0.1:1",
+                **overrides):
+    env = dict(_FAST)
+    env.update(overrides)
+    return Autoscaler(router_url, executor or FakeExecutor(),
+                      registry=Registry(), env=env)
+
+
+def _signals(load, backends=None, shed=0.0, kv=None, unhealthy=False):
+    backends = backends or {"http://b:1": {"state": "healthy",
+                                           "affinity_keys": 1,
+                                           "inflight": load,
+                                           "queue_depth": 0}}
+    return {"backends": backends, "registered": len(backends),
+            "healthy": len(backends), "load": load, "shed_total": shed,
+            "kv_free_ratio_min": kv, "unhealthy_any": unhealthy}
+
+
+# ---------------------------------------------------------------- policy
+def test_policy_scale_up_on_load_jumps_to_need():
+    a = make_scaler()
+    # load 7 over 1 replica, target 2: up wall = 2.5, want ceil(7/2) = 4
+    d = a.decide(_signals(7), actual=1, now=100.0)
+    assert d["direction"] == "up" and d["reason"] == "load"
+    assert d["desired"] == 4
+
+
+def test_policy_hysteresis_dead_band_holds():
+    a = make_scaler()
+    # 2 replicas, target 2: up wall 5.0, down wall (2-1)*2*0.75 = 1.5 —
+    # anything in (1.5, 5.0] holds
+    for load in (2, 3, 5):
+        d = a.decide(_signals(load), actual=2, now=100.0)
+        assert d["direction"] == "hold", (load, d)
+    assert a.decide(_signals(6), 2, 100.0)["direction"] == "up"
+    assert a.decide(_signals(1), 2, 100.0)["direction"] == "down"
+
+
+def test_policy_min_max_bounds():
+    a = make_scaler()
+    # at the ceiling: the desire is clamped, no event
+    d = a.decide(_signals(40), actual=4, now=100.0)
+    assert d["direction"] == "hold" and d["reason"] == "bounds"
+    # at the floor: idle never goes below min
+    d = a.decide(_signals(0), actual=1, now=100.0)
+    assert d["direction"] == "hold" and d["reason"] == "steady"
+
+
+def test_policy_shed_pressure_fires_inside_dead_band():
+    a = make_scaler()
+    a.decide(_signals(2, shed=0.0), actual=2, now=100.0)
+    # a shed DELTA (not absolute count) forces up even though load holds
+    d = a.decide(_signals(2, shed=3.0), actual=2, now=101.0)
+    assert d["direction"] == "up" and d["reason"] == "shed_pressure"
+    # fleet-sum stepping BACKWARDS (replica churn) is not pressure
+    d = a.decide(_signals(2, shed=1.0), actual=2, now=102.0)
+    assert d["direction"] == "hold"
+
+
+def test_policy_kv_pressure_fires_up():
+    a = make_scaler()
+    d = a.decide(_signals(2, kv=0.01), actual=2, now=100.0)
+    assert d["direction"] == "up" and d["reason"] == "kv_pressure"
+    d = a.decide(_signals(2, kv=0.5), actual=2, now=101.0)
+    assert d["direction"] == "hold"
+
+
+def test_policy_down_needs_stable_streak():
+    a = make_scaler(TPUSTACK_AUTOSCALER_DOWN_STABLE_TICKS="3")
+    for i, want in enumerate(["down_stabilizing", "down_stabilizing",
+                              "idle"]):
+        d = a.decide(_signals(0), actual=2, now=100.0 + i)
+        assert d["reason"] == want, (i, d)
+    assert d["direction"] == "down" and d["desired"] == 1
+    # any non-down tick resets the streak
+    a.decide(_signals(4), actual=2, now=104.0)
+    d = a.decide(_signals(0), actual=2, now=105.0)
+    assert d["reason"] == "down_stabilizing"
+
+
+def test_policy_cooldowns_up_fast_down_slow():
+    a = make_scaler(TPUSTACK_AUTOSCALER_UP_COOLDOWN_S="5",
+                    TPUSTACK_AUTOSCALER_DOWN_COOLDOWN_S="60")
+    a._last_up_at = 100.0
+    d = a.decide(_signals(9), actual=1, now=102.0)
+    assert d["direction"] == "hold" and d["reason"] == "up_cooldown"
+    d = a.decide(_signals(9), actual=1, now=106.0)
+    assert d["direction"] == "up"
+    # a down within the long cooldown of the up is suppressed
+    a._last_up_at = 100.0
+    d = a.decide(_signals(0), actual=2, now=110.0)
+    assert d["direction"] == "hold" and d["reason"] == "down_cooldown"
+    d = a.decide(_signals(0), actual=2, now=161.0)
+    assert d["direction"] == "down"
+
+
+def test_policy_hard_floor_while_unhealthy():
+    a = make_scaler()
+    d = a.decide(_signals(0, unhealthy=True), actual=3, now=100.0)
+    assert d["direction"] == "hold" and d["reason"] == "unhealthy_floor"
+    # scale-UP is never floored — more capacity helps a sick fleet
+    d = a.decide(_signals(20, unhealthy=True), actual=3, now=101.0)
+    assert d["direction"] == "up"
+
+
+def test_policy_down_one_step_per_event():
+    a = make_scaler()
+    d = a.decide(_signals(0), actual=4, now=100.0)
+    assert d["direction"] == "down" and d["desired"] == 3
+
+
+def test_pick_victims_smallest_affinity_share_first():
+    a = make_scaler()
+    backends = {
+        "http://b:1": {"affinity_keys": 9, "inflight": 0, "queue_depth": 0},
+        "http://b:2": {"affinity_keys": 2, "inflight": 5, "queue_depth": 0},
+        "http://b:3": {"affinity_keys": 2, "inflight": 0, "queue_depth": 0},
+    }
+    # smallest share wins; ties break toward the idler replica
+    assert a.pick_victims(_signals(0, backends=backends), 2) == \
+        ["http://b:3", "http://b:2"]
+
+
+# ------------------------------------------------------------ tick + loop
+def _stub_fleet(state):
+    """One stdlib HTTP server standing in for router AND replica: the
+    /debug/router payload lists the server's own URL as the backend, so
+    observe() scrapes /healthz and /metrics off the same socket."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            if self.path == "/debug/router":
+                body = json.dumps({
+                    "backends": {state["url"]: {
+                        "state": state.get("state", "healthy"),
+                        "affinity_keys": 3}},
+                    "healthy": 1}).encode()
+                ctype = "application/json"
+            elif self.path == "/healthz":
+                body = json.dumps({"ok": True,
+                                   "inflight": state.get("inflight", 0),
+                                   "queue_depth": state.get("queue", 0),
+                                   }).encode()
+                ctype = "application/json"
+            elif self.path == "/metrics":
+                body = (
+                    'tpustack_requests_shed_total{server="llm",'
+                    'reason="backpressure"} %g\n'
+                    'tpustack_llm_kv_free_blocks %g\n'
+                    'tpustack_llm_kv_used_blocks %g\n' % (
+                        state.get("shed", 0.0),
+                        state.get("kv_free", 90.0),
+                        state.get("kv_used", 6.0))).encode()
+                ctype = "text/plain"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    state["url"] = f"http://127.0.0.1:{srv.server_address[1]}"
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, state["url"]
+
+
+def test_tick_scrapes_decides_executes_and_records():
+    state = {"inflight": 9}
+    srv, url = _stub_fleet(state)
+    fake = FakeExecutor(n=1)
+    a = make_scaler(executor=fake, router_url=url)
+    try:
+        rec = a.tick()
+        assert rec["direction"] == "up" and rec["load"] == 9
+        assert fake.calls == [(4, [])]  # ceil(9/2)=5 clamped to max 4
+        dbg = a.debug_payload()
+        assert dbg["desired"] == 4 and dbg["actual"] == 4
+        assert dbg["converged"] is True
+        assert [e["direction"] for e in dbg["events"]] == ["up"] * 3
+        assert dbg["signals"]["backends"][url]["inflight"] == 9
+        # the catalog gauges track the decision
+        text = a._registry.render()
+        assert "tpustack_autoscaler_desired_replicas 4" in text
+        assert "tpustack_autoscaler_actual_replicas 4" in text
+        assert 'direction="up"' in text
+        # now idle: one tick scales down one step, victims chosen
+        state["inflight"] = 0
+        rec = a.tick()
+        assert rec["direction"] == "down" and rec["desired"] == 3
+        assert fake.calls[-1] == (3, [url])
+        down = a.debug_payload()["events"][-1]
+        assert down["direction"] == "down"
+        assert down["victim_affinity_keys"] == 3
+        assert down["fleet_affinity_keys"] == {url: 3}
+    finally:
+        srv.shutdown()
+
+
+def test_tick_holds_blind_when_router_unreachable():
+    fake = FakeExecutor(n=2)
+    a = make_scaler(executor=fake, router_url="http://127.0.0.1:9")
+    rec = a.tick()
+    assert rec["direction"] == "hold" and rec["reason"] == "scrape_failed"
+    assert fake.calls == []
+
+
+def test_debug_app_surfaces():
+    async def scenario():
+        state = {"inflight": 0}
+        srv, url = _stub_fleet(state)
+        a = make_scaler(executor=FakeExecutor(n=1), router_url=url)
+        client = TestClient(TestServer(a.build_app()))
+        await client.start_server()
+        try:
+            # loop not started: not ready (a blind autoscaler HOLDs, but
+            # a dead one should be restarted)
+            r = await client.get("/readyz")
+            assert r.status == 503
+            a.start()
+            r = await client.get("/readyz")
+            assert r.status == 200
+            r = await client.get("/healthz")
+            assert r.status == 200
+            r = await client.get("/debug/autoscaler")
+            assert r.status == 200
+            dbg = await r.json()
+            assert {"desired", "actual", "converged",
+                    "scaling_in_progress", "last_event_age_s", "policy",
+                    "signals", "decisions", "events"} <= set(dbg)
+            assert dbg["policy"]["min"] == 1 and dbg["policy"]["max"] == 4
+            r = await client.get("/metrics")
+            assert "tpustack_autoscaler_desired_replicas" in await r.text()
+        finally:
+            a.close()
+            await client.close()
+            srv.shutdown()
+    _run(scenario())
+
+
+def test_close_stops_loop_thread():
+    a = make_scaler(TPUSTACK_AUTOSCALER_INTERVAL_S="0.05")
+    a.start()
+    thread = a._thread
+    assert thread.is_alive()
+    a.close()
+    assert not thread.is_alive()
+    assert not any(t.name == "tpustack-autoscaler"
+                   for t in threading.enumerate())
+
+
+# ------------------------------------------------------- local executor
+#: a stub replica process: /readyz flips 503 after an authenticated
+#: /admin/drain (the contract the executor choreographs against) and a
+#: SIGTERM exits 0 — fast to boot, no model compile
+_STUB_REPLICA = r"""
+import json, os, signal, sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+draining = {"v": False}
+
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/readyz":
+            self._send(503 if draining["v"] else 200,
+                       {"ready": not draining["v"]})
+        elif self.path == "/healthz":
+            self._send(200, {"ok": True, "inflight": 0, "queue_depth": 0})
+        else:
+            self._send(404, {})
+
+    def do_POST(self):
+        if self.path == "/admin/drain":
+            if self.headers.get("X-Admin-Token", "") != \
+                    os.environ.get("TPUSTACK_ADMIN_TOKEN", ""):
+                self._send(403, {"error": "forbidden"})
+                return
+            draining["v"] = True
+            self._send(200, {"ok": True, "draining": True})
+        else:
+            self._send(404, {})
+
+
+signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+HTTPServer(("127.0.0.1", int(sys.argv[1])), H).serve_forever()
+"""
+
+
+def test_local_executor_spawn_registry_and_drain_choreography(tmp_path):
+    registry_file = tmp_path / "backends.txt"
+    registry_file.write_text("")
+
+    def spawn(port):
+        return [sys.executable, "-c", _STUB_REPLICA, str(port)]
+
+    ex = LocalSubprocessExecutor(
+        str(registry_file), spawn,
+        env=dict(os.environ, TPUSTACK_ADMIN_TOKEN="sekrit"),
+        admin_token="sekrit", ready_timeout_s=30, drain_timeout_s=15)
+    try:
+        events = ex.scale_to(2, [])
+        assert [e["direction"] for e in events] == ["up", "up"]
+        assert all(e["ready"] for e in events), events
+        urls = ex.urls()
+        assert ex.actual() == 2
+        # registry file holds exactly the live fleet
+        assert registry_file.read_text().split() == urls
+        mtime1 = os.stat(registry_file).st_mtime
+
+        victim = urls[0]
+        (down,) = ex.scale_to(1, [victim])
+        assert down["direction"] == "down" and down["url"] == victim
+        # the full choreography: drained via the authenticated admin
+        # lever, waited to idle, SIGTERMed, exited 0
+        assert "admin_drain_error" not in down, down
+        assert down["inflight_at_term"] == 0
+        assert down["exit_code"] == 0
+        assert down["drained"] is True
+        assert down["drain_wait_s"] >= 0
+        # membership followed, and the rewrite moved the mtime so the
+        # router's equal-mtime fast path cannot miss it
+        assert ex.urls() == [u for u in urls if u != victim]
+        assert registry_file.read_text().split() == ex.urls()
+        assert os.stat(registry_file).st_mtime != mtime1
+    finally:
+        ex.close()
+    assert ex.actual() == 0
+
+
+# ---------------------------------------------------------- k8s executor
+def test_kubernetes_executor_patches_scale_subresource():
+    calls = []
+
+    def transport(method, url, body, headers):
+        calls.append((method, url, body, headers))
+        return {"spec": {"replicas": 2}}
+
+    ex = KubernetesExecutor("llm", "coder-llm",
+                            api_base="https://10.0.0.1:443", token="tok",
+                            transport=transport)
+    assert ex.actual() == 2
+    events = ex.scale_to(3, [])
+    method, url, body, headers = calls[-1]
+    assert method == "PATCH"
+    assert url == ("https://10.0.0.1:443/apis/apps/v1/namespaces/llm/"
+                   "deployments/coder-llm/scale")
+    assert json.loads(body) == {"spec": {"replicas": 3}}
+    assert headers["Authorization"] == "Bearer tok"
+    assert headers["Content-Type"] == "application/merge-patch+json"
+    assert events == [{"direction": "up", "deployment": "coder-llm",
+                       "namespace": "llm", "replicas": 3, "was": 2}]
+    # victims are accepted but k8s picks the pod; a down is still a down
+    events = ex.scale_to(1, ["http://pod:8080"])
+    assert events[0]["direction"] == "down"
+
+
+def test_kubernetes_executor_holds_on_api_error():
+    def transport(method, url, body, headers):
+        raise OSError("apiserver away")
+
+    ex = KubernetesExecutor("llm", "coder-llm", api_base="https://x",
+                            token="t", transport=transport)
+    assert ex.actual() is None
+    events = ex.scale_to(3, [])
+    assert events[0]["direction"] == "error"
+
+
+# ------------------------------------------------- bisection + env wiring
+def test_maybe_from_env_unset_constructs_nothing():
+    assert maybe_from_env(env={}) is None
+    assert maybe_from_env(env={"TPUSTACK_AUTOSCALER_ROUTER_URL": " "}) is None
+    with pytest.raises(ValueError):
+        # a router URL without any executor config is a broken deploy
+        maybe_from_env(env={"TPUSTACK_AUTOSCALER_ROUTER_URL": "http://r:1"})
+
+
+def test_executor_from_env_selects_and_validates(tmp_path):
+    reg = tmp_path / "backends.txt"
+    with pytest.raises(ValueError):
+        executor_from_env(env={
+            "TPUSTACK_AUTOSCALER_REGISTRY_FILE": str(reg)})
+    ex = executor_from_env(env={
+        "TPUSTACK_AUTOSCALER_REGISTRY_FILE": str(reg),
+        "TPUSTACK_AUTOSCALER_SPAWN_CMD":
+            "python -m tpustack.serving.llm_server --port {port}",
+        "TPUSTACK_ADMIN_TOKEN": "tok"})
+    assert isinstance(ex, LocalSubprocessExecutor)
+    assert ex.spawn(1234)[-1] == "1234"
+    assert ex.admin_token == "tok"
+    k8s = executor_from_env(env={
+        "TPUSTACK_AUTOSCALER_K8S_DEPLOYMENT": "coder-llm",
+        "TPUSTACK_AUTOSCALER_K8S_NAMESPACE": "llm"})
+    assert isinstance(k8s, KubernetesExecutor)
+    assert k8s.namespace == "llm" and k8s.deployment == "coder-llm"
+
+
+# ----------------------------------------- POST /admin/drain (satellite)
+@pytest.fixture(scope="module")
+def llm_server():
+    import jax.numpy as jnp
+
+    from tpustack.models.llama import LlamaConfig
+    from tpustack.models.llm_generate import Generator
+    from tpustack.models.text_tokenizer import ByteTokenizer
+    from tpustack.serving.llm_server import LLMServer
+
+    gen = Generator(LlamaConfig.tiny(max_seq=64), dtype=jnp.float32, seed=3)
+    return LLMServer(generator=gen, tokenizer=ByteTokenizer(512),
+                     model_name="tiny-test", max_batch=2,
+                     registry=Registry())
+
+
+def test_admin_drain_requires_token(llm_server, monkeypatch):
+    async def scenario():
+        client = TestClient(TestServer(llm_server.build_app()))
+        await client.start_server()
+        try:
+            # knob unset: the surface is disabled outright
+            monkeypatch.delenv("TPUSTACK_ADMIN_TOKEN", raising=False)
+            r = await client.post("/admin/drain")
+            assert r.status == 403
+            monkeypatch.setenv("TPUSTACK_ADMIN_TOKEN", "sekrit")
+            # wrong and missing tokens are both 403
+            r = await client.post("/admin/drain",
+                                  headers={"X-Admin-Token": "wrong"})
+            assert r.status == 403
+            r = await client.post("/admin/drain")
+            assert r.status == 403
+            assert not llm_server.resilience.draining
+        finally:
+            await client.close()
+    _run(scenario())
+
+
+def test_admin_drain_undrain_round_trip(llm_server, monkeypatch):
+    monkeypatch.setenv("TPUSTACK_ADMIN_TOKEN", "sekrit")
+    hdr = {"X-Admin-Token": "sekrit"}
+
+    async def scenario():
+        client = TestClient(TestServer(llm_server.build_app()))
+        await client.start_server()
+        try:
+            r = await client.get("/readyz")
+            assert r.status == 200
+            r = await client.post("/admin/drain", headers=hdr)
+            assert r.status == 200
+            body = await r.json()
+            assert body["draining"] and body["state"] == "draining"
+            assert body["changed"] is True
+            # idempotent second drain reports no change
+            r = await client.post("/admin/drain", headers=hdr)
+            assert (await r.json())["changed"] is False
+            # readiness flipped with the draining shed reason; liveness
+            # stays 200 (finishing in-flight work is not being dead)
+            r = await client.get("/readyz")
+            assert r.status == 503
+            assert r.headers["X-Shed-Reason"] == "draining"
+            assert "Retry-After" in r.headers
+            r = await client.get("/healthz")
+            assert r.status == 200
+            # admission sheds while admin-drained
+            r = await client.post("/completion",
+                                  json={"prompt": "x", "n_predict": 1})
+            assert r.status == 503
+            assert r.headers["X-Shed-Reason"] == "draining"
+            # undrain restores service
+            r = await client.post("/admin/drain", headers=hdr,
+                                  json={"undrain": True})
+            assert (await r.json())["changed"] is True
+            r = await client.get("/readyz")
+            assert r.status == 200
+            r = await client.post(
+                "/completion",
+                json={"prompt": "ok", "n_predict": 2, "temperature": 0})
+            assert r.status == 200
+        finally:
+            await client.close()
+    _run(scenario())
+
+
+def test_admin_drain_during_active_request_finishes(llm_server,
+                                                    monkeypatch):
+    """Work in flight when the drain lands keeps running to completion
+    (the drain only stops NEW admissions); the fault knob stretches the
+    dispatch so the drain reliably lands mid-request."""
+    import asyncio
+
+    monkeypatch.setenv("TPUSTACK_ADMIN_TOKEN", "sekrit")
+    monkeypatch.setenv("TPUSTACK_FAULT_SLOW_PREFILL_S", "0.3")
+    from tpustack.serving.llm_server import LLMServer
+
+    replica = LLMServer(generator=llm_server.gen, tokenizer=llm_server.tok,
+                        model_name="tiny-test", max_batch=2,
+                        registry=Registry())
+    hdr = {"X-Admin-Token": "sekrit"}
+
+    async def scenario():
+        client = TestClient(TestServer(replica.build_app()))
+        await client.start_server()
+        try:
+            task = asyncio.ensure_future(client.post(
+                "/completion",
+                json={"prompt": "finish me", "n_predict": 8,
+                      "temperature": 0}))
+            await asyncio.sleep(0.1)  # inside the slowed prefill window
+            r = await client.post("/admin/drain", headers=hdr)
+            assert r.status == 200
+            assert (await r.json())["inflight"] >= 1
+            resp = await task
+            assert resp.status == 200
+            assert (await resp.json())["content"]
+        finally:
+            await client.close()
+    _run(scenario())
+
+
+def test_router_ejects_admin_drained_backend_within_one_tick(llm_server,
+                                                             monkeypatch):
+    """The authoritative handoff: after /admin/drain the replica answers
+    its next active /readyz poll with 503/draining and the router ejects
+    it immediately (no flapping tolerance) — then re-admits after an
+    undrain once the half-open window elapses."""
+    import asyncio
+
+    monkeypatch.setenv("TPUSTACK_ADMIN_TOKEN", "sekrit")
+    hdr = {"X-Admin-Token": "sekrit"}
+
+    async def scenario():
+        backend = TestServer(llm_server.build_app())
+        await backend.start_server()
+        url = str(backend.make_url("/")).rstrip("/")
+        router = Router(url, registry=Registry(), env={
+            "TPUSTACK_ROUTER_HEALTH_INTERVAL_S": "0.1",
+            "TPUSTACK_ROUTER_HALF_OPEN_S": "0.2",
+            "TPUSTACK_ROUTER_EJECT_AFTER": "2",
+            "TPUSTACK_ROUTER_RETRY_JITTER_S": "0"})
+        direct = TestClient(backend)
+        await direct.start_server()
+        try:
+            deadline = time.monotonic() + 5
+            while router.healthy_backends() != [url] \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert router.healthy_backends() == [url]
+
+            r = await direct.post("/admin/drain", headers=hdr)
+            assert r.status == 200
+            deadline = time.monotonic() + 5  # >> one 0.1s health tick
+            while router.healthy_backends() and \
+                    time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert router.healthy_backends() == []
+
+            r = await direct.post("/admin/drain", headers=hdr,
+                                  json={"undrain": True})
+            assert r.status == 200
+            deadline = time.monotonic() + 10
+            while router.healthy_backends() != [url] \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert router.healthy_backends() == [url]
+        finally:
+            await direct.close()
+            router.close()
+            await backend.close()
+
+    _run(scenario())
+
+
+# ========================================================== the chaos bar
+def test_chaos_elasticity_fast_cli(tmp_path):
+    """Shell ``tools/chaos_elasticity.py --fast`` — the full elastic
+    loop: quiet -> surge -> quiet against a routed fleet with the REAL
+    autoscaler + local executor; growth in the surge, goodput >= 0.9 in
+    every phase, lossless choreographed scale-down, no flapping, zero
+    leaks/violations — enforced on every PR."""
+    out_path = tmp_path / "chaos-elasticity.json"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "chaos_elasticity.py"),
+         "--fast", "--out", str(out_path)],
+        capture_output=True, text=True, cwd=REPO, timeout=420)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    artifact = json.loads(out_path.read_text())
+    assert artifact["ok"] and artifact["problems"] == []
+    assert artifact["final_actual"] == artifact["min_replicas"]
+    ups = [e for e in artifact["events"] if e["direction"] == "up"]
+    downs = [e for e in artifact["events"] if e["direction"] == "down"]
+    assert ups and downs
+    assert all(e["drained"] and e["exit_code"] == 0 for e in downs)
+    for p in artifact["phases"]:
+        assert p["summary"]["errors"] == 0
+        for tenant, stats in p["summary"]["tenants"].items():
+            if stats.get("priority") == "interactive":
+                assert stats["goodput_ratio"] >= 0.9, (p["name"], tenant)

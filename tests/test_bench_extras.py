@@ -73,6 +73,11 @@ def test_llm_extras_schema(monkeypatch):
                        "requests": {"ok": 50},
                        "failovers": {"connect_error": 1},
                        "affinity": {"hit": 22, "hit_ratio": 0.85}},
+                   # elastic capacity controller view when the replay ran
+                   # with --autoscaler-url (desired/actual + events)
+                   "server_autoscaler": {
+                       "desired": 2, "actual": 2, "converged": True,
+                       "events": [{"direction": "up", "reason": "load"}]},
                    # provenance + exact-counter signature (PR 13): every
                    # tool artifact carries them and the driver keeps them
                    "meta": {"schema_version": 1, "git_sha": "cafe",
@@ -117,6 +122,9 @@ def test_llm_extras_schema(monkeypatch):
     # the router's health/failover/affinity view rides the replay cell
     assert out["replay"]["server_router"]["affinity"]["hit_ratio"] == 0.85
     assert out["replay"]["server_router"]["failovers"]["connect_error"] == 1
+    # ...and so does the capacity controller's convergence evidence
+    assert out["replay"]["server_autoscaler"]["converged"] is True
+    assert out["replay"]["server_autoscaler"]["events"][0]["reason"] == "load"
     # the host-tier ledger + off/on tables ride the host_tier cell, the
     # chunk tables ride chunked_prefill
     assert out["host_tier"]["host_tier"]["spilled_total"] == 23

@@ -634,3 +634,256 @@ def test_manifest_lint_catches_router_violations(tmp_path):
     svc["spec"]["ports"] = [{"port": 80, "targetPort": 8080}]
     (tmp_path / "svc.yaml").write_text(yaml.safe_dump(svc))
     assert lint(root=tmp_path) == []
+
+
+# ------------------------------------------------- elastic capacity (PR 19)
+def test_autoscaler_deployment_wired():
+    """The shipped elastic-capacity controller: least-privilege RBAC
+    (deployments/scale get+patch only, own namespace), pinned capacity
+    bounds, the managed-by annotation on its target, kustomization and
+    prober wiring."""
+    docs = _load_all(CLUSTER / "apps" / "llm" / "autoscaler-deployment.yaml")
+    kinds = {}
+    for d in docs:
+        kinds.setdefault(d["kind"], []).append(d)
+    role = kinds["Role"][0]
+    assert role["rules"] == [{"apiGroups": ["apps"],
+                              "resources": ["deployments/scale"],
+                              "verbs": ["get", "patch"]}]
+    binding = kinds["RoleBinding"][0]
+    assert binding["roleRef"]["kind"] == "Role"
+    assert binding["subjects"][0]["name"] == \
+        kinds["ServiceAccount"][0]["metadata"]["name"]
+
+    dep = kinds["Deployment"][0]
+    spec = dep["spec"]["template"]["spec"]
+    ctr = spec["containers"][0]
+    assert "tpustack.serving.autoscaler" in " ".join(ctr["command"])
+    assert spec["serviceAccountName"] == \
+        kinds["ServiceAccount"][0]["metadata"]["name"]
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert int(env["TPUSTACK_AUTOSCALER_MIN"]) >= 1
+    assert (int(env["TPUSTACK_AUTOSCALER_MAX"])
+            >= int(env["TPUSTACK_AUTOSCALER_MIN"]))
+    # scales its OWN namespace, and the target carries the marker
+    assert env["TPUSTACK_AUTOSCALER_K8S_NAMESPACE"] == \
+        dep["metadata"]["namespace"]
+    llm = next(d for d in _load_all(CLUSTER / "apps" / "llm"
+                                    / "deployment.yaml")
+               if d.get("kind") == "Deployment")
+    assert llm["metadata"]["name"] == env["TPUSTACK_AUTOSCALER_K8S_DEPLOYMENT"]
+    assert llm["metadata"]["annotations"][
+        "tpustack.dev/managed-by-autoscaler"] == "true"
+    # no TPU for the control loop; riding the flux fan-out; probed
+    assert "google.com/tpu" not in yaml.safe_dump(dep)
+    kust = _load_all(CLUSTER / "apps" / "llm" / "kustomization.yaml")[0]
+    assert "autoscaler-deployment.yaml" in kust["resources"]
+    prober = _load_all(CLUSTER / "jobs" / "prober-cronjob.yaml")[0]
+    cmd = " ".join(prober["spec"]["jobTemplate"]["spec"]["template"]["spec"]
+                   ["containers"][0]["command"])
+    assert "--autoscaler=http://coder-llm-autoscaler" in cmd
+
+
+def _autoscaler_fixture(tmp_path, yaml_mod):
+    """A minimal CLEAN autoscaler config in tmp_path; tests permute it."""
+    def container(name, module, env):
+        return {
+            "name": name,
+            "command": ["python", "-m", module],
+            "env": [{"name": k, "value": v} for k, v in env.items()],
+            "resources": {"requests": {"cpu": 1, "memory": "1Gi"},
+                          "limits": {"cpu": 1, "memory": "1Gi"}},
+            "readinessProbe": {"httpGet": {"path": "/readyz"}},
+            "livenessProbe": {"httpGet": {"path": "/healthz"}},
+        }
+
+    llm = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "llm", "namespace": "x",
+                     "annotations":
+                     {"tpustack.dev/managed-by-autoscaler": "true"}},
+        "spec": {"replicas": 1, "template": {
+            "metadata": {"labels": {"app": "llm"}},
+            "spec": {"terminationGracePeriodSeconds": 45,
+                     "containers": [container(
+                         "srv", "tpustack.serving.llm_server", {})]},
+        }}}
+    scaler = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "scaler", "namespace": "x"},
+        "spec": {"template": {
+            "metadata": {"labels": {"app": "scaler"}},
+            "spec": {"terminationGracePeriodSeconds": 30,
+                     "serviceAccountName": "scaler",
+                     "containers": [container(
+                         "ctl", "tpustack.serving.autoscaler", {
+                             "TPUSTACK_AUTOSCALER_MIN": "1",
+                             "TPUSTACK_AUTOSCALER_MAX": "4",
+                             "TPUSTACK_AUTOSCALER_K8S_DEPLOYMENT": "llm",
+                             "TPUSTACK_AUTOSCALER_K8S_NAMESPACE": "x",
+                         })]},
+        }}}
+    role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+        "metadata": {"name": "scaler", "namespace": "x"},
+        "rules": [{"apiGroups": ["apps"],
+                   "resources": ["deployments/scale"],
+                   "verbs": ["get", "patch"]}],
+    }
+    binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+        "metadata": {"name": "scaler", "namespace": "x"},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "Role", "name": "scaler"},
+        "subjects": [{"kind": "ServiceAccount", "name": "scaler",
+                      "namespace": "x"}],
+    }
+
+    def write(**overrides):
+        docs = {"llm": llm, "scaler": scaler, "role": role,
+                "binding": binding}
+        docs.update(overrides)
+        for fname, doc in docs.items():
+            p = tmp_path / f"{fname}.yaml"
+            if doc is None:
+                if p.exists():
+                    p.unlink()
+            else:
+                p.write_text(yaml_mod.safe_dump(doc))
+    return llm, scaler, role, binding, write
+
+
+def test_manifest_lint_catches_autoscaler_violations(tmp_path):
+    """TPL601 elastic-capacity rules, fire and clean: RBAC must grant
+    deployments/scale get+patch and nothing else, bounds pinned with
+    MIN >= 1, own-namespace targeting, annotated target."""
+    import copy
+
+    lint = _import_lint_manifests().lint
+    llm, scaler, role, binding, write = _autoscaler_fixture(tmp_path, yaml)
+
+    write()
+    assert lint(root=tmp_path) == []  # the clean baseline
+
+    def env_of(doc):
+        return doc["spec"]["template"]["spec"]["containers"][0]["env"]
+
+    # MIN=0: scale-to-zero floor
+    s = copy.deepcopy(scaler)
+    env_of(s)[0]["value"] = "0"
+    write(scaler=s)
+    assert "scale-to-zero retires the entire fleet" in \
+        "\n".join(lint(root=tmp_path))
+
+    # bounds not pinned at all
+    s = copy.deepcopy(scaler)
+    env_of(s)[:] = env_of(s)[2:]
+    write(scaler=s)
+    assert "must pin TPUSTACK_AUTOSCALER_MIN" in "\n".join(lint(root=tmp_path))
+
+    # cross-namespace targeting
+    s = copy.deepcopy(scaler)
+    env_of(s)[3]["value"] = "other"
+    write(scaler=s)
+    out = "\n".join(lint(root=tmp_path))
+    assert "cross-namespace scaling" in out
+
+    # Role grants more than deployments/scale get+patch
+    r = copy.deepcopy(role)
+    r["rules"][0]["verbs"] = ["get", "patch", "update"]
+    write(role=r)
+    assert "blast radius must stay at fleet size" in \
+        "\n".join(lint(root=tmp_path))
+    r = copy.deepcopy(role)
+    r["rules"][0]["resources"] = ["deployments/scale", "secrets"]
+    write(role=r)
+    assert "blast radius must stay at fleet size" in \
+        "\n".join(lint(root=tmp_path))
+
+    # Role grants too little (patch without get): can't execute
+    r = copy.deepcopy(role)
+    r["rules"][0]["verbs"] = ["patch"]
+    write(role=r)
+    assert "could never execute a decision" in "\n".join(lint(root=tmp_path))
+
+    # no RoleBinding at all → the PATCH would 403
+    write(binding=None)
+    assert "would 403" in "\n".join(lint(root=tmp_path))
+
+    # ClusterRole-shaped grant is over-broad by construction
+    b = copy.deepcopy(binding)
+    b["roleRef"]["kind"] = "ClusterRole"
+    write(binding=b)
+    assert "cluster-scoped grants" in "\n".join(lint(root=tmp_path))
+
+    # default ServiceAccount
+    s = copy.deepcopy(scaler)
+    del s["spec"]["template"]["spec"]["serviceAccountName"]
+    write(scaler=s)
+    assert "default ServiceAccount" in "\n".join(lint(root=tmp_path))
+
+    # target Deployment missing / missing the managed-by marker
+    write(llm=None)
+    assert "no manifest defines" in "\n".join(lint(root=tmp_path))
+    d = copy.deepcopy(llm)
+    del d["metadata"]["annotations"]
+    write(llm=d)
+    assert "must carry" in "\n".join(lint(root=tmp_path))
+
+
+def test_manifest_lint_catches_replicas_pins(tmp_path):
+    """A kustomize patch (or replicas transformer) pinning replicas on an
+    autoscaler-managed Deployment makes kustomize and the controller
+    fight — fire on every patch flavour, stay clean on benign patches."""
+    lint = _import_lint_manifests().lint
+    _, _, _, _, write = _autoscaler_fixture(tmp_path, yaml)
+    write()
+
+    kust = {
+        "apiVersion": "kustomize.config.k8s.io/v1beta1",
+        "kind": "Kustomization",
+        "resources": ["llm.yaml", "scaler.yaml", "role.yaml",
+                      "binding.yaml"],
+    }
+
+    def kustomize(extra):
+        doc = dict(kust, **extra)
+        (tmp_path / "kustomization.yaml").write_text(yaml.safe_dump(doc))
+
+    # benign patch: no replicas touched
+    kustomize({"patches": [{"patch": yaml.safe_dump(
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "llm",
+                      "annotations": {"x": "y"}}})}]})
+    assert lint(root=tmp_path) == []
+
+    # strategic-merge inline patch pinning replicas
+    kustomize({"patches": [{"patch": yaml.safe_dump(
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "llm"}, "spec": {"replicas": 5}})}]})
+    assert "fight over the fleet" in "\n".join(lint(root=tmp_path))
+
+    # JSON6902 op list with a target
+    kustomize({"patches": [{
+        "target": {"kind": "Deployment", "name": "llm"},
+        "patch": yaml.safe_dump(
+            [{"op": "replace", "path": "/spec/replicas", "value": 5}]),
+    }]})
+    assert "fight over the fleet" in "\n".join(lint(root=tmp_path))
+
+    # file-based patchesStrategicMerge (a partial-Deployment overlay is
+    # not a standalone manifest — .yml keeps it out of the doc walk,
+    # exactly how kustomize users keep overlays from double-applying)
+    (tmp_path / "pin.yml").write_text(yaml.safe_dump(
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "llm"}, "spec": {"replicas": 5}}))
+    kustomize({"patchesStrategicMerge": ["pin.yml"]})
+    assert "fight over the fleet" in "\n".join(lint(root=tmp_path))
+
+    # the replicas transformer
+    kustomize({"replicas": [{"name": "llm", "count": 5}]})
+    assert "replicas transformer pins" in "\n".join(lint(root=tmp_path))
+
+    # pinning some OTHER deployment is fine
+    kustomize({"replicas": [{"name": "unmanaged", "count": 5}]})
+    assert lint(root=tmp_path) == []

@@ -1,0 +1,284 @@
+"""Registry-churn hardening (satellite of the elastic capacity PR): the
+router's membership mechanisms — the ``@file`` registry the autoscaler's
+local executor rewrites, and ``dns://`` headless-service resolution —
+under rapid add/remove/replace while traffic is in flight.
+
+What must hold, and is asserted here:
+
+- an in-flight stream SURVIVES its backend being removed from the
+  registry (membership governs new routing only; the held upstream
+  connection finishes),
+- no stale-backend routing: the instant a rewrite is applied, new
+  requests land only inside the new set (``X-Router-Backend`` proves
+  placement),
+- removed backends' per-backend metric label series are dropped from
+  the scrape, not left as immortal zeros,
+- ``dns://`` churn (pod IPs replaced on restart) reconciles the same
+  way, preserves circuit state for survivors, and a resolver outage
+  keeps the current set instead of flushing the fleet.
+
+The kind-based on-cluster version of this drill is documented in
+docs/RESILIENCE.md ("Registry churn on a real cluster").
+"""
+
+import asyncio
+import os
+import socket
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpustack.obs import Registry
+from tpustack.serving.router import Router
+
+#: health thread parked (tests drive reconciliation directly), no jitter
+_QUIET = {
+    "TPUSTACK_ROUTER_HEALTH_INTERVAL_S": "30",
+    "TPUSTACK_ROUTER_EJECT_AFTER": "2",
+    "TPUSTACK_ROUTER_HALF_OPEN_S": "60",
+    "TPUSTACK_ROUTER_RETRY_BUDGET": "2",
+    "TPUSTACK_ROUTER_RETRY_JITTER_S": "0",
+    "TPUSTACK_ROUTER_AFFINITY_CHUNK": "8",
+    "TPUSTACK_ROUTER_UPSTREAM_TIMEOUT_S": "10",
+}
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class OkReplica:
+    """Always-200 /completion stub that records how often it served."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def build_app(self):
+        async def completion(request):
+            self.calls += 1
+            await request.read()
+            return web.json_response({"content": "served"})
+
+        async def readyz(request):
+            return web.json_response({"ready": True})
+
+        app = web.Application()
+        app.router.add_post("/completion", completion)
+        app.router.add_get("/readyz", readyz)
+        return app
+
+
+class GatedStreamReplica:
+    """Streams the first chunk, then parks mid-stream until released —
+    the churn window the removal tests need to land inside."""
+
+    def __init__(self, chunks):
+        self.chunks = chunks
+        self.started = asyncio.Event()
+        self.release = asyncio.Event()
+
+    def build_app(self):
+        async def completion(request):
+            await request.read()
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            await resp.write(self.chunks[0])
+            self.started.set()
+            await self.release.wait()
+            for c in self.chunks[1:]:
+                await resp.write(c)
+            await resp.write_eof()
+            return resp
+
+        async def readyz(request):
+            return web.json_response({"ready": True})
+
+        app = web.Application()
+        app.router.add_post("/completion", completion)
+        app.router.add_get("/readyz", readyz)
+        return app
+
+
+def _rewrite(path, urls):
+    os.utime(path, (0, 0))  # force an mtime change even same-second
+    path.write_text("\n".join(urls) + ("\n" if urls else ""))
+
+
+def test_inflight_stream_survives_backend_removal(tmp_path):
+    """The exact scale-down race: the autoscaler pulls a victim out of
+    the ``@file`` registry while it is mid-stream.  Membership governs
+    NEW placement only — the held connection finishes byte-perfect."""
+
+    async def scenario():
+        chunks = [b"data: tok1\n\n", b"data: tok2\n\n", b"data: [DONE]\n\n"]
+        stream_stub = GatedStreamReplica(chunks)
+        ok_stub = OkReplica()
+        stream_srv = TestServer(stream_stub.build_app())
+        ok_srv = TestServer(ok_stub.build_app())
+        await stream_srv.start_server()
+        await ok_srv.start_server()
+        victim = str(stream_srv.make_url("/")).rstrip("/")
+        survivor = str(ok_srv.make_url("/")).rstrip("/")
+
+        path = tmp_path / "backends"
+        path.write_text(victim + "\n")
+        reg = Registry()
+        router = Router(f"@{path}", registry=reg, env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            task = asyncio.ensure_future(client.post(
+                "/completion",
+                json={"prompt": "s" * 64, "n_predict": 3, "stream": True}))
+            await asyncio.wait_for(stream_stub.started.wait(), timeout=10)
+
+            # the churn lands mid-stream: victim out, survivor in
+            _rewrite(path, [survivor])
+            router._apply_registry(router._resolve_spec())
+            assert router.backends() == [survivor]
+            # removed backend's label series left the scrape immediately
+            text = reg.render()
+            assert f'backend="{victim}"' not in text
+            assert f'backend="{survivor}"' in text
+
+            # a NEW request cannot land on the removed backend
+            r2 = await client.post("/completion",
+                                   json={"prompt": "after-churn",
+                                         "n_predict": 1})
+            assert r2.status == 200
+            assert r2.headers["X-Router-Backend"] == survivor
+            assert ok_stub.calls == 1
+
+            # ...while the in-flight stream still finishes intact
+            stream_stub.release.set()
+            resp = await asyncio.wait_for(task, timeout=10)
+            assert resp.status == 200
+            assert resp.headers["X-Router-Backend"] == victim
+            assert await resp.read() == b"".join(chunks)
+        finally:
+            await client.close()
+            await stream_srv.close()
+            await ok_srv.close()
+            router.close()
+
+    _run(scenario())
+
+
+def test_rapid_file_churn_under_load_never_routes_stale(tmp_path):
+    """Rapid add/remove/replace cycles against the ``@file`` registry
+    with a request after every rewrite: placement always lands inside
+    the JUST-applied set, every request succeeds (some member is always
+    live), and after the dust settles only the final set's label series
+    remain."""
+
+    async def scenario():
+        stubs = [OkReplica(), OkReplica()]
+        servers = [TestServer(s.build_app()) for s in stubs]
+        for s in servers:
+            await s.start_server()
+        urls = [str(s.make_url("/")).rstrip("/") for s in servers]
+
+        path = tmp_path / "backends"
+        path.write_text("\n".join(urls) + "\n")
+        reg = Registry()
+        router = Router(f"@{path}", registry=reg, env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            for i in range(24):
+                # thrash: both -> only A -> only B -> both -> ...
+                keep = urls if i % 3 == 0 else [urls[i % 2]]
+                _rewrite(path, keep)
+                router._apply_registry(router._resolve_spec())
+                assert set(router.backends()) == set(keep)
+                r = await client.post(
+                    "/completion",
+                    json={"prompt": f"churn-{i}" * 4, "n_predict": 1})
+                assert r.status == 200, i
+                # the placement proof: never a backend outside the set
+                assert r.headers["X-Router-Backend"] in keep, i
+                await r.release()
+            assert stubs[0].calls + stubs[1].calls == 24
+
+            # settle on just one backend: the other's series are gone
+            _rewrite(path, [urls[1]])
+            router._apply_registry(router._resolve_spec())
+            text = reg.render()
+            assert f'backend="{urls[0]}"' not in text
+            assert f'backend="{urls[1]}"' in text
+        finally:
+            await client.close()
+            for s in servers:
+                await s.close()
+            router.close()
+
+    _run(scenario())
+
+
+def test_replace_cycle_readmits_with_fresh_circuit_state(tmp_path):
+    """Remove-then-re-add (a replica retired and respawned on the same
+    port) must come back as a FRESH backend: no inherited ejection
+    count, no open circuit from its previous life."""
+    a, b = "http://127.0.0.1:7101", "http://127.0.0.1:7102"
+    path = tmp_path / "backends"
+    path.write_text(f"{a}\n{b}\n")
+    reg = Registry()
+    r = Router(f"@{path}", registry=reg, env=_QUIET)
+    try:
+        r._apply_probe(a, "unready")  # circuit open, ejections=1
+        assert r.healthy_backends() == [b]
+        _rewrite(path, [b])
+        r._apply_registry(r._resolve_spec())
+        assert r.backends() == [b]
+        _rewrite(path, [a, b])  # the respawn
+        r._apply_registry(r._resolve_spec())
+        assert set(r.healthy_backends()) == {a, b}
+        with r._lock:
+            assert r._backends[a]["ejections"] == 0
+            assert r._backends[a]["fails"] == 0
+    finally:
+        r.close()
+
+
+def test_dns_churn_reconciles_preserves_state_and_drops_series(monkeypatch):
+    """``dns://`` membership: pod restarts mint fresh IPs.  Survivors
+    keep circuit state, replaced IPs drop their series, and a resolver
+    outage keeps the current set instead of flushing the fleet."""
+    resolver = {"ips": ["10.0.0.1", "10.0.0.2"], "fail": False}
+
+    def fake_getaddrinfo(host, port, *args, **kwargs):
+        assert host == "llm-headless.llm.svc"
+        if resolver["fail"]:
+            raise OSError("resolver down")
+        return [(socket.AF_INET, socket.SOCK_STREAM, 6, "", (ip, port))
+                for ip in resolver["ips"]]
+
+    monkeypatch.setattr("tpustack.serving.router.socket.getaddrinfo",
+                        fake_getaddrinfo)
+    u1, u2, u3 = (f"http://10.0.0.{i}:8080" for i in (1, 2, 3))
+    reg = Registry()
+    r = Router("dns://llm-headless.llm.svc:8080", registry=reg, env=_QUIET)
+    try:
+        assert r.backends() == [u1, u2]
+        # u2 accumulates circuit state that must survive the churn
+        r._apply_probe(u2, "down")
+        with r._lock:
+            assert r._backends[u2]["fails"] == 1
+
+        resolver["ips"] = ["10.0.0.2", "10.0.0.3"]  # .1 restarted as .3
+        r._apply_registry(r._resolve_spec())
+        assert set(r.backends()) == {u2, u3}
+        assert set(r.healthy_backends()) == {u2, u3}
+        with r._lock:
+            assert r._backends[u2]["fails"] == 1  # survivor state kept
+        text = reg.render()
+        assert f'backend="{u1}"' not in text
+        assert f'backend="{u3}"' in text
+
+        # resolver outage: keep serving the set we have
+        resolver["fail"] = True
+        r._apply_registry(r._resolve_spec())
+        assert set(r.backends()) == {u2, u3}
+    finally:
+        r.close()

@@ -288,6 +288,60 @@ def test_probe_router_target():
     assert out2["targets"]["router"]["debug_router"]["ok"] is False
 
 
+def test_probe_autoscaler_target():
+    """The autoscaler kind: health/ready plus the /debug/autoscaler
+    consistency check — a payload claiming ``converged`` must have
+    desired == actual; there is no inference surface."""
+    probe = _tool("probe")
+    from tpustack.obs import Registry
+    from tpustack.obs import catalog
+
+    reg = Registry()
+    fetch = _fake_fetch({
+        ("GET", "/healthz"): (200, b"{}"),
+        ("GET", "/readyz"): (200, b"{}"),
+        ("GET", "/debug/autoscaler"): (200, json.dumps(
+            {"desired": 2, "actual": 2, "converged": True}).encode()),
+    })
+    out = probe.run_round({"autoscaler": "http://scaler"},
+                          metrics=catalog.build(reg), fetch=fetch, timeout=5)
+    assert out["up"] == {"autoscaler": True}
+    checks = out["targets"]["autoscaler"]
+    assert checks["debug_autoscaler"]["ok"]
+    assert "inference" not in checks
+    assert reg.get_sample_value("tpustack_probe_up_state",
+                                {"target": "autoscaler"}) == 1
+    assert reg.get_sample_value(
+        "tpustack_probe_attempts_total",
+        {"target": "autoscaler", "check": "debug_autoscaler",
+         "outcome": "ok"}) == 1
+
+    # a payload claiming convergence while desired != actual is a lie
+    # the probe must catch (the controller's own bookkeeping is broken)
+    fetch2 = _fake_fetch({
+        ("GET", "/healthz"): (200, b"{}"),
+        ("GET", "/readyz"): (200, b"{}"),
+        ("GET", "/debug/autoscaler"): (200, json.dumps(
+            {"desired": 3, "actual": 2, "converged": True}).encode()),
+    })
+    out2 = probe.run_round({"autoscaler": "http://scaler"}, fetch=fetch2,
+                           timeout=5)
+    assert out2["up"] == {"autoscaler": False}
+    assert "desired 3 != actual 2" in \
+        out2["targets"]["autoscaler"]["debug_autoscaler"]["error"]
+
+    # a dead control loop (readyz 503) is down even with a sane payload
+    fetch3 = _fake_fetch({
+        ("GET", "/healthz"): (200, b"{}"),
+        ("GET", "/readyz"): (503, b"{}"),
+        ("GET", "/debug/autoscaler"): (200, json.dumps(
+            {"desired": 2, "actual": 2, "converged": True}).encode()),
+    })
+    out3 = probe.run_round({"autoscaler": "http://scaler"}, fetch=fetch3,
+                           timeout=5)
+    assert out3["up"] == {"autoscaler": False}
+
+
 def test_probe_failure_modes():
     probe = _tool("probe")
     from tpustack.obs import Registry

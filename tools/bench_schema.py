@@ -63,6 +63,10 @@ LLM_EXTRA_KEEP = (
     # backend health/circuit states, failover + affinity counters — the
     # scale-out evidence chaos_serving's goodput bar is judged with
     "server_router",
+    # elastic capacity controller view when --autoscaler-url was given:
+    # desired/actual, policy decisions and scale events recorded while
+    # the replay's load was offered
+    "server_autoscaler",
     # provenance + the machine-exact perf signature (tpustack.obs.perfsig)
     # ride each cell into the driver artifact: BENCH_r*.json rounds carry
     # the exact counters the perf gate ratchets on, per measurement

@@ -18,6 +18,9 @@ Checks per target kind:
 - ``graph`` — GET /healthz, GET /readyz, POST /prompt with a
   CLIPTextEncode-only graph, polled to success via /history — a full
   submit→worker→publish round trip with no device work.
+- ``autoscaler`` — GET /healthz, GET /readyz (503 = control loop dead),
+  GET /debug/autoscaler with a consistency check: a payload claiming
+  ``converged`` must have ``desired == actual``.
 
 Inference probes send a W3C ``traceparent`` (the tracing layer's client
 contract), so a failing probe's trace id — printed in the JSON line — can
@@ -118,6 +121,24 @@ def _validate_png(payload: bytes) -> Optional[str]:
     return None if payload[:8] == b"\x89PNG\r\n\x1a\n" else "not a PNG"
 
 
+def _validate_autoscaler(payload: bytes) -> Optional[str]:
+    """The convergence contract: a debug payload claiming ``converged``
+    must have desired == actual — anything else means the controller's
+    own bookkeeping is lying to operators."""
+    try:
+        body = json.loads(payload.decode())
+    except ValueError:
+        return "response is not JSON"
+    missing = [k for k in ("desired", "actual", "converged")
+               if k not in body]
+    if missing:
+        return f"response missing {missing}"
+    if body["converged"] and body["desired"] != body["actual"]:
+        return (f"converged but desired {body['desired']} != "
+                f"actual {body['actual']}")
+    return None
+
+
 def _probe_graph_inference(fetch: Fetch, base: str, headers,
                            timeout: float) -> Dict[str, object]:
     """submit → poll /history to completion: a full accept→worker→publish
@@ -162,6 +183,13 @@ def probe_target(kind: str, base: str, fetch: Fetch = _urllib_fetch,
         "healthz": _http_check(fetch, "GET", base + "/healthz", timeout=10),
         "readyz": _http_check(fetch, "GET", base + "/readyz", timeout=10),
     }
+    if kind == "autoscaler":
+        # no inference surface: the debug payload IS the probe (cheap,
+        # no device work, so it runs even under --no-inference)
+        checks["debug_autoscaler"] = _http_check(
+            fetch, "GET", base + "/debug/autoscaler", timeout=10,
+            validate=_validate_autoscaler)
+        return checks
     if not inference:
         return checks
     header, tid = make_traceparent()
@@ -238,6 +266,8 @@ def main(argv: List[str] = None) -> int:
     p.add_argument("--graph", help="graph server base URL")
     p.add_argument("--router", help="L7 router base URL (the scale-out "
                                     "gateway fronting the llm replicas)")
+    p.add_argument("--autoscaler", help="elastic capacity controller base "
+                                        "URL (debug surface consistency)")
     p.add_argument("--count", type=int, default=1,
                    help="probe rounds to run (default 1; the CronJob runs "
                         "several per invocation so the sidecar is "
@@ -252,10 +282,11 @@ def main(argv: List[str] = None) -> int:
 
     targets = {k: v for k, v in
                (("llm", args.llm), ("sd", args.sd), ("graph", args.graph),
-                ("router", args.router))
+                ("router", args.router), ("autoscaler", args.autoscaler))
                if v}
     if not targets:
-        p.error("give at least one of --llm/--sd/--graph/--router")
+        p.error("give at least one of "
+                "--llm/--sd/--graph/--router/--autoscaler")
 
     # metrics through the shared catalog + the stdlib sidecar — the same
     # exposition path every batch/train Job uses (TPUSTACK_METRICS_PORT)

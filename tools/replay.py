@@ -431,6 +431,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--url", default=None,
                    help=f"target server (default: TPUSTACK_REPLAY_URL or "
                         f"{DEFAULT_URL})")
+    p.add_argument("--autoscaler-url", default="",
+                   help="elastic capacity controller base URL; its "
+                        "/debug/autoscaler snapshot (desired/actual, "
+                        "decisions, scale events) is embedded in the "
+                        "artifact as server_autoscaler")
     p.add_argument("--tenants", default="interactive:4,batch:1",
                    help="per-tenant offered load: name:rps[:priority]"
                         "[,...] — the optional priority (interactive|"
@@ -597,6 +602,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                 artifact["server_router"] = json.loads(r.read().decode())
         except Exception:
             log("no /debug/router on target (driving a backend directly)")
+        if args.autoscaler_url:
+            # the elastic run's control-plane evidence: what the capacity
+            # controller saw and did while this load was offered
+            try:
+                with urllib.request.urlopen(
+                        args.autoscaler_url.rstrip("/") +
+                        "/debug/autoscaler", timeout=5) as r:
+                    artifact["server_autoscaler"] = json.loads(
+                        r.read().decode())
+            except Exception as exc:
+                log(f"autoscaler snapshot failed: {exc}")
         if host is not None:
             # the server-side ledger view of the same run — what the
             # conservation tests cross-check the client artifact against

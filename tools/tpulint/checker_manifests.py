@@ -368,6 +368,12 @@ def _is_router(container) -> bool:
     return any("tpustack.serving.router" in a for a in argv)
 
 
+def _is_autoscaler(container) -> bool:
+    argv = [str(a) for a in ((container.get("command") or [])
+                             + (container.get("args") or []))]
+    return any("tpustack.serving.autoscaler" in a for a in argv)
+
+
 def _is_llm_server(container) -> bool:
     argv = [str(a) for a in ((container.get("command") or [])
                              + (container.get("args") or []))]
@@ -437,12 +443,218 @@ def _check_router_contract(errors: List[str], routers, services,
                 "the prefix-affinity router (router-deployment.yaml)")
 
 
+#: the marker an autoscaler-managed Deployment must carry (and the one
+#: the kustomize replicas-pinning rule keys on)
+AUTOSCALER_ANNOTATION = "tpustack.dev/managed-by-autoscaler"
+
+#: the ONLY RBAC grant the capacity controller may hold: read + patch the
+#: scale subresource.  Anything broader turns a compromised autoscaler
+#: pod from "can resize one fleet" into "can rewrite pod specs / read
+#: secrets" — the blast radius must stay at fleet size.
+_SCALE_RESOURCE = "deployments/scale"
+_SCALE_GROUPS = {"apps"}
+_SCALE_VERBS = {"get", "patch"}
+
+
+def _check_autoscaler_contract(errors: List[str], autoscalers, roles,
+                               bindings, deployments, kustomizations) -> None:
+    """The elastic-capacity controller's deployment contract:
+
+    - the capacity bounds are an operator contract, pinned in the
+      manifest: TPUSTACK_AUTOSCALER_MIN / _MAX env present, MIN >= 1
+      (scale-to-zero would retire the whole fleet) and MIN <= MAX;
+    - it scales only its OWN namespace (the Role grant is
+      namespace-scoped; cross-namespace scaling would need cluster-wide
+      RBAC this config refuses to mint);
+    - it runs under a dedicated ServiceAccount whose RoleBindings grant
+      deployments/scale get+patch — and NOTHING else, on any bound Role;
+    - the Deployment it targets exists and carries the
+      ``tpustack.dev/managed-by-autoscaler: "true"`` annotation;
+    - no kustomization pins ``replicas`` on an annotated Deployment
+      (via the replicas transformer or a patch): a pinned count and the
+      controller would fight forever, flapping the fleet every
+      reconcile.
+    """
+    role_by_key = {(r["namespace"], r["name"]): r for r in roles}
+    for a in autoscalers:
+        where, container, ns = a["where"], a["container"], a["namespace"]
+        lo = _env_value(container, "TPUSTACK_AUTOSCALER_MIN")
+        hi = _env_value(container, "TPUSTACK_AUTOSCALER_MAX")
+        if lo is None or hi is None:
+            errors.append(
+                f"{where}: autoscaler container must pin "
+                "TPUSTACK_AUTOSCALER_MIN and TPUSTACK_AUTOSCALER_MAX in "
+                "the manifest — capacity bounds are an operator contract, "
+                "not a code default")
+        else:
+            try:
+                lo_n, hi_n = int(lo), int(hi)
+            except (TypeError, ValueError):
+                errors.append(f"{where}: TPUSTACK_AUTOSCALER_MIN/MAX "
+                              f"({lo!r}/{hi!r}) must be integers")
+            else:
+                if lo_n < 1:
+                    errors.append(
+                        f"{where}: TPUSTACK_AUTOSCALER_MIN={lo_n} — the "
+                        "floor must be >= 1: scale-to-zero retires the "
+                        "entire fleet and the service with it")
+                if lo_n > hi_n:
+                    errors.append(f"{where}: TPUSTACK_AUTOSCALER_MIN="
+                                  f"{lo_n} > MAX={hi_n}")
+        target_ns = _env_value(container, "TPUSTACK_AUTOSCALER_K8S_NAMESPACE")
+        if target_ns and ns and target_ns != ns:
+            errors.append(
+                f"{where}: autoscaler targets namespace {target_ns!r} from "
+                f"namespace {ns!r} — the scale grant is namespace-scoped; "
+                "cross-namespace scaling needs cluster-wide RBAC this "
+                "config forbids")
+        sa = a["serviceAccountName"]
+        if not sa:
+            errors.append(
+                f"{where}: autoscaler pod runs under the default "
+                "ServiceAccount — it needs a dedicated SA bound to a "
+                f"{_SCALE_RESOURCE}-only Role")
+        else:
+            bound = []
+            for b in bindings:
+                if b["namespace"] != ns:
+                    continue
+                if not any(s.get("kind") == "ServiceAccount"
+                           and s.get("name") == sa
+                           and s.get("namespace", ns) == ns
+                           for s in b["subjects"]):
+                    continue
+                ref = b["roleRef"]
+                if ref.get("kind") == "Role":
+                    role = role_by_key.get((ns, ref.get("name")))
+                    if role is not None:
+                        bound.append(role)
+                else:
+                    errors.append(
+                        f"{b['where']}: autoscaler ServiceAccount {sa!r} "
+                        f"bound to a {ref.get('kind')} — cluster-scoped "
+                        "grants exceed the fleet-sized blast radius")
+            if not bound:
+                errors.append(
+                    f"{where}: no RoleBinding in namespace {ns!r} grants "
+                    f"ServiceAccount {sa!r} a Role — the scale PATCH "
+                    "would 403 and the fleet would never move")
+            else:
+                can_scale = False
+                for role in bound:
+                    for rule in role["rules"]:
+                        resources = set(rule.get("resources") or [])
+                        verbs = set(rule.get("verbs") or [])
+                        groups = set(rule.get("apiGroups") or [])
+                        if (resources <= {_SCALE_RESOURCE}
+                                and verbs <= _SCALE_VERBS
+                                and groups <= _SCALE_GROUPS):
+                            if (_SCALE_RESOURCE in resources
+                                    and _SCALE_VERBS <= verbs):
+                                can_scale = True
+                            continue
+                        errors.append(
+                            f"{role['where']}: autoscaler Role grants "
+                            f"{sorted(groups)}:{sorted(resources)} verbs "
+                            f"{sorted(verbs)} — beyond {_SCALE_RESOURCE} "
+                            f"{sorted(_SCALE_VERBS)}; the controller's "
+                            "blast radius must stay at fleet size")
+                if not can_scale:
+                    errors.append(
+                        f"{where}: ServiceAccount {sa!r} has no Role rule "
+                        f"granting {_SCALE_RESOURCE} get+patch — the "
+                        "controller could never execute a decision")
+        target = _env_value(container, "TPUSTACK_AUTOSCALER_K8S_DEPLOYMENT")
+        if target:
+            match = [d for d in deployments if d.get("name") == target
+                     and (not target_ns or d.get("namespace") == target_ns)]
+            if not match:
+                errors.append(
+                    f"{where}: autoscaler targets Deployment {target!r}, "
+                    "which no manifest defines")
+            elif not any(d["annotations"].get(AUTOSCALER_ANNOTATION)
+                         == "true" for d in match):
+                errors.append(
+                    f"{where}: target Deployment {target!r} must carry "
+                    f'the {AUTOSCALER_ANNOTATION}: "true" annotation — '
+                    "the marker the replicas-pinning rule keys on")
+    managed = {d["name"] for d in deployments
+               if d.get("annotations", {}).get(AUTOSCALER_ANNOTATION)
+               == "true"}
+    if managed:
+        _check_replicas_pins(errors, managed, kustomizations)
+
+
+def _patch_pins_replicas(patch, managed: Set[str],
+                         target_name: Optional[str]) -> Optional[str]:
+    """Return the managed Deployment name a kustomize patch pins
+    ``replicas`` on, if any (strategic-merge dict or JSON6902 op list)."""
+    if isinstance(patch, dict):
+        name = ((patch.get("metadata") or {}).get("name")) or target_name
+        if name in managed and "replicas" in (patch.get("spec") or {}):
+            return name
+    elif isinstance(patch, list):  # JSON6902 ops
+        for op in patch:
+            if (isinstance(op, dict)
+                    and str(op.get("path", "")).startswith("/spec/replicas")
+                    and target_name in managed):
+                return target_name
+    return None
+
+
+def _check_replicas_pins(errors: List[str], managed: Set[str],
+                         kustomizations) -> None:
+    for rel, directory, doc in kustomizations:
+        for entry in doc.get("replicas") or []:
+            if (entry or {}).get("name") in managed:
+                errors.append(
+                    f"{rel}: replicas transformer pins count={entry.get('count')} "
+                    f"on autoscaler-managed Deployment "
+                    f"{entry.get('name')!r} — kustomize and the "
+                    "controller would fight over the fleet every "
+                    "reconcile")
+        patch_entries = list(doc.get("patches") or [])
+        patch_entries += [{"patch": p} if isinstance(p, str) else p
+                          for p in doc.get("patchesStrategicMerge") or []]
+        for entry in patch_entries:
+            if not isinstance(entry, dict):
+                continue
+            target_name = (entry.get("target") or {}).get("name")
+            raw = entry.get("patch")
+            path = entry.get("path")
+            if raw is not None and "\n" not in str(raw) \
+                    and not str(raw).lstrip().startswith(("{", "[")):
+                # patchesStrategicMerge shorthand: a bare filename
+                path, raw = str(raw), None
+            docs = []
+            if raw is not None:
+                try:
+                    docs = [d for d in yaml.safe_load_all(str(raw)) if d]
+                except yaml.YAMLError:
+                    continue  # the YAML-parse rule reports it
+            elif path:
+                try:
+                    with open(directory / path) as f:
+                        docs = [d for d in yaml.safe_load_all(f) if d]
+                except (OSError, yaml.YAMLError):
+                    continue
+            for patch in docs:
+                name = _patch_pins_replicas(patch, managed, target_name)
+                if name:
+                    errors.append(
+                        f"{rel}: patch pins spec.replicas on "
+                        f"autoscaler-managed Deployment {name!r} — "
+                        "kustomize and the controller would fight over "
+                        "the fleet every reconcile")
+
+
 def lint(root: Path = None) -> List[str]:
     """Return a list of violation strings (empty = clean)."""
     root = Path(root) if root is not None else REPO / "cluster-config"
     errors: List[str] = []
     catalog = _catalog_metric_names()
     routers, services, deployments = [], [], []
+    autoscalers, roles, bindings, kustomizations = [], [], [], []
     for path in sorted(root.rglob("*.yaml")):
         rel = path.relative_to(root).as_posix()
         if rel in SKIP_FILES:
@@ -471,6 +683,28 @@ def lint(root: Path = None) -> List[str]:
                               for p in spec.get("ports", []) or []},
                 })
                 continue
+            meta = doc.get("metadata") or {}
+            if kind == "Role":
+                roles.append({
+                    "where": f"{rel}/Role/{meta.get('name')}",
+                    "name": meta.get("name"),
+                    "namespace": meta.get("namespace"),
+                    "rules": doc.get("rules") or [],
+                })
+                continue
+            if kind == "RoleBinding":
+                bindings.append({
+                    "where": f"{rel}/RoleBinding/{meta.get('name')}",
+                    "namespace": meta.get("namespace"),
+                    "roleRef": doc.get("roleRef") or {},
+                    "subjects": doc.get("subjects") or [],
+                })
+                continue
+            if kind == "Kustomization" and str(
+                    doc.get("apiVersion", "")).startswith(
+                    "kustomize.config.k8s.io"):
+                kustomizations.append((rel, path.parent, doc))
+                continue
             if kind not in WORKLOAD_KINDS:
                 continue
             where = f"{rel}/{kind}/{doc['metadata'].get('name')}"
@@ -480,11 +714,22 @@ def lint(root: Path = None) -> List[str]:
                     _check_resources(where, container, errors)
                     if _is_router(container):
                         routers.append((where, container))
+                    if _is_autoscaler(container):
+                        autoscalers.append({
+                            "where": where,
+                            "container": container,
+                            "namespace": meta.get("namespace"),
+                            "serviceAccountName": tmpl.get(
+                                "spec", {}).get("serviceAccountName"),
+                        })
             if kind == "Deployment":
                 _check_deployment(where, doc, errors)
                 tmpl = doc["spec"]["template"]
                 deployments.append({
                     "where": where,
+                    "name": meta.get("name"),
+                    "namespace": meta.get("namespace"),
+                    "annotations": meta.get("annotations") or {},
                     "replicas": int(doc["spec"].get("replicas", 1)),
                     "labels": (tmpl.get("metadata") or {}).get("labels")
                     or {},
@@ -497,6 +742,8 @@ def lint(root: Path = None) -> List[str]:
             _check_prober_contract(where, doc, errors)
             _check_tpu_parallelism(where, doc, errors)
     _check_router_contract(errors, routers, services, deployments)
+    _check_autoscaler_contract(errors, autoscalers, roles, bindings,
+                               deployments, kustomizations)
     return errors
 
 

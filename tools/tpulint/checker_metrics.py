@@ -49,6 +49,9 @@ UNIT_SUFFIXES = (
     "blocks",
     # mesh-shape accounting (devices per mesh axis, parallel/mesh.py)
     "chips",
+    # fleet-size accounting (the elastic capacity controller's desired/
+    # actual replica counts, serving/autoscaler.py)
+    "replicas",
     # enum gauges (value is a documented small-integer state machine)
     "state",
     # index gauges (value identifies a position, e.g. the last-saved

@@ -532,6 +532,35 @@ CATALOG: Tuple[MetricSpec, ...] = (
                "0 — the client saw the last upstream error honestly).",
                unit="retries"),
 
+    # ---- elastic capacity controller (tpustack.serving.autoscaler;
+    # constructed only when TPUSTACK_AUTOSCALER_ROUTER_URL is set) ----
+    MetricSpec("tpustack_autoscaler_desired_replicas", "gauge",
+               "Replica count the damped policy currently wants (after "
+               "hysteresis, cooldowns and min/max clamping).",
+               unit="replicas"),
+    MetricSpec("tpustack_autoscaler_actual_replicas", "gauge",
+               "Replica count the executor reports as existing (local: "
+               "live subprocesses; k8s: the Deployment scale "
+               "subresource).  desired != actual means a scale event is "
+               "in flight or stuck — see the runbook.", unit="replicas"),
+    MetricSpec("tpustack_autoscaler_scale_events_total", "counter",
+               "Executed scale events, by direction (up|down) and the "
+               "policy reason that fired them (load | shed_pressure | "
+               "kv_pressure | idle | bounds).", ("direction", "reason"),
+               unit="total"),
+    MetricSpec("tpustack_autoscaler_policy_decision_state", "gauge",
+               "Raw per-tick policy desire before damping: +1 scale up, "
+               "-1 scale down, 0 hold.  Oscillation here with no scale "
+               "events means the hysteresis/cooldowns are doing their "
+               "job; oscillating EVENTS mean they are mis-tuned.",
+               unit="state"),
+    MetricSpec("tpustack_autoscaler_drain_wait_seconds", "histogram",
+               "Scale-down choreography: seconds from the victim's "
+               "admin drain to its clean exit (in-flight work finished "
+               "+ SIGTERM drain state machine ran).",
+               buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
+               unit="seconds"),
+
     # ---- black-box prober (tools/probe.py, the prober CronJob sidecar) ----
     MetricSpec("tpustack_probe_attempts_total", "counter",
                "Prober checks run, by target (llm|sd|graph), check "

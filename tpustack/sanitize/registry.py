@@ -57,8 +57,18 @@ GUARDED: Dict[Tuple[str, str], Tuple[GuardedSpec, ...]] = {
         _s("_sigterm_fired", "_lock", writes_only=True),
     ),
     ("tpustack.serving.resilience", "ResilienceManager"): (
+        _s("_admin_drained", "_lock", writes_only=True),
         _s("_inflight", "_lock", writes_only=True),
         _s("_service_times", "_lock"),
+    ),
+    ("tpustack.serving.autoscaler", "Autoscaler"): (
+        _s("_events", "_lock"),
+        _s("_decisions", "_lock"),
+        _s("_last_signals", "_lock", writes_only=True),
+        _s("_scaling", "_lock", writes_only=True),
+    ),
+    ("tpustack.serving.autoscaler", "LocalSubprocessExecutor"): (
+        _s("_procs", "_lock"),
     ),
     ("tpustack.serving.kv_pool", "KVBlockPool"): (
         _s("_free", "_lock", writes_only=True),

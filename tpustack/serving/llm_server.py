@@ -84,6 +84,7 @@ for llama.cpp client parity.
 from __future__ import annotations
 
 import asyncio
+import hmac
 import json
 import os
 import threading
@@ -1738,6 +1739,49 @@ class LLMServer:
         return web.json_response(payload, status=status,
                                  headers=self.resilience.ready_headers(status))
 
+    async def admin_drain(self, request: web.Request) -> web.Response:
+        """Authenticated reversible drain (``POST /admin/drain``).
+
+        The autoscaler's scale-down choreography calls this FIRST: the
+        flip makes ``/readyz`` 503 with ``X-Shed-Reason: draining``, the
+        router ejects the replica authoritatively within one health tick,
+        in-flight work finishes, and only then is the process signalled.
+        Body ``{"undrain": true}`` reverses it (an operator aborting a
+        scale-down, or a drill restoring the fleet).
+
+        Auth: ``X-Admin-Token`` must equal ``TPUSTACK_ADMIN_TOKEN``; an
+        empty knob disables the surface (403 always) so an unconfigured
+        replica exposes no unauthenticated drain lever."""
+        expected = knobs.get_str("TPUSTACK_ADMIN_TOKEN")
+        presented = request.headers.get("X-Admin-Token", "")
+        if not expected or not hmac.compare_digest(presented, expected):
+            self._reject("admin_forbidden")
+            return web.json_response(
+                {"error": "forbidden", "detail": "missing or bad "
+                 "X-Admin-Token (or TPUSTACK_ADMIN_TOKEN unset)"},
+                status=403)
+        try:
+            body = await request.json()
+        except Exception as exc:
+            # an empty/absent body is a plain drain request
+            log.debug("admin drain: unparseable body treated as {}: %s", exc)
+            body = {}
+        undrain = bool(isinstance(body, dict) and body.get("undrain"))
+        if undrain:
+            changed = self.resilience.admin_undrain()
+        else:
+            changed = self.resilience.admin_drain()
+        status, ready = self.resilience.ready_payload()
+        return web.json_response({
+            "ok": True,
+            "action": "undrain" if undrain else "drain",
+            "changed": changed,
+            "draining": self.resilience.draining,
+            "state": self.resilience.state_name,
+            "readyz_status": status,
+            "inflight": self.resilience.inflight,
+        })
+
     async def props(self, request: web.Request) -> web.Response:
         """Server properties + live KV-cache config/stats, so operators can
         verify the serving substrate (paged pool size/block/utilization,
@@ -1975,6 +2019,9 @@ class LLMServer:
         app.router.add_get("/metrics",
                            obs_http.make_metrics_handler(self._registry))
         app.router.add_post("/profile", self.profile)
+        # deliberately NOT in the work set: the drain lever must keep
+        # working while admission is shedding (that is its whole point)
+        app.router.add_post("/admin/drain", self.admin_drain)
         app.router.add_post("/completion", self.completion)
         app.router.add_post("/tokenize", self.tokenize)
         app.router.add_post("/detokenize", self.detokenize)

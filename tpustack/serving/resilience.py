@@ -259,6 +259,13 @@ class ResilienceManager:
         self._drain_once = threading.Lock()
         self._state = SERVING
         self._hung = False
+        # reversible ADMIN drain (POST /admin/drain): readiness flips 503
+        # + X-Shed-Reason: draining and admission sheds, but the process
+        # keeps running and can undrain — the autoscaler's scale-down
+        # choreography uses it to eject a victim from the router
+        # authoritatively BEFORE any signal is sent, so in-flight work
+        # finishes with no new arrivals racing it
+        self._admin_drained = False  # guarded-by: _lock (writes)
         self._inflight = 0  # guarded-by: _lock (writes)
         self._last_beat = time.monotonic()
         # appended from worker/engine threads, median'd on the event loop —
@@ -291,15 +298,21 @@ class ResilienceManager:
 
     @property
     def state_name(self) -> str:
+        if self._state == SERVING and self._admin_drained:
+            return "draining"
         return _STATE_NAMES[self._state]
 
     @property
     def draining(self) -> bool:
-        return self._state != SERVING
+        return self._state != SERVING or self._admin_drained
 
     @property
     def hung(self) -> bool:
         return self._hung
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
 
     def install_signal_handlers(self) -> None:
         """SIGTERM → drain.  Only callable from the main thread (python
@@ -346,6 +359,42 @@ class ResilienceManager:
         log.warning("SIGTERM/drain: refusing new work, waiting up to %.0fs "
                     "for in-flight requests", self.drain_timeout_s)
         self._drain_thread.start()
+
+    def admin_drain(self) -> bool:
+        """Reversible readiness-level drain (``POST /admin/drain``).
+
+        Unlike :meth:`begin_drain` this never exits the process: it only
+        makes ``draining`` true, which flips ``/readyz`` to 503 with
+        ``X-Shed-Reason: draining`` and sheds new admissions.  The router
+        treats the unready probe as authoritative and ejects the backend
+        within one health tick, so in-flight work finishes with no new
+        arrivals racing it.  The autoscaler's scale-down choreography
+        drains a victim this way, waits for in-flight work, THEN sends
+        SIGTERM (which runs the one-shot drain state machine and exits 0).
+
+        Returns True if the call changed state (idempotent otherwise)."""
+        with self._lock:
+            was = self._admin_drained
+            self._admin_drained = True
+        if not was and self._state == SERVING:
+            self.metrics["tpustack_serving_drain_state"].labels(
+                server=self.server).set(DRAINING)
+            log.warning("admin drain: readiness now 503/draining; process "
+                        "stays up until undrained or signalled")
+        return not was
+
+    def admin_undrain(self) -> bool:
+        """Undo :meth:`admin_drain`.  No-op if a real (signal) drain has
+        started — that one is one-way by design.  Returns True if the call
+        changed state."""
+        with self._lock:
+            was = self._admin_drained
+            self._admin_drained = False
+        if was and self._state == SERVING:
+            self.metrics["tpustack_serving_drain_state"].labels(
+                server=self.server).set(SERVING)
+            log.warning("admin undrain: readiness restored")
+        return was
 
     def _flight_dump(self, reason: str) -> None:
         """Post-mortem hook: dump every registered flight recorder so the

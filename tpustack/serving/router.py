@@ -670,9 +670,17 @@ class Router:
     async def debug_router(self, request: web.Request) -> web.Response:
         now = time.monotonic()
         with self._lock:
+            # per-backend affinity ledger share: how many live prefix keys
+            # last landed on each replica.  The autoscaler's scale-down
+            # victim selection reads this — the replica holding the FEWEST
+            # warm prefixes is the cheapest one to give back.
+            aff_share: Dict[str, int] = {}
+            for owner in self._affinity.values():
+                aff_share[owner] = aff_share.get(owner, 0) + 1
             backends = {
                 u: {"state": st["state"], "fails": st["fails"],
                     "ejections": st["ejections"],
+                    "affinity_keys": aff_share.get(u, 0),
                     "open_age_s": (round(now - st["opened_at"], 3)
                                    if st["state"] == OPEN else None)}
                 for u, st in self._backends.items()}

@@ -417,6 +417,9 @@ def _generic_lm_task(args, kind: str) -> None:
 
 
 def main(argv=None) -> int:
+    from tpustack.utils import enable_compile_cache
+
+    enable_compile_cache()  # restarted/rescheduled trainers skip cold jit
     p = argparse.ArgumentParser(description="tpustack training ladder")
     p.add_argument("task", choices=["resnet50", "bert", "llama2", "sd15"])
     p.add_argument("--steps", type=int, default=100)

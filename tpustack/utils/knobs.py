@@ -236,6 +236,58 @@ _declare("TPUSTACK_ROUTER_UPSTREAM_TIMEOUT_S", float, 600.0,
          "+ full response; streaming responses are exempt after the "
          "first byte).")
 
+# -------------------------------------------------------------- autoscaler
+_declare("TPUSTACK_ADMIN_TOKEN", str, "",
+         "Shared secret for the authenticated admin surface (POST "
+         "/admin/drain).  Empty disables the surface entirely — every "
+         "request 403s, so an unconfigured fleet exposes nothing.")
+_declare("TPUSTACK_AUTOSCALER_ROUTER_URL", str, "",
+         "Base URL of the L7 router the autoscaler scrapes for fleet "
+         "state (/debug/router).  Empty is the bisection flag — no "
+         "autoscaler constructs.")
+_declare("TPUSTACK_AUTOSCALER_MIN", int, 1,
+         "Replica floor.  Never below 1: scale-to-zero would empty the "
+         "healthy set and turn the next request into a cold-boot timeout.")
+_declare("TPUSTACK_AUTOSCALER_MAX", int, 4,
+         "Replica ceiling (chips are finite; the policy clamps here "
+         "before the executor ever sees the desire).")
+_declare("TPUSTACK_AUTOSCALER_TARGET_LOAD", float, 3.0,
+         "Target work units (in-flight + queued requests) per replica — "
+         "the set-point of the utilization controller.")
+_declare("TPUSTACK_AUTOSCALER_HYSTERESIS", float, 0.25,
+         "Dead-band half-width as a fraction of the target: scale up "
+         "above target*(1+h), down only below (n-1)*target*(1-h).")
+_declare("TPUSTACK_AUTOSCALER_INTERVAL_S", float, 2.0,
+         "Seconds between control-loop ticks (scrape -> decide -> "
+         "execute).")
+_declare("TPUSTACK_AUTOSCALER_UP_COOLDOWN_S", float, 5.0,
+         "Minimum seconds between consecutive scale-UP events (fast: a "
+         "surge should add capacity within seconds).")
+_declare("TPUSTACK_AUTOSCALER_DOWN_COOLDOWN_S", float, 60.0,
+         "Minimum seconds after ANY scale event before a scale-DOWN "
+         "(slow: giving back a warm KV cache must never be hasty).")
+_declare("TPUSTACK_AUTOSCALER_DOWN_STABLE_TICKS", int, 3,
+         "Consecutive below-band ticks required before a scale-down "
+         "fires (flap suppression on top of the cooldowns).")
+_declare("TPUSTACK_AUTOSCALER_KV_FREE_MIN", float, 0.05,
+         "KV-pool free-block ratio under which the fleet is memory-"
+         "pressured and a scale-up fires regardless of request load.")
+_declare("TPUSTACK_AUTOSCALER_DRAIN_TIMEOUT_S", float, 120.0,
+         "Scale-down choreography: max seconds to wait for a drained "
+         "victim's in-flight work before terminating it anyway.")
+_declare("TPUSTACK_AUTOSCALER_REGISTRY_FILE", str, "",
+         "Local executor: path of the router's @file registry the "
+         "executor rewrites (selects LocalSubprocessExecutor when set).")
+_declare("TPUSTACK_AUTOSCALER_SPAWN_CMD", str, "",
+         "Local executor: replica spawn command template; '{port}' is "
+         "substituted (shlex-split).")
+_declare("TPUSTACK_AUTOSCALER_K8S_DEPLOYMENT", str, "",
+         "Kubernetes executor: Deployment name whose scale subresource "
+         "is patched (selects KubernetesExecutor when set).")
+_declare("TPUSTACK_AUTOSCALER_K8S_NAMESPACE", str, "llm",
+         "Kubernetes executor: namespace of the managed Deployment (the "
+         "RBAC Role grants deployments/scale patch here only).")
+
 # ------------------------------------------------------------ fault injection
 _declare("TPUSTACK_FAULT_SLOW_PREFILL_S", float, 0.0,
          "Sleep injected before every device dispatch (deterministic "
