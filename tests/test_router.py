@@ -613,6 +613,125 @@ def test_streaming_relay_and_pre_first_byte_failover():
     _run(scenario())
 
 
+class HeaderCapturingStream(StreamReplica):
+    """StreamReplica that also keeps each request's headers — the
+    traceparent-continuity regression needs to see what the RETRY
+    attempt carried."""
+
+    def __init__(self, chunks):
+        super().__init__(chunks)
+        self.headers = []
+
+    def build_app(self):
+        async def completion(request):
+            self.calls += 1
+            self.headers.append(dict(request.headers))
+            await request.read()
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            for c in self.chunks:
+                await resp.write(c)
+            await resp.write_eof()
+            return resp
+
+        async def readyz(request):
+            return web.json_response({"ready": True})
+
+        app = web.Application()
+        app.router.add_post("/completion", completion)
+        app.router.add_get("/readyz", readyz)
+        return app
+
+
+def test_traceparent_continuity_across_streaming_failover():
+    """A before-first-byte streaming failover must reuse the ORIGINAL
+    trace id on the retry: the watchtower stitches router- and
+    replica-side span trees by trace id, and a retry that minted a new
+    one would orphan the second attempt from the incident's tree."""
+    from tpustack.obs import trace as obs_trace
+
+    async def scenario():
+        chunks = [b"data: tok\n\n", b"data: [DONE]\n\n"]
+        stub = HeaderCapturingStream(chunks)
+        server = TestServer(stub.build_app())
+        await server.start_server()
+        live = str(server.make_url("/")).rstrip("/")
+        dead = f"http://127.0.0.1:{_free_port()}"
+        tracer = obs_trace.Tracer()
+        router = Router(f"{dead},{live}", registry=Registry(),
+                        tracer=tracer, env=_QUIET)
+        client = TestClient(TestServer(router.build_app()))
+        await client.start_server()
+        try:
+            # pick a prompt whose affinity key rendezvous-ranks the DEAD
+            # backend first — otherwise the live one wins the hash and no
+            # failover happens (ports are random, so no fixed prompt works)
+            prompt = next(
+                c * 64 for c in "abcdefghijklmnopqrstuvwxyz"
+                if rendezvous_rank(router.affinity_key(c * 64),
+                                   [dead, live])[0] == dead)
+            tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+            r = await client.post(
+                "/completion",
+                json={"prompt": prompt, "n_predict": 2, "stream": True},
+                headers={"traceparent": tp})
+            body = await r.read()
+            assert r.status == 200
+            assert body == b"".join(chunks)
+            # the attempt that reached a replica is the RETRY (the dead
+            # backend connect-failed first) — same trace id as the client
+            fwd_tp = stub.headers[0]["traceparent"].split("-")
+            assert fwd_tp[1] == "ab" * 16
+            # and its parent span is the router's own span in that trace,
+            # so stitching joins both processes under one root
+            record = tracer.get("ab" * 16)
+            assert record is not None
+            assert fwd_tp[2] in {s["span_id"] for s in record["spans"]}
+            # the failover itself is on the structured flight log
+            kinds = [rec["kind"] for rec in router.flight.recent(16)]
+            assert "failover" in kinds
+        finally:
+            await client.close()
+            await server.close()
+            router.close()
+    _run(scenario())
+
+
+def test_flight_events_on_ejection_and_readmission():
+    """The router's fleet transitions are structured flight events
+    (kind=ejection|breaker) — the watchtower ingests these instead of
+    parsing logs.  Re-ejecting an already-OPEN backend records nothing
+    (true transitions only, or a flapping probe would spam bundles)."""
+    url = "http://127.0.0.1:1"
+    router = Router(url, registry=Registry(), env=_QUIET)
+    try:
+        with router._lock:
+            st = router._backends[url]
+        for _ in range(int(_QUIET["TPUSTACK_ROUTER_EJECT_AFTER"])):
+            router._apply_probe(url, "down")
+        events = router.flight.recent(16)
+        assert [e["kind"] for e in events
+                if e["kind"] in ("ejection", "breaker")] \
+            == ["ejection", "breaker"]
+        eject = next(e for e in events if e["kind"] == "ejection")
+        assert eject["url"] == url and eject["ejections"] == 1
+        opened = next(e for e in events if e["kind"] == "breaker")
+        assert opened["to"] == "open" and opened["via"] == "ejection"
+        # still OPEN: another failing probe is NOT a new transition
+        router._apply_probe(url, "down")
+        assert len([e for e in router.flight.recent(16)
+                    if e["kind"] == "ejection"]) == 1
+        # half-open probe success closes the breaker, via=probe
+        router._apply_probe(url, "ok")
+        closed = [e for e in router.flight.recent(16)
+                  if e["kind"] == "breaker" and e["to"] == "closed"]
+        assert len(closed) == 1 and closed[0]["via"] == "probe"
+        assert st["state"] == HEALTHY
+    finally:
+        router.close()
+
+
 def test_streaming_without_middleware_body_parse():
     """The obs middleware only parses POST application/json bodies up to
     its size bound — a content type it skips (standing in for the >1 MB

@@ -1,0 +1,447 @@
+"""Fleet watchtower: trace stitching, burn-rate alerting, incident
+bundles (tpustack/obs/watchtower.py + tpustack/serving/watchtower.py).
+
+The integration tests run a REAL router and two replica stubs carrying
+the real obs middleware (tracer + flight recorder) on a background
+event-loop thread, because the watchtower's tick() scrapes with
+blocking urllib from whatever thread calls it — exactly the production
+shape, and it would deadlock against servers on the caller's own loop.
+"""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+from aiohttp import web
+
+from tpustack.obs import Registry
+from tpustack.obs import flight as obs_flight
+from tpustack.obs import http as obs_http
+from tpustack.obs import trace as obs_trace
+from tpustack.obs.watchtower import (BurnRateEngine, IncidentStore,
+                                     merge_scrapes, stitch)
+from tpustack.serving.router import Router
+from tpustack.serving.watchtower import Watchtower, maybe_from_env
+
+#: fast knobs for a watchtower driven tick-by-tick in tests
+_WT = {
+    "TPUSTACK_WATCHTOWER_INTERVAL_S": "0.05",
+    "TPUSTACK_WATCHTOWER_INCIDENT_COOLDOWN_S": "0",
+    "TPUSTACK_WATCHTOWER_WINDOW_SCALE": "0.001",  # 1h window -> 3.6s
+    "TPUSTACK_WATCHTOWER_TRACES_PER_BUNDLE": "4",
+    "TPUSTACK_WATCHTOWER_INCIDENT_KEEP": "4",
+}
+
+#: router knobs: fast active health checks so an ejection follows a
+#: replica kill within a few hundred ms
+_ROUTER = {
+    "TPUSTACK_ROUTER_HEALTH_INTERVAL_S": "0.05",
+    "TPUSTACK_ROUTER_EJECT_AFTER": "2",
+    "TPUSTACK_ROUTER_HALF_OPEN_S": "60",
+    "TPUSTACK_ROUTER_RETRY_BUDGET": "2",
+    "TPUSTACK_ROUTER_RETRY_JITTER_S": "0",
+    "TPUSTACK_ROUTER_AFFINITY_CHUNK": "8",
+    "TPUSTACK_ROUTER_UPSTREAM_TIMEOUT_S": "10",
+}
+
+
+# ------------------------------------------------------------- pure: stitch
+def _span(sid, parent, name, start, dur, status="ok"):
+    return {"span_id": sid, "parent_id": parent, "name": name,
+            "start_unix": start, "duration_s": dur, "status": status,
+            "attrs": {}, "events": []}
+
+
+def test_stitch_joins_processes_under_one_root():
+    router_rec = {"spans": [_span("r1", "c0", "POST /completion",
+                                  100.0, 1.0)]}
+    replica_rec = {"spans": [
+        _span("a1", "r1", "POST /completion", 100.2, 0.5),
+        _span("a2", "a1", "engine", 100.3, 0.1),
+    ]}
+    st = stitch("t1", [{"process": "router", "record": router_rec},
+                       {"process": "replica", "record": replica_rec}])
+    assert st["n_roots"] == 1 and st["n_spans"] == 3
+    assert st["processes"] == ["router", "replica"]
+    root = st["tree"][0]
+    assert root["process"] == "router"
+    hop = root["children"][0]["hop"]
+    # gap = parent duration - child duration: the 0.5s neither process's
+    # own spans can account for (network + connect + upstream queue)
+    assert hop == {"from": "router", "to": "replica",
+                   "gap_s": 0.5, "offset_s": 0.2}
+    # same-process parent/child edges carry no hop annotation
+    assert "hop" not in root["children"][0]["children"][0]
+
+
+def test_stitch_dedupes_and_rolls_up_status():
+    rec = {"spans": [_span("r1", None, "root", 10.0, 2.0, "error")]}
+    st = stitch("t2", [{"process": "router", "record": rec},
+                       {"process": "router", "record": rec}])
+    assert st["n_spans"] == 1  # same process polled twice: no dup spans
+    assert st["status"] == "error"
+    assert st["duration_s"] == 2.0
+    assert stitch("t3", [{"process": "router", "record": {}}]) is None
+
+
+def test_merge_scrapes_sums_keywise():
+    k = ("tpustack_http_requests_total", (("server", "llm"),))
+    assert merge_scrapes([{k: 2.0}, {k: 3.0}, {}]) == {k: 5.0}
+
+
+# -------------------------------------------------------- pure: burn rates
+def _requests(total, bad):
+    """A parsed exposition with ``total`` llm requests, ``bad`` of them
+    5xx (availability SLI only — no latency histogram, so the latency
+    verdict stays 'no traffic')."""
+    return {
+        ("tpustack_http_requests_total",
+         (("endpoint", "/completion"), ("method", "POST"),
+          ("server", "llm"), ("status", "200"))): float(total - bad),
+        ("tpustack_http_requests_total",
+         (("endpoint", "/completion"), ("method", "POST"),
+          ("server", "llm"), ("status", "500"))): float(bad),
+    }
+
+
+def test_burn_rate_engine_fires_on_both_windows_only():
+    eng = BurnRateEngine(window_scale=0.01)  # 1h->36s 5m->3s 6h->216s
+    t0 = 1000.0
+    eng.observe(t0, _requests(100, 0))
+    state = eng.evaluate(t0)
+    assert state["active"] == [] and state["samples"] == 1
+    # 50% errors over the whole (short) history: every window degrades
+    # to the full span and the page alert fires on long AND short
+    eng.observe(t0 + 5, _requests(200, 50))
+    state = eng.evaluate(t0 + 5)
+    page = state["rules"][0]
+    assert page["severity"] == "page" and page["threshold"] == 14.4
+    llm = page["states"]["llm"]["availability"]
+    assert llm["burn_long"] == llm["burn_short"] == 100.0
+    assert llm["active"]
+    assert {"severity": "page", "server": "llm",
+            "kind": "availability"} in state["active"]
+    assert page["long"]["degraded"]  # history shorter than 36s window
+    # latency has no histogram traffic: burn None, never active
+    assert page["states"]["llm"]["latency"]["burn_long"] is None
+    assert not page["states"]["llm"]["latency"]["active"]
+
+
+def test_burn_rate_engine_short_window_recovery_clears_alert():
+    # long window still sees the error burst, but the SHORT window has
+    # recovered -> multi-window rule keeps the alert quiet (the
+    # condition stopped; paging now would wake someone for history)
+    eng = BurnRateEngine(window_scale=0.01)
+    t0 = 1000.0
+    eng.observe(t0, _requests(100, 0))
+    eng.observe(t0 + 30, _requests(200, 50))    # burst
+    eng.observe(t0 + 34, _requests(300, 50))    # clean again
+    state = eng.evaluate(t0 + 34)
+    llm = state["rules"][0]["states"]["llm"]["availability"]
+    assert llm["burn_long"] > 14.4      # 50/200 bad over ~34s
+    assert llm["burn_short"] == 0.0     # last 3s: 100 good, 0 bad
+    assert not llm["active"]
+    assert all(a["severity"] != "page" for a in state["active"])
+    # the slower ticket windows still see the burst — by design: the
+    # budget IS spent, someone should look, nobody should be woken
+    assert {"severity": "ticket", "server": "llm",
+            "kind": "availability"} in state["active"]
+
+
+def test_burn_rate_engine_history_is_bounded_and_filtered():
+    eng = BurnRateEngine(window_scale=0.001)  # retain ~ 21.6s * 1.25
+    noise = {("tpustack_llm_tokens_total", (("kind", "generated"),)): 9.9}
+    for i in range(200):
+        eng.observe(1000.0 + i, {**_requests(i, 0), **noise})
+    with eng._lock:
+        assert len(eng._history) < 60  # pruned to the retention horizon
+        for _, samples in eng._history:
+            assert all(k[0].startswith("tpustack_http_") for k in samples)
+
+
+# ---------------------------------------------------- pure: incident store
+def test_incident_store_ring_memory_and_disk(tmp_path):
+    store = IncidentStore(dump_dir=str(tmp_path), keep=2)
+    ids = [store.add({"reason": f"r{i}", "alerts": {"active": []},
+                      "traces": [], "flight": {}})["id"]
+           for i in range(3)]
+    assert len(store) == 2
+    listed = store.list()
+    assert [b["reason"] for b in listed] == ["r2", "r1"]  # newest first
+    assert store.get(ids[0]) is None and store.get(ids[2]) is not None
+    on_disk = sorted(p.name for p in tmp_path.glob("incident-*.json"))
+    assert len(on_disk) == 2  # disk ring pruned with the memory ring
+    with open(store.get(ids[2])["path"]) as f:
+        assert json.load(f)["reason"] == "r2"
+
+
+def test_incident_store_survives_unwritable_dir():
+    store = IncidentStore(dump_dir="/proc/definitely/not/writable", keep=4)
+    bundle = store.add({"reason": "x"})
+    assert bundle["path"] is None  # best-effort: memory copy still serves
+    assert store.get(bundle["id"])["reason"] == "x"
+
+
+# ------------------------------------------------------------- integration
+class _Fleet:
+    """Serve aiohttp apps on a background event-loop thread so the
+    watchtower's blocking urllib scrapes (run from the test thread)
+    cannot deadlock against them."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self.loop.run_forever,
+                                        daemon=True, name="fleet-loop")
+        self._thread.start()
+        self._runners = []
+
+    def serve(self, app) -> str:
+        async def _start():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            return runner, port
+        runner, port = asyncio.run_coroutine_threadsafe(
+            _start(), self.loop).result(10)
+        self._runners.append(runner)
+        return f"http://127.0.0.1:{port}"
+
+    def stop_app(self, url: str) -> None:
+        """Tear one served app down — the 'kill' in these tests."""
+        port = int(url.rsplit(":", 1)[1])
+        for runner in list(self._runners):
+            addrs = [s.getsockname()[1]
+                     for s in (runner.sites and [
+                         site._server.sockets[0]
+                         for site in runner.sites] or [])]
+            if port in addrs:
+                asyncio.run_coroutine_threadsafe(
+                    runner.cleanup(), self.loop).result(10)
+                self._runners.remove(runner)
+                return
+        raise AssertionError(f"no served app on {url}")
+
+    def close(self):
+        for runner in self._runners:
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    runner.cleanup(), self.loop).result(10)
+            except Exception:
+                pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)
+        self.loop.close()
+
+
+def _replica_app(name: str):
+    """A replica stub carrying the REAL obs surfaces the watchtower
+    scrapes: instrument middleware (tracer spans honouring the router's
+    traceparent), /debug/traces, /debug/flight, /metrics."""
+    registry = Registry()
+    tracer = obs_trace.Tracer()
+    flight = obs_flight.FlightRecorder(name, meta={"stub": True})
+
+    async def completion(request):
+        body = await request.json()
+        flight.record("dispatch", prompt_chars=len(body.get("prompt", "")))
+        return web.json_response({"content": "ok", "tokens_predicted": 1})
+
+    async def readyz(request):
+        return web.json_response({"ready": True})
+
+    app = web.Application(middlewares=[
+        obs_http.instrument("llm", registry, tracer=tracer)])
+    obs_http.add_debug_trace_routes(app, tracer)
+    obs_http.add_debug_flight_routes(app, flight)
+    app.router.add_get("/metrics",
+                       obs_http.make_metrics_handler(registry))
+    app.router.add_post("/completion", completion)
+    app.router.add_get("/readyz", readyz)
+    app.router.add_get("/healthz", readyz)
+    return app
+
+
+def _post(url, payload, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return (resp.status, json.loads(resp.read().decode()),
+                dict(resp.headers))
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _wait(predicate, timeout=5.0, every=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(every)
+    return False
+
+
+@pytest.fixture()
+def fleet():
+    f = _Fleet()
+    yield f
+    f.close()
+
+
+def test_watchtower_stitches_one_tree_per_request(fleet):
+    urls = [fleet.serve(_replica_app(f"llm-{i}")) for i in range(2)]
+    router = Router(",".join(urls), registry=Registry(),
+                    tracer=obs_trace.Tracer(), env=_ROUTER)
+    router_url = fleet.serve(router.build_app())
+    tower = Watchtower(router_url, env=_WT)
+    tower_url = fleet.serve(tower.build_app())
+    try:
+        for i in range(4):
+            status, _, _ = _post(router_url + "/completion",
+                                 {"prompt": f"prompt-{i}" * 8,
+                                  "n_predict": 1})
+            assert status == 200
+        tower.tick()
+        summaries = _get(router_url + "/debug/traces")
+        recent = summaries["recent"]
+        assert len(recent) == 4
+        for s in recent:
+            st = tower.stitch_trace(s["trace_id"])
+            assert st is not None, s["trace_id"]
+            # ONE root spanning both processes: the replica's root span
+            # parents under the router's span via the forwarded
+            # traceparent, so the join needs no timestamp heuristics
+            assert st["n_roots"] == 1
+            assert "router" in st["processes"]
+            assert any(p.startswith("replica@") for p in st["processes"])
+            hops = [c.get("hop") for c in st["tree"][0]["children"]
+                    if c.get("hop")]
+            assert hops and hops[0]["gap_s"] >= 0
+        # the watchtower's debug app serves the same stitch (the
+        # blocking fan-out rides an executor thread, not the loop)
+        payload = _get(f"{tower_url}/debug/traces"
+                       f"/{recent[0]['trace_id']}")
+        assert payload["n_spans"] >= 2 and len(payload["processes"]) >= 2
+    finally:
+        tower.close()
+        router.close()
+
+
+def test_replica_kill_yields_exactly_one_bundle_with_all_evidence(fleet):
+    urls = [fleet.serve(_replica_app(f"llm-{i}")) for i in range(2)]
+    router = Router(",".join(urls), registry=Registry(),
+                    tracer=obs_trace.Tracer(), env=_ROUTER)
+    router_url = fleet.serve(router.build_app())
+    tower = Watchtower(router_url, env=_WT)
+    try:
+        served = set()
+        for i in range(3):
+            _, _, headers = _post(router_url + "/completion",
+                                  {"prompt": f"warm-{i}" * 8,
+                                   "n_predict": 1})
+            served.add(headers["X-Router-Backend"])
+        assert tower.tick()["captured"] is None  # primes the flight cursor
+        # kill a replica such that a SURVIVOR still holds trace spans —
+        # the bundle must show a cross-process tree after the kill
+        victim = urls[0] if (urls[1] in served) else urls[1]
+        fleet.stop_app(victim)
+        assert _wait(lambda: any(
+            b["state"] == "open"
+            for b in _get(router_url + "/debug/router")
+            ["backends"].values())), "router never ejected the victim"
+        record = tower.tick()
+        assert record["captured"] is not None
+        assert record["triggers"][0] == "ejection"
+        # exactly one bundle: ejection + breaker-open from the same kill
+        # coalesce into one capture, and the next tick sees no new events
+        assert tower.tick()["captured"] is None
+        assert len(tower.store) == 1
+        bundle = tower.store.get(record["captured"])
+        # evidence 1: stitched traces spanning router + replica
+        assert bundle["traces"], "bundle captured no traces"
+        assert any(len(t["processes"]) >= 2 for t in bundle["traces"])
+        # evidence 2: per-process flight snapshots (router + survivor;
+        # the victim is dead — that IS the incident)
+        assert "router" in bundle["flight"]
+        assert any(p.startswith("replica@") for p in bundle["flight"])
+        # evidence 3: the router's structured event history names the
+        # victim, and the alert state rode along
+        events = bundle["router"]["events"]
+        assert any(e["kind"] == "ejection" and e["url"] == victim
+                   for e in events)
+        assert any(e["kind"] == "breaker" and e["to"] == "open"
+                   for e in events)
+        assert "rules" in bundle["alerts"]
+        assert bundle["fleet"]["router"] == tower.router_url
+        assert victim in bundle["fleet"]["replicas"]
+        # the acceptance path: the report tool renders this bundle to a
+        # markdown timeline without error, naming the victim
+        from tools.incident_report import render
+        md = render(bundle)
+        assert "## Timeline" in md and "ejection" in md
+        assert victim in md
+        assert "hop" in md  # at least one cross-process gap attributed
+    finally:
+        tower.close()
+        router.close()
+
+
+def test_debug_app_surfaces(fleet):
+    urls = [fleet.serve(_replica_app("llm-0"))]
+    router = Router(urls[0], registry=Registry(),
+                    tracer=obs_trace.Tracer(), env=_ROUTER)
+    router_url = fleet.serve(router.build_app())
+    tower = Watchtower(router_url, registry=Registry(), env=_WT)
+    tower_url = fleet.serve(tower.build_app())
+    try:
+        tower.start()
+        assert _wait(lambda: tower._ticks > 0)
+        dbg = _get(tower_url + "/debug/watchtower")
+        assert dbg["router_url"] == router_url.rstrip("/")
+        assert dbg["replicas"] == urls
+        assert dbg["config"]["window_scale"] == 0.001
+        alerts = _get(tower_url + "/debug/alerts")
+        assert {r["severity"] for r in alerts["rules"]} == \
+            {"page", "ticket"}
+        incidents = _get(tower_url + "/debug/incidents")
+        assert incidents == {"incidents": []}
+        # readiness follows the loop thread, metrics expose the gauges
+        assert _get(tower_url + "/readyz")["ready"]
+        with urllib.request.urlopen(tower_url + "/metrics",
+                                    timeout=10) as resp:
+            text = resp.read().decode()
+        assert "tpustack_watchtower_fleet_targets" in text
+        assert "tpustack_watchtower_alert_active" in text
+    finally:
+        tower.close()
+        router.close()
+
+
+def test_maybe_from_env_bisection():
+    assert maybe_from_env(env={}) is None
+    assert maybe_from_env(
+        env={"TPUSTACK_WATCHTOWER_ROUTER_URL": "  "}) is None
+    tower = maybe_from_env(env={
+        "TPUSTACK_WATCHTOWER_ROUTER_URL": "http://127.0.0.1:1/",
+        "TPUSTACK_WATCHTOWER_AUTOSCALER_URL": "http://127.0.0.1:2",
+        **_WT})
+    assert tower is not None
+    try:
+        assert tower.router_url == "http://127.0.0.1:1"
+        assert tower.autoscaler_url == "http://127.0.0.1:2"
+        assert [r for r, _ in tower.targets()] == \
+            ["router", "autoscaler"]
+        # an unreachable fleet is a degraded tick, not a crash
+        record = tower.tick()
+        assert record["router_reachable"] is False
+        assert record["captured"] is None
+    finally:
+        tower.close()

@@ -17,7 +17,13 @@ resilience bar end to end:
   actually exercised;
 - zero KV-pool leaks on survivors (``tpustack_llm_kv_used_blocks`` == 0
   once quiesced) and zero sanitizer violations anywhere — the replicas
-  and the router run under ``TPUSTACK_SANITIZE=1``.
+  and the router run under ``TPUSTACK_SANITIZE=1``;
+- the fleet watchtower (``tpustack.serving.watchtower``, booted
+  alongside the router) produced an incident bundle for the SIGKILL
+  that names the killed replica in its ejection events, holds a
+  stitched trace spanning router and replica processes plus burn-rate
+  alert state and per-process flight snapshots, and renders to
+  markdown via ``tools/incident_report.py``.
 
 ``--fast`` is the tier-1/CI shape: 2 replicas, SIGKILL one mid-load,
 SIGTERM-drain the other after the last request is offered (the drain
@@ -161,10 +167,11 @@ def _scrape_sum(url: str, metric: str) -> float:
 # ------------------------------------------------------------------- drill
 def run_drill(args) -> int:
     n = args.replicas
-    ports = _free_ports(n + 1)
-    replica_ports, router_port = ports[:n], ports[n]
+    ports = _free_ports(n + 2)
+    replica_ports, router_port, watch_port = ports[:n], ports[n], ports[n + 1]
     replica_urls = [f"http://127.0.0.1:{p}" for p in replica_ports]
     router_url = f"http://127.0.0.1:{router_port}"
+    watch_url = f"http://127.0.0.1:{watch_port}"
 
     base_env = dict(os.environ,
                     JAX_PLATFORMS="cpu",
@@ -229,6 +236,27 @@ def run_drill(args) -> int:
             _log_tail("router")
             return 2
         _log(f"router up on {router_port} -> {len(replica_urls)} backends")
+
+        # the fleet watchtower rides along: it must turn the SIGKILL's
+        # ejection into an incident bundle whose stitched trace spans
+        # router and replica processes (asserted below)
+        watchtower_env = dict(
+            base_env,
+            PORT=str(watch_port),
+            TPUSTACK_WATCHTOWER_ROUTER_URL=router_url,
+            # quick enough to catch the ejection warm, slow enough that
+            # fleet-wide scraping doesn't steal CPU from the drill itself
+            TPUSTACK_WATCHTOWER_INTERVAL_S="0.5",
+            TPUSTACK_WATCHTOWER_INCIDENT_COOLDOWN_S="5",
+            TPUSTACK_WATCHTOWER_INCIDENT_DIR=os.path.join(
+                logdir, "incidents"))
+        _spawn("watchtower",
+               [sys.executable, "-m", "tpustack.serving.watchtower"],
+               watchtower_env)
+        if not _wait_ready(watch_url, 30, "watchtower"):
+            _log_tail("watchtower")
+            return 2
+        _log(f"watchtower up on {watch_port} (watching {router_url})")
 
         tenants = parse_tenants(args.tenants)
         schedule = build_schedule(
@@ -313,6 +341,21 @@ def run_drill(args) -> int:
                                    "sanitizer_violations": violations[url]}
         violations["router"] = _scrape_sum(
             router_url, "tpustack_sanitizer_violations_total")
+        violations["watchtower"] = _scrape_sum(
+            watch_url, "tpustack_sanitizer_violations_total")
+
+        # the watchtower must have turned the SIGKILL into an incident
+        # bundle; give it a few ticks' grace past the drill's end
+        bundle, bundle_summary = None, None
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            listing = _http_json(watch_url + "/debug/incidents")["incidents"]
+            if listing:
+                bundle_summary = listing[-1]  # oldest = the kill's bundle
+                bundle = _http_json(
+                    watch_url + f"/debug/incidents/{bundle_summary['id']}")
+                break
+            time.sleep(0.5)
 
         # ------------------------------------------------------- asserts
         problems = []
@@ -347,6 +390,53 @@ def run_drill(args) -> int:
                 problems.append(f"{url}: {used:.0f} KV blocks still in "
                                 "use after quiesce (pool leak)")
 
+        watchtower_stats = {"incidents": 0}
+        if bundle is None:
+            problems.append("watchtower produced no incident bundle for "
+                            "the SIGKILL")
+        else:
+            listing = _http_json(watch_url + "/debug/incidents")["incidents"]
+            watchtower_stats["incidents"] = len(listing)
+            watchtower_stats["bundle"] = {
+                "id": bundle["id"], "reason": bundle["reason"],
+                "n_traces": len(bundle.get("traces") or ())}
+            events = (bundle.get("router") or {}).get("events") or []
+            if not any(e.get("kind") == "ejection"
+                       and e.get("url") == kill_url for e in events):
+                problems.append(
+                    f"incident bundle {bundle['id']} does not name the "
+                    f"killed replica {kill_url} in its ejection events")
+            stitched = [t for t in bundle.get("traces") or ()
+                        if len(t.get("processes") or ()) >= 2]
+            if not stitched:
+                problems.append(
+                    f"incident bundle {bundle['id']} holds no stitched "
+                    "trace spanning router and replica processes")
+            else:
+                watchtower_stats["bundle"]["stitched_processes"] = \
+                    stitched[0]["processes"]
+            if "rules" not in (bundle.get("alerts") or {}):
+                problems.append(f"incident bundle {bundle['id']} carries "
+                                "no burn-rate alert state")
+            flight = bundle.get("flight") or {}
+            if "router" not in flight or not any(
+                    p.startswith("replica@") for p in flight):
+                problems.append(f"incident bundle {bundle['id']} is "
+                                "missing per-process flight snapshots")
+            # the forensics path end to end: the report tool must render
+            # this bundle to markdown without error
+            try:
+                from tools.incident_report import render
+                md = render(bundle)
+                if kill_url not in md:
+                    problems.append("incident_report markdown does not "
+                                    f"mention the killed replica "
+                                    f"{kill_url}")
+                watchtower_stats["bundle"]["report_chars"] = len(md)
+            except Exception as e:
+                problems.append(f"incident_report failed to render "
+                                f"bundle {bundle['id']}: {e!r}")
+
         artifact = {
             "metric": "chaos_serving",
             "fast": bool(args.fast),
@@ -367,6 +457,7 @@ def run_drill(args) -> int:
                 "affinity": aff,
             },
             "survivors": survivor_stats,
+            "watchtower": watchtower_stats,
             "router_sanitizer_violations": violations["router"],
             "problems": problems,
             "ok": not problems,

@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Render a watchtower incident bundle to a markdown timeline.
+
+The watchtower (``tpustack/serving/watchtower.py``) captures one
+correlated JSON artifact per incident — stitched cross-process traces,
+per-process flight snapshots, the router's structured
+ejection/breaker/failover history, autoscaler decisions, and the
+multi-window burn-rate alert state.  This tool turns one bundle into
+the markdown an operator actually reads in a postmortem doc:
+
+- header: what fired, when, and the fleet roster at capture time;
+- **timeline**: every timestamped event in the bundle (router fleet
+  events, autoscaler decisions and scale events, trace roots) merged
+  and sorted — the incident's story in order;
+- **alerts**: burn rates per severity/server/SLI over both windows;
+- **traces**: each stitched tree rendered with per-hop gap attribution
+  (``gap`` = wall time between processes no single process can see);
+- **flight**: each process's aggregates and most recent records.
+
+Usage::
+
+    python tools/incident_report.py --file incident-inc-123-1.json
+    python tools/incident_report.py --url http://localhost:8092   # latest
+    python tools/incident_report.py --url http://localhost:8092 --id inc-9-2
+    python tools/incident_report.py --file b.json --out incident.md
+
+Exit code: 0 on a rendered report, 2 on usage/fetch errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def _ts(unix: Optional[float]) -> str:
+    if unix is None:
+        return "—"
+    return time.strftime("%H:%M:%S", time.gmtime(unix)) + \
+        f".{int((unix % 1) * 1000):03d}Z"
+
+
+def _fmt(value) -> str:
+    if isinstance(value, float):
+        return f"{value:g}"
+    return str(value)
+
+
+# -------------------------------------------------------------- timeline
+def timeline_events(bundle: Dict) -> List[Dict]:
+    """Every timestamped event in the bundle, merged and sorted."""
+    events: List[Dict] = []
+    for e in (bundle.get("router") or {}).get("events", ()):
+        fields = {k: v for k, v in e.items()
+                  if k not in ("ts", "seq", "kind")}
+        events.append({"t": e.get("ts"), "source": "router",
+                       "what": e.get("kind", "?"),
+                       "detail": " ".join(f"{k}={_fmt(v)}"
+                                          for k, v in sorted(
+                                              fields.items()))})
+    scaler = bundle.get("autoscaler") or {}
+    for d in scaler.get("decisions", ()):
+        events.append({"t": d.get("t"), "source": "autoscaler",
+                       "what": f"decision:{d.get('direction', '?')}",
+                       "detail": f"reason={d.get('reason')} "
+                                 f"desired={d.get('desired')}"})
+    for e in scaler.get("events", ()):
+        events.append({"t": e.get("t"), "source": "autoscaler",
+                       "what": f"scale:{e.get('direction', '?')}",
+                       "detail": f"reason={e.get('reason')} "
+                                 f"url={e.get('url', '—')}"})
+    for tr in bundle.get("traces", ()):
+        roots = tr.get("tree") or [{}]
+        events.append({"t": roots[0].get("start_unix"), "source": "trace",
+                       "what": tr.get("status", "?"),
+                       "detail": f"{tr['trace_id'][:16]}… "
+                                 f"{tr.get('duration_s', 0):.3f}s across "
+                                 f"{'+'.join(tr.get('processes', ()))}"})
+    events.append({"t": bundle.get("captured_at"), "source": "watchtower",
+                   "what": "bundle-captured",
+                   "detail": f"reason={bundle.get('reason')}"})
+    return sorted((e for e in events if e["t"] is not None),
+                  key=lambda e: e["t"])
+
+
+# ---------------------------------------------------------------- traces
+def _render_span(node: Dict, trace_start: float, lines: List[str],
+                 depth: int = 0) -> None:
+    pad = "  " * depth
+    offset = (node.get("start_unix") or trace_start) - trace_start
+    hop = node.get("hop")
+    hop_note = ""
+    if hop:
+        hop_note = (f"  ⇠ hop {hop['from']} → {hop['to']} "
+                    f"(gap {hop['gap_s'] * 1000:.1f} ms)")
+    lines.append(
+        f"{pad}- `+{offset * 1000:7.1f} ms` **{node.get('name', '?')}** "
+        f"[{node.get('process', '?')}] "
+        f"{(node.get('duration_s') or 0) * 1000:.1f} ms "
+        f"{node.get('status', '?')}{hop_note}")
+    for child in node.get("children", ()):
+        _render_span(child, trace_start, lines, depth + 1)
+
+
+# ---------------------------------------------------------------- render
+def render(bundle: Dict) -> str:
+    lines: List[str] = []
+    add = lines.append
+    fleet = bundle.get("fleet") or {}
+    add(f"# Incident {bundle.get('id', '?')}")
+    add("")
+    add(f"- **captured**: {_ts(bundle.get('captured_at'))} "
+        f"(unix {bundle.get('captured_at')})")
+    add(f"- **reason**: `{bundle.get('reason')}`")
+    add(f"- **trigger**: `{json.dumps(bundle.get('trigger'))}`")
+    add(f"- **router**: {fleet.get('router')}")
+    replicas = fleet.get("replicas") or []
+    backends = fleet.get("backends") or {}
+    for url in replicas:
+        st = backends.get(url) or {}
+        add(f"  - {url}: {st.get('state', 'unknown')} "
+            f"(ejections={st.get('ejections', 0)})")
+    if fleet.get("autoscaler"):
+        add(f"- **autoscaler**: {fleet['autoscaler']}")
+
+    add("")
+    add("## Timeline")
+    add("")
+    add("| time | source | event | detail |")
+    add("|---|---|---|---|")
+    for e in timeline_events(bundle):
+        add(f"| {_ts(e['t'])} | {e['source']} | {e['what']} | "
+            f"{e['detail']} |")
+
+    add("")
+    add("## Burn-rate alert state")
+    add("")
+    alerts = bundle.get("alerts") or {}
+    active = alerts.get("active") or []
+    if active:
+        add("**Active:** " + ", ".join(
+            f"`{a['severity']}:{a['server']}:{a['kind']}`"
+            for a in active))
+    else:
+        add("No alert was active at capture time (the trigger was a "
+            "fleet event).")
+    add("")
+    add("| severity | server | SLI | burn (long) | burn (short) | "
+        "firing |")
+    add("|---|---|---|---|---|---|")
+    for rule in alerts.get("rules", ()):
+        for server, kinds in sorted(rule.get("states", {}).items()):
+            for kind, st in sorted(kinds.items()):
+                long_b = st.get("burn_long")
+                short_b = st.get("burn_short")
+                add(f"| {rule['severity']} (>{rule['threshold']}x) "
+                    f"| {server} | {kind} "
+                    f"| {'—' if long_b is None else f'{long_b:.2f}'} "
+                    f"({rule['long']['window']}) "
+                    f"| {'—' if short_b is None else f'{short_b:.2f}'} "
+                    f"({rule['short']['window']}) "
+                    f"| {'**YES**' if st.get('active') else 'no'} |")
+
+    add("")
+    add("## Stitched traces")
+    traces = bundle.get("traces") or []
+    if not traces:
+        add("")
+        add("No traces captured (no recent traffic at capture time).")
+    for tr in traces:
+        add("")
+        add(f"### `{tr['trace_id']}` — {tr.get('status')} "
+            f"{tr.get('duration_s', 0):.3f}s, "
+            f"{tr.get('n_spans')} spans across "
+            f"{', '.join(tr.get('processes', ()))}")
+        add("")
+        roots = tr.get("tree") or []
+        start = min((r.get("start_unix") or 0) for r in roots) \
+            if roots else 0.0
+        for root in roots:
+            _render_span(root, start, lines)
+
+    add("")
+    add("## Flight recorders")
+    for process, snap in sorted((bundle.get("flight") or {}).items()):
+        add("")
+        agg = snap.get("aggregates") or {}
+        add(f"### {process} (`{snap.get('server', '?')}`, "
+            f"{len(snap.get('records') or ())} records)")
+        if agg:
+            add("")
+            add("| aggregate | value |")
+            add("|---|---|")
+            for k, v in sorted(agg.items()):
+                add(f"| {k} | {_fmt(v)} |")
+        records = (snap.get("records") or [])[-8:]
+        if records:
+            add("")
+            add("Most recent records:")
+            add("")
+            for r in records:
+                fields = {k: v for k, v in r.items()
+                          if k not in ("ts", "seq", "kind")}
+                add(f"- `{_ts(r.get('ts'))}` **{r.get('kind')}** "
+                    + " ".join(f"{k}={_fmt(v)}"
+                               for k, v in sorted(fields.items())))
+
+    scaler = bundle.get("autoscaler")
+    if scaler:
+        add("")
+        add("## Autoscaler")
+        add("")
+        add(f"desired={scaler.get('desired')} "
+            f"actual={scaler.get('actual')}; recent decisions and scale "
+            f"events are on the timeline above.")
+    add("")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- CLI
+def _load(args) -> Optional[Dict]:
+    if args.file:
+        with open(args.file) as f:
+            return json.load(f)
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    if args.id:
+        with urllib.request.urlopen(f"{base}/debug/incidents/{args.id}",
+                                    timeout=10) as resp:
+            return json.loads(resp.read().decode())
+    with urllib.request.urlopen(base + "/debug/incidents",
+                                timeout=10) as resp:
+        listing = json.loads(resp.read().decode())["incidents"]
+    if not listing:
+        return None
+    with urllib.request.urlopen(
+            f"{base}/debug/incidents/{listing[0]['id']}",
+            timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def main(argv: List[str] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--file", help="saved incident-*.json bundle")
+    src.add_argument("--url", help="watchtower base URL (fetches the "
+                                   "newest bundle, or --id)")
+    p.add_argument("--id", help="incident id to fetch from --url")
+    p.add_argument("--out", help="write markdown here (default stdout)")
+    args = p.parse_args(argv)
+    try:
+        bundle = _load(args)
+    except Exception as e:
+        print(f"incident_report: cannot load bundle: {e}",
+              file=sys.stderr)
+        return 2
+    if bundle is None:
+        print("incident_report: the watchtower has no incidents",
+              file=sys.stderr)
+        return 2
+    md = render(bundle)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md)
+    else:
+        print(md)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -374,6 +374,12 @@ def _is_autoscaler(container) -> bool:
     return any("tpustack.serving.autoscaler" in a for a in argv)
 
 
+def _is_watchtower(container) -> bool:
+    argv = [str(a) for a in ((container.get("command") or [])
+                             + (container.get("args") or []))]
+    return any("tpustack.serving.watchtower" in a for a in argv)
+
+
 def _is_llm_server(container) -> bool:
     argv = [str(a) for a in ((container.get("command") or [])
                              + (container.get("args") or []))]
@@ -648,13 +654,80 @@ def _check_replicas_pins(errors: List[str], managed: Set[str],
                         "the fleet every reconcile")
 
 
+#: the only verbs a forensics observer may hold.  The watchtower talks
+#: plain HTTP to the fleet's debug surfaces — it needs NO Kubernetes API
+#: access at all; any write verb turns "can read the fleet's telemetry"
+#: into "can change the fleet", which defeats the design (losing the
+#: watchtower must lose forensics, never traffic).
+_READONLY_VERBS = {"get", "list", "watch"}
+
+
+def _check_watchtower_contract(errors: List[str], watchtowers, roles,
+                               bindings) -> None:
+    """The fleet watchtower's deployment contract (read-only observer):
+
+    - the discovery flag is pinned in the manifest:
+      TPUSTACK_WATCHTOWER_ROUTER_URL env present (unset constructs
+      nothing — a watchtower pod watching no one);
+    - its ServiceAccount holds NO write RBAC: every Role any RoleBinding
+      grants it must stay within get/list/watch, and cluster-scoped
+      roleRefs are rejected outright.  An unbound SA (no RoleBindings at
+      all) is the ideal shape — the watchtower never talks to the
+      Kubernetes API.
+    """
+    role_by_key = {(r["namespace"], r["name"]): r for r in roles}
+    for w in watchtowers:
+        where, container, ns = w["where"], w["container"], w["namespace"]
+        if _env_value(container, "TPUSTACK_WATCHTOWER_ROUTER_URL") is None:
+            errors.append(
+                f"{where}: watchtower container sets no "
+                "TPUSTACK_WATCHTOWER_ROUTER_URL — with the knob unset "
+                "the watchtower constructs nothing and watches no one")
+        sa = w["serviceAccountName"]
+        if not sa:
+            errors.append(
+                f"{where}: watchtower pod runs under the default "
+                "ServiceAccount — it needs a dedicated SA so the "
+                "read-only RBAC contract is checkable")
+            continue
+        for b in bindings:
+            if b["namespace"] != ns:
+                continue
+            if not any(s.get("kind") == "ServiceAccount"
+                       and s.get("name") == sa
+                       and s.get("namespace", ns) == ns
+                       for s in b["subjects"]):
+                continue
+            ref = b["roleRef"]
+            if ref.get("kind") != "Role":
+                errors.append(
+                    f"{b['where']}: watchtower ServiceAccount {sa!r} "
+                    f"bound to a {ref.get('kind')} — the read-only "
+                    "observer gets no cluster-scoped grants")
+                continue
+            role = role_by_key.get((ns, ref.get("name")))
+            if role is None:
+                continue
+            for rule in role["rules"]:
+                verbs = set(rule.get("verbs") or [])
+                extra = verbs - _READONLY_VERBS
+                if extra:
+                    errors.append(
+                        f"{role['where']}: watchtower Role grants write "
+                        f"verbs {sorted(extra)} on "
+                        f"{sorted(set(rule.get('resources') or []))} — "
+                        "the watchtower Deployment must stay read-only "
+                        f"(allowed: {sorted(_READONLY_VERBS)})")
+
+
 def lint(root: Path = None) -> List[str]:
     """Return a list of violation strings (empty = clean)."""
     root = Path(root) if root is not None else REPO / "cluster-config"
     errors: List[str] = []
     catalog = _catalog_metric_names()
     routers, services, deployments = [], [], []
-    autoscalers, roles, bindings, kustomizations = [], [], [], []
+    autoscalers, watchtowers = [], []
+    roles, bindings, kustomizations = [], [], []
     for path in sorted(root.rglob("*.yaml")):
         rel = path.relative_to(root).as_posix()
         if rel in SKIP_FILES:
@@ -722,6 +795,14 @@ def lint(root: Path = None) -> List[str]:
                             "serviceAccountName": tmpl.get(
                                 "spec", {}).get("serviceAccountName"),
                         })
+                    if _is_watchtower(container):
+                        watchtowers.append({
+                            "where": where,
+                            "container": container,
+                            "namespace": meta.get("namespace"),
+                            "serviceAccountName": tmpl.get(
+                                "spec", {}).get("serviceAccountName"),
+                        })
             if kind == "Deployment":
                 _check_deployment(where, doc, errors)
                 tmpl = doc["spec"]["template"]
@@ -744,6 +825,7 @@ def lint(root: Path = None) -> List[str]:
     _check_router_contract(errors, routers, services, deployments)
     _check_autoscaler_contract(errors, autoscalers, roles, bindings,
                                deployments, kustomizations)
+    _check_watchtower_contract(errors, watchtowers, roles, bindings)
     return errors
 
 

@@ -59,6 +59,12 @@ UNIT_SUFFIXES = (
     "step",
     # budget gauges (remaining router failover attempts, router.py)
     "retries",
+    # boolean alert gauges (1 = firing, 0 = quiet; the watchtower's
+    # multi-window burn-rate alerts, serving/watchtower.py)
+    "active",
+    # scrape-target accounting (fleet members the watchtower tracks,
+    # serving/watchtower.py)
+    "targets",
 )
 _RESERVED_LABELS = {"le", "quantile"}
 

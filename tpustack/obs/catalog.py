@@ -561,6 +561,38 @@ CATALOG: Tuple[MetricSpec, ...] = (
                buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
                unit="seconds"),
 
+    # ---- fleet watchtower (tpustack.serving.watchtower; constructed
+    # only when TPUSTACK_WATCHTOWER_ROUTER_URL is set) ----
+    MetricSpec("tpustack_watchtower_alert_active", "gauge",
+               "1 while the multi-window burn-rate alert for this "
+               "(severity, server, SLI kind) is firing — the burn "
+               "exceeds the severity's threshold over BOTH its long and "
+               "short windows (page: 14.4x over 1h AND 5m; ticket: 6x "
+               "over 6h AND 30m) — else 0.  The live, in-stack twin of "
+               "the slo-rules.yaml Prometheus alerts.",
+               ("severity", "server", "kind"), unit="active"),
+    MetricSpec("tpustack_watchtower_burn_rate_ratio", "gauge",
+               "Error-budget burn rate over each alert window "
+               "((1 - SLI) / (1 - SLO); 1.0 = burning exactly the "
+               "budget).  Absent while a window has no traffic.",
+               ("severity", "server", "kind", "window"), unit="ratio"),
+    MetricSpec("tpustack_watchtower_fleet_targets", "gauge",
+               "Scrape targets the watchtower currently tracks, by role "
+               "(router | replica | autoscaler).  replica count dropping "
+               "without an autoscaler decision is itself an incident "
+               "signal.", ("role",), unit="targets"),
+    MetricSpec("tpustack_watchtower_incidents_total", "counter",
+               "Incident bundles captured, by trigger reason (alert | "
+               "ejection | breaker | unhealthy_floor).  Bounded by the "
+               "capture cooldown — a flapping fleet yields one bundle "
+               "per cooldown window, not one per flap.",
+               ("reason",), unit="total"),
+    MetricSpec("tpustack_watchtower_scrape_errors_total", "counter",
+               "Fleet scrape failures, by target role.  A burst here "
+               "means the watchtower is partially blind — alert state "
+               "degrades to whatever targets still answer.",
+               ("role",), unit="total"),
+
     # ---- black-box prober (tools/probe.py, the prober CronJob sidecar) ----
     MetricSpec("tpustack_probe_attempts_total", "counter",
                "Prober checks run, by target (llm|sd|graph), check "

@@ -1,0 +1,374 @@
+"""Fleet watchtower primitives: trace stitching, online burn-rate
+alerting, and the incident-bundle store.
+
+Every observability surface in the stack is per-process — each replica
+keeps its own ``/debug/traces`` and ``/debug/flight``, the burn-rate
+math lives offline in ``tools/slo_report.py``, and when a replica dies
+the evidence (router ejection, replica post-mortem, autoscaler hold)
+is scattered across processes.  This module holds the three pure
+pieces the watchtower control loop (:mod:`tpustack.serving.watchtower`)
+composes:
+
+- :func:`stitch` — join per-process span lists for ONE trace id into a
+  single cross-process tree (the Dapper join).  The router forwards
+  ``traceparent`` built from its own root span, so a replica root's
+  ``parent_id`` IS a router span id: concatenating the span lists and
+  re-nesting with :func:`tpustack.obs.trace._span_tree` produces one
+  tree.  Each cross-process edge is annotated with per-hop gap
+  attribution: ``gap_s`` (parent span duration minus child root
+  duration — the network + connect + queue time neither process can
+  see alone) and ``offset_s`` (child start minus parent start).
+- :class:`BurnRateEngine` — the exact ``tools/slo_report.py`` math
+  (``parse_exposition``/``delta``/``report``) applied to a retained
+  ring of live fleet scrapes, evaluated against the canonical
+  multi-window alert rules: page when the burn exceeds 14.4 over BOTH
+  the 1 h and 5 m windows, ticket when it exceeds 6 over both 6 h and
+  30 m (the Google SRE-workbook shape, mirroring
+  ``cluster-config/apps/monitoring/slo-rules.yaml``).  Windows scale by
+  ``TPUSTACK_WATCHTOWER_WINDOW_SCALE`` so a chaos drill can watch an
+  alert resolve in seconds; while the retained history is shorter than
+  a window the full history IS the window (degraded, flagged in the
+  state) rather than silently reporting no data mid-incident.
+- :class:`IncidentStore` — a bounded ring of correlated incident
+  bundles, in memory always and mirrored to an on-disk
+  ``incident-*.json`` ring (atomic tmp+rename, oldest pruned) when
+  ``TPUSTACK_WATCHTOWER_INCIDENT_DIR`` is set, so the evidence
+  survives the watchtower pod.
+
+Everything here is dependency-free and synchronous; nothing does I/O
+except ``IncidentStore.add`` (best-effort disk mirror).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from tpustack.obs.trace import _span_tree
+from tpustack.utils import get_logger, knobs
+
+log = get_logger("obs.watchtower")
+
+#: canonical multi-window burn-rate alert rules (Google SRE workbook;
+#: mirror of cluster-config/apps/monitoring/slo-rules.yaml).  An alert is
+#: active only when the burn exceeds the threshold over BOTH windows:
+#: the long window proves it matters, the short window proves it is
+#: still happening.
+ALERT_RULES: Tuple[Dict, ...] = (
+    {"severity": "page", "threshold": 14.4,
+     "long_s": 3600.0, "short_s": 300.0,
+     "long_name": "1h", "short_name": "5m"},
+    {"severity": "ticket", "threshold": 6.0,
+     "long_s": 21600.0, "short_s": 1800.0,
+     "long_name": "6h", "short_name": "30m"},
+)
+
+#: the metric families the burn-rate math actually reads — history
+#: entries are filtered to these so six hours of 5-second scrapes stays
+#: a few MB, not the whole exposition times 4320
+_SLI_FAMILIES = (
+    "tpustack_http_requests_total",
+    "tpustack_http_request_latency_seconds_bucket",
+    "tpustack_http_request_latency_seconds_count",
+)
+
+
+# ---------------------------------------------------------------- stitching
+def stitch(trace_id: str, process_records: List[Dict]) -> Optional[Dict]:
+    """Join per-process trace records for ``trace_id`` into one tree.
+
+    ``process_records`` is ``[{"process": name, "record": record}, ...]``
+    where each ``record`` is a ``GET /debug/traces/{id}`` payload (flat
+    ``spans`` with parent links).  Returns the stitched record — flat
+    ``spans`` (each stamped with its ``process``), the nested ``tree``
+    with cross-process ``hop`` annotations, and rollup fields — or None
+    when no process had any spans for the trace.
+    """
+    spans: List[Dict] = []
+    seen: set = set()
+    processes: List[str] = []
+    for pr in process_records:
+        record = pr.get("record") or {}
+        added = False
+        for s in record.get("spans", ()):
+            if s.get("span_id") in seen:
+                continue  # the same process polled twice
+            seen.add(s.get("span_id"))
+            spans.append(dict(s, process=pr.get("process", "?")))
+            added = True
+        if added:
+            processes.append(pr.get("process", "?"))
+    if not spans:
+        return None
+    tree = _span_tree(spans)
+    for root in tree:
+        _annotate_hops(root)
+    statuses = {s.get("status") for s in spans}
+    return {
+        "trace_id": trace_id,
+        "processes": processes,
+        "n_spans": len(spans),
+        "n_roots": len(tree),
+        "duration_s": max((r.get("duration_s") or 0.0) for r in tree),
+        "status": ("error" if "error" in statuses else "ok"),
+        "spans": spans,
+        "tree": tree,
+    }
+
+
+def _annotate_hops(node: Dict) -> None:
+    """Stamp each child that lives in a DIFFERENT process than its parent
+    with the per-hop gap attribution: ``gap_s`` is the parent span's
+    duration minus the child root's — wall time spent on the wire, in
+    connect(), or queued upstream, which neither process's own spans can
+    account for — and ``offset_s`` is how long after the parent started
+    the child began (one-way network + queue, assuming synced clocks)."""
+    for child in node.get("children", ()):
+        if child.get("process") != node.get("process"):
+            gap = ((node.get("duration_s") or 0.0)
+                   - (child.get("duration_s") or 0.0))
+            child["hop"] = {
+                "from": node.get("process"),
+                "to": child.get("process"),
+                "gap_s": round(max(0.0, gap), 6),
+                "offset_s": round((child.get("start_unix") or 0.0)
+                                  - (node.get("start_unix") or 0.0), 6),
+            }
+        _annotate_hops(child)
+
+
+def merge_scrapes(scrapes: List[Dict]) -> Dict:
+    """Sum parsed expositions key-wise — counters and cumulative buckets
+    across replicas of the same ``server`` add exactly the way a
+    Prometheus ``sum by`` would, giving ONE fleet-level sample set the
+    SLI functions read unchanged."""
+    merged: Dict = {}
+    for samples in scrapes:
+        for key, value in samples.items():
+            merged[key] = merged.get(key, 0.0) + value
+    return merged
+
+
+# ------------------------------------------------------------- burn rates
+class BurnRateEngine:
+    """Multi-window burn-rate alerting over a retained scrape history.
+
+    ``observe(now, samples)`` feeds one merged fleet scrape per tick;
+    ``evaluate(now)`` computes per-(server, SLI-kind) burn rates over
+    every rule window via the exact ``tools/slo_report.py`` delta math
+    and returns the full alert state.  Thread-safe (the control loop
+    feeds while HTTP handlers read)."""
+
+    def __init__(self, slos: Optional[Dict] = None,
+                 window_scale: float = 1.0):
+        from tools import slo_report
+
+        self._slo = slo_report
+        self.slos = dict(slos if slos is not None else slo_report.SLOS)
+        self.window_scale = max(1e-6, float(window_scale))
+        self.rules = [dict(r, long_s=r["long_s"] * self.window_scale,
+                           short_s=r["short_s"] * self.window_scale)
+                      for r in ALERT_RULES]
+        self._retain_s = max(r["long_s"] for r in self.rules) * 1.25
+        self._lock = threading.Lock()
+        self._history: deque = deque()  # (ts, samples) — guarded-by: _lock
+
+    def observe(self, now: float, samples: Dict) -> None:
+        kept = {k: v for k, v in samples.items() if k[0] in _SLI_FAMILIES}
+        with self._lock:
+            self._history.append((now, kept))
+            cutoff = now - self._retain_s
+            while self._history and self._history[0][0] < cutoff:
+                self._history.popleft()
+
+    def _baseline_locked(self, now: float, window_s: float) -> Tuple:
+        """The scrape from ``window_s`` ago: newest sample at or before
+        ``now - window_s``; degrades to the OLDEST retained sample (the
+        full history becomes the window) while history is still short."""
+        target = now - window_s
+        chosen = self._history[0]
+        for entry in self._history:
+            if entry[0] <= target:
+                chosen = entry
+            else:
+                break
+        return chosen, chosen[0] > target  # (entry, degraded?)
+
+    def _window_report(self, latest: Dict, baseline: Dict) -> Dict:
+        windowed = self._slo.delta(latest, baseline)
+        out: Dict = {}
+        for server, entry in self._slo.report(windowed,
+                                              self.slos).items():
+            out[server] = {
+                kind: {"burn_rate": r["burn_rate"], "sli": r["sli"],
+                       "events": r["events"]}
+                for kind, r in entry.items()}
+        return out
+
+    def evaluate(self, now: float) -> Dict:
+        """Full alert state: per-rule, per-server, per-SLI-kind burn
+        rates over both windows plus the active set."""
+        with self._lock:
+            if not self._history:
+                return {"evaluated_at": now, "samples": 0, "span_s": 0.0,
+                        "window_scale": self.window_scale,
+                        "rules": [], "active": []}
+            history = list(self._history)
+            latest_ts, latest = history[-1]
+            baselines = {}
+            for rule in self.rules:
+                for win in ("long_s", "short_s"):
+                    (ts, samples), degraded = self._baseline_locked(
+                        now, rule[win])
+                    baselines[(rule["severity"], win)] = (
+                        ts, samples, degraded)
+        rules_out: List[Dict] = []
+        active: List[Dict] = []
+        for rule in self.rules:
+            per_window = {}
+            for win, name_key in (("long_s", "long_name"),
+                                  ("short_s", "short_name")):
+                ts, samples, degraded = baselines[(rule["severity"], win)]
+                per_window[win] = {
+                    "window": rule[name_key],
+                    "window_s": rule[win],
+                    "actual_span_s": round(latest_ts - ts, 3),
+                    "degraded": degraded,
+                    "report": self._window_report(latest, samples),
+                }
+            states: Dict[str, Dict] = {}
+            for server in self.slos:
+                states[server] = {}
+                for kind in ("availability", "latency"):
+                    burns = {}
+                    for win in ("long_s", "short_s"):
+                        rep = per_window[win]["report"].get(server, {})
+                        burns[win] = (rep.get(kind) or {}).get("burn_rate")
+                    is_active = all(
+                        b is not None and b > rule["threshold"]
+                        for b in burns.values())
+                    states[server][kind] = {
+                        "burn_long": burns["long_s"],
+                        "burn_short": burns["short_s"],
+                        "active": is_active,
+                    }
+                    if is_active:
+                        active.append({"severity": rule["severity"],
+                                       "server": server, "kind": kind})
+            rules_out.append({
+                "severity": rule["severity"],
+                "threshold": rule["threshold"],
+                "long": {k: per_window["long_s"][k]
+                         for k in ("window", "window_s", "actual_span_s",
+                                   "degraded")},
+                "short": {k: per_window["short_s"][k]
+                          for k in ("window", "window_s", "actual_span_s",
+                                    "degraded")},
+                "states": states,
+            })
+        return {
+            "evaluated_at": now,
+            "samples": len(history),
+            "span_s": round(latest_ts - history[0][0], 3),
+            "window_scale": self.window_scale,
+            "rules": rules_out,
+            "active": active,
+        }
+
+
+# --------------------------------------------------------- incident store
+class IncidentStore:
+    """Bounded ring of incident bundles: always in memory, mirrored to an
+    on-disk ``incident-*.json`` ring when a directory is configured.
+
+    Disk writes are atomic (tmp + ``os.replace``) and best-effort by the
+    same contract as flight-recorder dumps: a full disk logs a warning
+    and the in-memory copy still serves — the evidence writer must never
+    be the thing that takes the watchtower down."""
+
+    def __init__(self, dump_dir: str = "", keep: Optional[int] = None):
+        if keep is None:
+            keep = knobs.get_int("TPUSTACK_WATCHTOWER_INCIDENT_KEEP")
+        self.dump_dir = dump_dir
+        self.keep = max(1, int(keep))
+        self._lock = threading.Lock()
+        self._bundles: deque = deque(maxlen=self.keep)  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+
+    def add(self, bundle: Dict) -> Dict:
+        """Stamp, retain, and (best-effort) persist one bundle; returns
+        the stamped bundle (``id``, ``captured_at``, ``path``)."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        bundle = dict(bundle)
+        bundle.setdefault("captured_at", time.time())
+        bundle["id"] = f"inc-{os.getpid()}-{seq}"
+        bundle["path"] = self._persist(bundle)
+        with self._lock:
+            self._bundles.append(bundle)
+        return bundle
+
+    def _persist(self, bundle: Dict) -> Optional[str]:
+        if not self.dump_dir:
+            return None
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            path = os.path.join(self.dump_dir, f"incident-{bundle['id']}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(bundle, f)
+            os.replace(tmp, path)  # pollers never see a half-written bundle
+            self._prune_disk()
+            return path
+        except Exception:
+            log.warning("incident bundle persist failed (id=%s)",
+                        bundle.get("id"), exc_info=True)
+            return None
+
+    def _prune_disk(self) -> None:
+        entries = []
+        for name in os.listdir(self.dump_dir):
+            if name.startswith("incident-") and name.endswith(".json"):
+                p = os.path.join(self.dump_dir, name)
+                try:
+                    entries.append((os.stat(p).st_mtime, p))
+                except OSError:
+                    continue
+        entries.sort(reverse=True)
+        for _, p in entries[self.keep:]:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def list(self) -> List[Dict]:
+        """Newest-first bundle summaries (the ``GET /debug/incidents``
+        payload body)."""
+        with self._lock:
+            bundles = list(self._bundles)
+        return [{
+            "id": b["id"],
+            "captured_at": b.get("captured_at"),
+            "reason": b.get("reason"),
+            "trigger": b.get("trigger"),
+            "n_traces": len(b.get("traces") or ()),
+            "processes": sorted(b.get("flight") or ()),
+            "alerts_active": len((b.get("alerts") or {}).get("active", ())),
+            "path": b.get("path"),
+        } for b in reversed(bundles)]
+
+    def get(self, incident_id: str) -> Optional[Dict]:
+        with self._lock:
+            for b in self._bundles:
+                if b["id"] == incident_id:
+                    return b
+        return None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._bundles)

@@ -63,6 +63,7 @@ from aiohttp import web
 from tpustack import sanitize
 from tpustack.obs import accounting as obs_accounting
 from tpustack.obs import catalog as obs_catalog
+from tpustack.obs import flight as obs_flight
 from tpustack.obs import http as obs_http
 from tpustack.obs import trace as obs_trace
 from tpustack.serving.resilience import ResilienceManager, shed_headers
@@ -162,6 +163,14 @@ class Router:
         self.resilience = ResilienceManager("router", registry,
                                             concurrency=64, env=env,
                                             expected_service_s=0.5)
+        # structured fleet-event log (kind=ejection|breaker|failover):
+        # the watchtower ingests these from /debug/flight instead of
+        # parsing logs.  Safe to call record() under _lock — the
+        # recorder's own lock is outside the sanitizer registry.
+        self.flight = obs_flight.register(obs_flight.FlightRecorder(
+            "router", meta={"spec": spec,
+                            "eject_after": self.eject_after,
+                            "retry_budget": self.retry_budget}))
         self._session = None  # aiohttp.ClientSession, created on the loop
         self._lock = threading.Lock()
         # url -> {"state", "fails", "opened_at", "ejections"}; mutated by
@@ -294,6 +303,8 @@ class Router:
                 if st["state"] != HEALTHY:
                     log.info("backend %s re-admitted (half-open probe ok)",
                              url)
+                    self.flight.record("breaker", url=url, to="closed",
+                                       via="probe")
                 st["state"] = HEALTHY
                 st["fails"] = 0
                 self.metrics["tpustack_router_backend_healthy_state"].labels(
@@ -314,6 +325,11 @@ class Router:
                 backend=url).set(0)
             log.warning("backend %s ejected (circuit open, half-open probe "
                         "in %.1fs)", url, self.half_open_s)
+            self.flight.record("ejection", url=url,
+                               ejections=st["ejections"],
+                               half_open_s=self.half_open_s)
+            self.flight.record("breaker", url=url, to="open",
+                               via="ejection")
         st["state"] = OPEN
         st["opened_at"] = time.monotonic()
         st["fails"] = 0
@@ -340,6 +356,8 @@ class Router:
                 st["state"] = HEALTHY
                 self.metrics["tpustack_router_backend_healthy_state"].labels(
                     backend=url).set(1)
+                self.flight.record("breaker", url=url, to="closed",
+                                   via="success")
 
     # ------------------------------------------------------------ affinity
     def affinity_key(self, prompt: str) -> str:
@@ -486,10 +504,13 @@ class Router:
         with self._lock:
             self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
 
-    def _note_failover(self, reason: str, budget_left: int) -> None:
+    def _note_failover(self, reason: str, budget_left: int,
+                       from_url: str = "") -> None:
         self.metrics["tpustack_router_failover_total"].labels(
             reason=reason).inc()
         self.metrics["tpustack_router_retry_budget_retries"].set(budget_left)
+        self.flight.record("failover", reason=reason,
+                           budget_left=budget_left, from_url=from_url)
         with self._lock:
             self._failovers[reason] = self._failovers.get(reason, 0) + 1
 
@@ -577,7 +598,7 @@ class Router:
                 break
             budget -= 1
             tried.add(target)
-            self._note_failover(spill, budget)
+            self._note_failover(spill, budget, from_url=target)
             if self.retry_jitter_s > 0:
                 await asyncio.sleep(random.uniform(0, self.retry_jitter_s))
 
@@ -720,6 +741,7 @@ class Router:
                                              work_endpoints=work),
                          self.resilience.middleware(work)])
         obs_http.add_debug_trace_routes(app, self.tracer)
+        obs_http.add_debug_flight_routes(app, self.flight)
         app.router.add_get("/health", self.health)
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/readyz", self.readyz)

@@ -1,0 +1,531 @@
+"""Fleet watchtower: the control-loop service that correlates the
+fleet's per-process observability surfaces.
+
+Same shape as the autoscaler (:mod:`tpustack.serving.autoscaler`): a
+plain class with a directly-callable ``tick()``, a daemon loop thread,
+and a small aiohttp debug app.  Each tick it
+
+1. **discovers the fleet** from the router's backend registry
+   (``GET /debug/router``) — replicas come and go under the autoscaler
+   and the watchtower follows with no config of its own;
+2. **scrapes** ``/metrics`` from router + replicas (+ autoscaler when
+   ``TPUSTACK_WATCHTOWER_AUTOSCALER_URL`` is set), merges the
+   expositions fleet-wise, and feeds the
+   :class:`~tpustack.obs.watchtower.BurnRateEngine` — the exact
+   ``tools/slo_report.py`` math over live multi-window deltas,
+   exported as ``tpustack_watchtower_alert_active`` /
+   ``_burn_rate_ratio`` and served on ``GET /debug/alerts``;
+3. **watches for fleet events** — new router flight-recorder events of
+   kind ``ejection`` (satellite of this PR: the router records
+   ejection/breaker/failover transitions structurally), burn-rate
+   alerts transitioning inactive → active, and autoscaler
+   ``unhealthy_floor`` decisions — and on any of them (cooldown
+   permitting) captures one **incident bundle**: the K slowest/errored
+   cross-process stitched traces, every process's flight snapshot,
+   the router's ejection/breaker/failover history, the autoscaler's
+   recent decisions, and the full alert state, retained in the bounded
+   :class:`~tpustack.obs.watchtower.IncidentStore` ring and served on
+   ``GET /debug/incidents``.
+
+On-demand stitching lives on ``GET /debug/traces/{trace_id}``: the
+watchtower fans the id out to every process's ``/debug/traces/{id}``
+and returns the joined tree with per-hop gap attribution — the Dapper
+join, done at read time with no collection pipeline.
+
+The watchtower only ever reads (GET everywhere, no admin endpoints, no
+RBAC writes — tpulint TPL601 enforces the read-only ServiceAccount on
+its Deployment); losing it loses forensics, never traffic.
+
+Bisection contract: ``TPUSTACK_WATCHTOWER_ROUTER_URL`` unset/empty
+constructs NOTHING (:func:`maybe_from_env` returns None).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+from tpustack import sanitize
+from tpustack.obs import catalog as obs_catalog
+from tpustack.obs import http as obs_http
+from tpustack.obs.watchtower import (BurnRateEngine, IncidentStore,
+                                     merge_scrapes, stitch)
+from tpustack.serving.autoscaler import _fetch_json
+from tpustack.utils import get_logger, knobs
+
+log = get_logger("serving.watchtower")
+
+#: router flight-event kinds the watchtower ingests as incident evidence
+FLEET_EVENT_KINDS = ("ejection", "breaker", "failover")
+
+
+def _fetch_text(url: str, timeout: float = 5.0) -> str:
+    req = urllib.request.Request(url)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+class Watchtower:
+    """See the module docstring; construct via :func:`maybe_from_env`
+    in production, directly in tests."""
+
+    def __init__(self, router_url: str, autoscaler_url: str = "",
+                 registry=None, env=None):
+        from tools import slo_report
+
+        self._slo = slo_report
+        self.router_url = router_url.rstrip("/")
+        self.autoscaler_url = (autoscaler_url or "").rstrip("/")
+        self.interval_s = max(0.05, knobs.get_float(
+            "TPUSTACK_WATCHTOWER_INTERVAL_S", env=env))
+        self.cooldown_s = max(0.0, knobs.get_float(
+            "TPUSTACK_WATCHTOWER_INCIDENT_COOLDOWN_S", env=env))
+        self.traces_per_bundle = max(1, knobs.get_int(
+            "TPUSTACK_WATCHTOWER_TRACES_PER_BUNDLE", env=env))
+        self.engine = BurnRateEngine(window_scale=knobs.get_float(
+            "TPUSTACK_WATCHTOWER_WINDOW_SCALE", env=env))
+        self.store = IncidentStore(
+            dump_dir=knobs.get_str(
+                "TPUSTACK_WATCHTOWER_INCIDENT_DIR", env=env).strip(),
+            keep=knobs.get_int(
+                "TPUSTACK_WATCHTOWER_INCIDENT_KEEP", env=env))
+        self._registry = registry
+        self.metrics = obs_catalog.build(registry)
+        self.resilience = None  # read-only service: nothing to drain
+        self._lock = threading.Lock()
+        self._replicas: List[str] = []  # guarded-by: _lock
+        self._last_tick: Optional[Dict] = None  # guarded-by: _lock
+        # control-thread-only trigger bookkeeping (benign racy debug reads)
+        self._flight_seq: Dict[str, int] = {}  # per-process last-seen seq
+        self._flight_primed = False  # skip pre-start history on first tick
+        self._active_alerts: set = set()
+        self._autoscaler_last_t = time.time()  # pre-start decisions are history
+        self._last_capture_at = -float("inf")
+        self._ticks = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        sanitize.install_guards(self)
+        log.info("watchtower up: router=%s autoscaler=%s interval=%.2fs "
+                 "window_scale=%g incident_dir=%s",
+                 self.router_url, self.autoscaler_url or "(none)",
+                 self.interval_s, self.engine.window_scale,
+                 self.store.dump_dir or "(memory)")
+
+    # ------------------------------------------------------------- scraping
+    def _scrape_error(self, role: str, url: str, what: str,
+                      exc: Exception) -> None:
+        log.debug("scrape %s %s failed (%s): %s", what, url, role, exc)
+        self.metrics["tpustack_watchtower_scrape_errors_total"] \
+            .labels(role=role).inc()
+
+    def discover(self) -> Optional[Dict]:
+        """Fleet roster from the router's backend registry, or None when
+        the router is unreachable (a blind watchtower keeps its last
+        roster and alert state — it must not forget an incident because
+        the incident also took the router)."""
+        try:
+            dbg = _fetch_json(self.router_url + "/debug/router", timeout=5)
+        except Exception as exc:
+            self._scrape_error("router", self.router_url, "/debug/router",
+                               exc)
+            return None
+        replicas = sorted((dbg.get("backends") or {}).keys())
+        with self._lock:
+            self._replicas = replicas
+        return dbg
+
+    def targets(self) -> List[Tuple[str, str]]:
+        """``[(role, base_url), ...]`` — router first, then replicas,
+        then the autoscaler when configured."""
+        with self._lock:
+            replicas = list(self._replicas)
+        out = [("router", self.router_url)]
+        out += [("replica", u) for u in replicas]
+        if self.autoscaler_url:
+            out.append(("autoscaler", self.autoscaler_url))
+        return out
+
+    def _scrape_fleet_metrics(self) -> Tuple[Dict, int]:
+        scrapes, ok = [], 0
+        for role, url in self.targets():
+            try:
+                text = _fetch_text(url + "/metrics", timeout=5)
+            except Exception as exc:
+                self._scrape_error(role, url, "/metrics", exc)
+                continue
+            scrapes.append(self._slo.parse_exposition(text))
+            ok += 1
+        return merge_scrapes(scrapes), ok
+
+    # ------------------------------------------------------------ stitching
+    def _trace_processes(self) -> List[Tuple[str, str]]:
+        """Processes that expose ``/debug/traces`` (the autoscaler keeps
+        no tracer)."""
+        return [(role if role == "router" else f"{role}@{url}", url)
+                for role, url in self.targets() if role != "autoscaler"]
+
+    def stitch_trace(self, trace_id: str) -> Optional[Dict]:
+        """Fan ``trace_id`` out to every process and join the span trees
+        (blocking; HTTP handlers call it via an executor)."""
+        records = []
+        for process, url in self._trace_processes():
+            try:
+                rec = _fetch_json(f"{url}/debug/traces/{trace_id}",
+                                  timeout=5)
+            except Exception as exc:
+                # 404 = the request never touched this process; anything
+                # else still only narrows the stitch, never fails it
+                log.debug("no trace %s from %s: %s", trace_id, process, exc)
+                continue
+            records.append({"process": process, "record": rec})
+        return stitch(trace_id, records)
+
+    def _interesting_trace_ids(self) -> List[str]:
+        """K trace ids worth bundling: errored first (newest first),
+        topped up with the router's slowest."""
+        try:
+            summary = _fetch_json(self.router_url + "/debug/traces",
+                                  timeout=5)
+        except Exception as exc:
+            self._scrape_error("router", self.router_url, "/debug/traces",
+                               exc)
+            return []
+        recent = summary.get("recent") or []
+        slowest = summary.get("slowest") or []
+        ids: List[str] = []
+        for s in reversed(recent):  # newest errors are the incident's
+            if s.get("status") == "error" and s["trace_id"] not in ids:
+                ids.append(s["trace_id"])
+        for s in slowest:
+            if s["trace_id"] not in ids:
+                ids.append(s["trace_id"])
+        return ids[: self.traces_per_bundle]
+
+    # ------------------------------------------------------- fleet events
+    def _poll_flight_events(self) -> List[Dict]:
+        """New (seq beyond last-seen) router flight events of the fleet
+        kinds.  The first poll only primes the seq cursor: events from
+        before the watchtower existed are history, not incidents."""
+        try:
+            snap = _fetch_json(self.router_url + "/debug/flight?n=256",
+                               timeout=5)
+        except Exception as exc:
+            self._scrape_error("router", self.router_url, "/debug/flight",
+                               exc)
+            return []
+        records = snap.get("records") or []
+        last = self._flight_seq.get("router", -1)
+        fresh = [r for r in records
+                 if r.get("seq", 0) > last
+                 and r.get("kind") in FLEET_EVENT_KINDS]
+        if records:
+            self._flight_seq["router"] = max(
+                last, max(r.get("seq", 0) for r in records))
+        if not self._flight_primed:
+            self._flight_primed = True
+            return []
+        return fresh
+
+    def _poll_autoscaler_decisions(self) -> List[Dict]:
+        """New ``unhealthy_floor`` holds since the last tick."""
+        if not self.autoscaler_url:
+            return []
+        try:
+            dbg = _fetch_json(self.autoscaler_url + "/debug/autoscaler",
+                              timeout=5)
+        except Exception as exc:
+            self._scrape_error("autoscaler", self.autoscaler_url,
+                               "/debug/autoscaler", exc)
+            return []
+        fresh = [d for d in (dbg.get("decisions") or [])
+                 if d.get("reason") == "unhealthy_floor"
+                 and (d.get("t") or 0) > self._autoscaler_last_t]
+        if fresh:
+            self._autoscaler_last_t = max(d["t"] for d in fresh)
+        return fresh
+
+    # ------------------------------------------------------------- alerting
+    def _export_alert_metrics(self, state: Dict, n_replicas: int) -> None:
+        m = self.metrics
+        m["tpustack_watchtower_fleet_targets"].labels(role="router").set(1)
+        m["tpustack_watchtower_fleet_targets"].labels(
+            role="replica").set(n_replicas)
+        m["tpustack_watchtower_fleet_targets"].labels(
+            role="autoscaler").set(1 if self.autoscaler_url else 0)
+        for rule in state.get("rules", ()):
+            sev = rule["severity"]
+            for server, kinds in rule.get("states", {}).items():
+                for kind, st in kinds.items():
+                    m["tpustack_watchtower_alert_active"].labels(
+                        severity=sev, server=server, kind=kind).set(
+                            1 if st["active"] else 0)
+                    for win_key, win_name in (("burn_long",
+                                               rule["long"]["window"]),
+                                              ("burn_short",
+                                               rule["short"]["window"])):
+                        if st[win_key] is not None:
+                            m["tpustack_watchtower_burn_rate_ratio"].labels(
+                                severity=sev, server=server, kind=kind,
+                                window=win_name).set(st[win_key])
+
+    # ------------------------------------------------------------- bundles
+    def capture_bundle(self, reason: str, trigger: Dict) -> Dict:
+        """Snapshot one correlated incident bundle (blocking scrapes of
+        the whole fleet) and retain it."""
+        now = time.time()
+        fleet_dbg = None
+        try:
+            fleet_dbg = _fetch_json(self.router_url + "/debug/router",
+                                    timeout=5)
+        except Exception as exc:
+            self._scrape_error("router", self.router_url, "/debug/router",
+                               exc)
+        traces = []
+        for tid in self._interesting_trace_ids():
+            stitched = self.stitch_trace(tid)
+            if stitched is not None:
+                traces.append(stitched)
+        flight: Dict[str, Dict] = {}
+        for process, url in self._trace_processes():
+            try:
+                flight[process] = _fetch_json(url + "/debug/flight",
+                                              timeout=5)
+            except Exception as exc:
+                # a dead replica IS the incident — note it and move on
+                log.debug("no flight snapshot from %s: %s", process, exc)
+                continue
+        router_events = []
+        router_flight = flight.get("router") or {}
+        for r in router_flight.get("records", ()):
+            if r.get("kind") in FLEET_EVENT_KINDS:
+                router_events.append(r)
+        autoscaler = None
+        if self.autoscaler_url:
+            try:
+                dbg = _fetch_json(self.autoscaler_url + "/debug/autoscaler",
+                                  timeout=5)
+                autoscaler = {"desired": dbg.get("desired"),
+                              "actual": dbg.get("actual"),
+                              "decisions": (dbg.get("decisions") or [])[-16:],
+                              "events": (dbg.get("events") or [])[-16:]}
+            except Exception as exc:
+                self._scrape_error("autoscaler", self.autoscaler_url,
+                                   "/debug/autoscaler", exc)
+        with self._lock:
+            replicas = list(self._replicas)
+        bundle = self.store.add({
+            "captured_at": now,
+            "reason": reason,
+            "trigger": trigger,
+            "fleet": {
+                "router": self.router_url,
+                "replicas": replicas,
+                "autoscaler": self.autoscaler_url or None,
+                "backends": (fleet_dbg or {}).get("backends"),
+            },
+            "traces": traces,
+            "flight": flight,
+            "router": {"events": router_events,
+                       "debug": fleet_dbg},
+            "autoscaler": autoscaler,
+            "alerts": self.engine.evaluate(now),
+        })
+        self.metrics["tpustack_watchtower_incidents_total"].labels(
+            reason=reason).inc()
+        self._last_capture_at = time.monotonic()
+        log.warning("incident bundle %s captured: reason=%s trigger=%s "
+                    "(%d traces, %d processes)", bundle["id"], reason,
+                    trigger, len(traces), len(flight))
+        return bundle
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> Dict:
+        """One watch cycle: discover, scrape, evaluate, maybe capture.
+        Returns the tick record (also kept for /debug/watchtower)."""
+        now = time.time()
+        fleet = self.discover()
+        merged, scraped_ok = self._scrape_fleet_metrics()
+        if scraped_ok:
+            self.engine.observe(now, merged)
+        state = self.engine.evaluate(now)
+        with self._lock:
+            n_replicas = len(self._replicas)
+        self._export_alert_metrics(state, n_replicas)
+
+        triggers: List[Tuple[str, Dict]] = []
+        for ev in self._poll_flight_events():
+            if ev.get("kind") == "ejection":
+                triggers.append(("ejection", ev))
+            elif ev.get("kind") == "breaker" and ev.get("to") == "open":
+                triggers.append(("breaker", ev))
+        active_now = {(a["severity"], a["server"], a["kind"])
+                      for a in state.get("active", ())}
+        for key in sorted(active_now - self._active_alerts):
+            triggers.append(("alert", {"severity": key[0], "server": key[1],
+                                       "kind": key[2]}))
+        self._active_alerts = active_now
+        for d in self._poll_autoscaler_decisions():
+            triggers.append(("unhealthy_floor", d))
+
+        captured = None
+        if triggers:
+            since = time.monotonic() - self._last_capture_at
+            if since >= self.cooldown_s:
+                reason, trig = triggers[0]
+                if len(triggers) > 1:
+                    trig = dict(trig, coalesced=[
+                        {"reason": r} for r, _ in triggers[1:]])
+                captured = self.capture_bundle(reason, trig)["id"]
+            else:
+                log.info("incident trigger suppressed by cooldown "
+                         "(%.1fs < %.1fs): %s", since, self.cooldown_s,
+                         [r for r, _ in triggers])
+        self._ticks += 1
+        record = {
+            "t": now,
+            "router_reachable": fleet is not None,
+            "replicas": n_replicas,
+            "targets_scraped": scraped_ok,
+            "alerts_active": sorted(active_now),
+            "triggers": [r for r, _ in triggers],
+            "captured": captured,
+        }
+        with self._lock:
+            self._last_tick = record
+        return record
+
+    # ----------------------------------------------------------- lifecycle
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                log.exception("watchtower tick failed; continuing")
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="tpustack-watchtower")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(5.0, self.interval_s * 2))
+            self._thread = None
+
+    # ---------------------------------------------------------------- views
+    def debug_payload(self) -> Dict:
+        with self._lock:
+            last_tick = self._last_tick
+            replicas = list(self._replicas)
+        return {
+            "router_url": self.router_url,
+            "autoscaler_url": self.autoscaler_url or None,
+            "replicas": replicas,
+            "config": {
+                "interval_s": self.interval_s,
+                "cooldown_s": self.cooldown_s,
+                "traces_per_bundle": self.traces_per_bundle,
+                "window_scale": self.engine.window_scale,
+                "incident_dir": self.store.dump_dir or None,
+                "incident_keep": self.store.keep,
+            },
+            "ticks": self._ticks,
+            "last_tick": last_tick,
+            "incidents": len(self.store),
+            "alerts_active": sorted(self._active_alerts),
+        }
+
+    async def debug_watchtower(self, request: web.Request) -> web.Response:
+        return web.json_response(self.debug_payload())
+
+    async def debug_alerts(self, request: web.Request) -> web.Response:
+        return web.json_response(self.engine.evaluate(time.time()))
+
+    async def debug_incidents(self, request: web.Request) -> web.Response:
+        return web.json_response({"incidents": self.store.list()})
+
+    async def debug_incident(self, request: web.Request) -> web.Response:
+        bundle = self.store.get(request.match_info["incident_id"])
+        if bundle is None:
+            return web.json_response({"error": "unknown incident"},
+                                     status=404)
+        return web.json_response(bundle)
+
+    async def debug_trace(self, request: web.Request) -> web.Response:
+        trace_id = request.match_info["trace_id"]
+        stitched = await asyncio.get_event_loop().run_in_executor(
+            None, self.stitch_trace, trace_id)
+        if stitched is None:
+            return web.json_response(
+                {"error": "no process holds this trace"}, status=404)
+        return web.json_response(stitched)
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        with self._lock:
+            last_tick = self._last_tick
+        return web.json_response({"ok": True, "ticks": self._ticks,
+                                  "last_tick_t": (last_tick or {}).get("t")})
+
+    async def readyz(self, request: web.Request) -> web.Response:
+        # ready as long as the loop thread lives: a blind watchtower
+        # serves its retained evidence, which is the whole point
+        alive = self._thread is not None and self._thread.is_alive()
+        return web.json_response({"ready": alive},
+                                 status=200 if alive else 503)
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/readyz", self.readyz)
+        app.router.add_get("/metrics",
+                           obs_http.make_metrics_handler(self._registry))
+        app.router.add_get("/debug/watchtower", self.debug_watchtower)
+        app.router.add_get("/debug/alerts", self.debug_alerts)
+        app.router.add_get("/debug/incidents", self.debug_incidents)
+        app.router.add_get("/debug/incidents/{incident_id}",
+                           self.debug_incident)
+        app.router.add_get("/debug/traces/{trace_id}", self.debug_trace)
+        return app
+
+
+# ------------------------------------------------------------------ wiring
+def maybe_from_env(registry=None, env=None) -> Optional[Watchtower]:
+    """The bisection contract: ``TPUSTACK_WATCHTOWER_ROUTER_URL``
+    unset/empty constructs NOTHING."""
+    router_url = knobs.get_str(
+        "TPUSTACK_WATCHTOWER_ROUTER_URL", env=env).strip()
+    if not router_url:
+        return None
+    return Watchtower(
+        router_url,
+        autoscaler_url=knobs.get_str(
+            "TPUSTACK_WATCHTOWER_AUTOSCALER_URL", env=env).strip(),
+        registry=registry, env=env)
+
+
+def main() -> None:
+    tower = maybe_from_env()
+    if tower is None:
+        raise SystemExit("TPUSTACK_WATCHTOWER_ROUTER_URL is not set — "
+                         "nothing to watch")
+    tower.start()
+    obs_http.maybe_start_metrics_sidecar()
+    port = int(os.environ.get("PORT", "8092"))
+    web.run_app(tower.build_app(), port=port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

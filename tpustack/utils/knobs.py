@@ -288,6 +288,38 @@ _declare("TPUSTACK_AUTOSCALER_K8S_NAMESPACE", str, "llm",
          "Kubernetes executor: namespace of the managed Deployment (the "
          "RBAC Role grants deployments/scale patch here only).")
 
+# -------------------------------------------------------------- watchtower
+_declare("TPUSTACK_WATCHTOWER_ROUTER_URL", str, "",
+         "Base URL of the L7 router the watchtower discovers the fleet "
+         "from (/debug/router) and stitches traces through.  Empty is "
+         "the bisection flag — no watchtower constructs.")
+_declare("TPUSTACK_WATCHTOWER_AUTOSCALER_URL", str, "",
+         "Base URL of the autoscaler's debug surface; when set, its "
+         "decisions (unhealthy_floor holds) join the incident evidence "
+         "and can trigger bundles.  Empty skips the autoscaler scrape.")
+_declare("TPUSTACK_WATCHTOWER_INTERVAL_S", float, 5.0,
+         "Seconds between watchtower ticks (scrape fleet -> evaluate "
+         "burn rates -> capture incident bundles).")
+_declare("TPUSTACK_WATCHTOWER_INCIDENT_DIR", str, "",
+         "Directory of the bounded on-disk incident-bundle ring.  Empty "
+         "keeps bundles in memory only (still served on "
+         "/debug/incidents, lost with the process).")
+_declare("TPUSTACK_WATCHTOWER_INCIDENT_KEEP", int, 16,
+         "Ring bound: newest bundles kept in memory and on disk; older "
+         "incident-*.json artifacts are pruned on every capture.")
+_declare("TPUSTACK_WATCHTOWER_INCIDENT_COOLDOWN_S", float, 60.0,
+         "Minimum seconds between incident captures — one fleet event "
+         "(an ejection storm, a flapping breaker) yields one bundle, "
+         "not one per tick.")
+_declare("TPUSTACK_WATCHTOWER_TRACES_PER_BUNDLE", int, 5,
+         "How many slowest/errored stitched traces a bundle snapshots "
+         "(K in the incident-forensics runbook).")
+_declare("TPUSTACK_WATCHTOWER_WINDOW_SCALE", float, 1.0,
+         "Multiplier on the canonical burn-rate alert windows "
+         "(5m/1h fast page, 30m/6h slow ticket).  1.0 in production; "
+         "tests and chaos drills shrink it so alerts resolve within a "
+         "drill.")
+
 # ------------------------------------------------------------ fault injection
 _declare("TPUSTACK_FAULT_SLOW_PREFILL_S", float, 0.0,
          "Sleep injected before every device dispatch (deterministic "
